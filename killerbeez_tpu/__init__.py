@@ -1,0 +1,47 @@
+"""killerbeez_tpu — a TPU-native fuzzing framework.
+
+A from-scratch re-design of the Killerbeez fuzzing framework
+(reference: grimm-co/killerbeez) for TPU hardware via JAX/XLA/Pallas.
+
+The reference decomposes a fuzzer into three pluggable component types
+(reference fuzzer/main.c): a *driver* delivers input to the target, an
+*instrumentation* runs the target and classifies the outcome
+(crash/hang/new-path), and a *mutator* generates candidate inputs.
+killerbeez_tpu keeps that decomposition as its API but makes the inner
+loop array-shaped: a batch of candidate inputs is a ``uint8[B, L]``
+tensor, coverage is an AFL-style 64KB edge bitmap per lane, and
+mutation -> execution -> novelty -> triage is one jitted step.
+
+Package map:
+  utils/            logging, JSON option parsing, state serialization
+  ops/              coverage bitmap ops, hashing (device + host)
+  mutators/         vmapped byte-tensor mutators behind the mutator vtable
+  models/           the KBVM bytecode VM (TPU-native "QEMU mode") + targets
+  instrumentation/  jit_harness / return_code / forkserver-afl backends
+  drivers/          file / stdin / network drivers
+  fuzzer/           the batched main loop + CLI
+  parallel/         multi-chip shard_map tier (ICI coverage allreduce)
+  tools/            merger / tracer / picker / minimize
+  manager/          distributed job manager (REST + sqlite work queue)
+  native/           C/C++ host-side exec backend (forkserver protocol)
+"""
+
+__version__ = "0.1.0"
+
+MAP_SIZE_POW2 = 16
+MAP_SIZE = 1 << MAP_SIZE_POW2  # AFL-compatible edge bitmap size (reference afl_progs/config.h:314-315)
+
+# Fuzz verdicts (reference killerbeez-utils global_types.h, via SURVEY §2.11)
+FUZZ_NONE = 0
+FUZZ_HANG = 1
+FUZZ_CRASH = 2
+FUZZ_RUNNING = 3
+FUZZ_ERROR = 4
+
+FUZZ_RESULT_NAMES = {
+    FUZZ_NONE: "none",
+    FUZZ_HANG: "hang",
+    FUZZ_CRASH: "crash",
+    FUZZ_RUNNING: "running",
+    FUZZ_ERROR: "error",
+}

@@ -1,0 +1,29 @@
+"""Device-side coverage and hashing primitives.
+
+These are the TPU re-implementations of the reference's hot bitmap
+loops (reference afl_instrumentation.c:600-707,
+dynamorio_instrumentation.c:1428-1469) as vectorized XLA ops.
+"""
+
+from .coverage import (
+    classify_counts,
+    simplify_trace,
+    has_new_bits,
+    has_new_bits_with_ignore,
+    has_new_bits_seq,
+    has_new_bits_batch,
+    update_virgin,
+    merge_virgin,
+    build_bitmap,
+    count_non_255_bytes,
+    count_bytes,
+)
+from .hashing import murmur3_32, murmur3_32_np, xxh64, hash_bitmaps
+
+__all__ = [
+    "classify_counts", "simplify_trace", "has_new_bits",
+    "has_new_bits_with_ignore", "has_new_bits_seq", "has_new_bits_batch",
+    "update_virgin", "merge_virgin", "build_bitmap",
+    "count_non_255_bytes", "count_bytes",
+    "murmur3_32", "murmur3_32_np", "xxh64", "hash_bitmaps",
+]

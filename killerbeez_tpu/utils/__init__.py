@@ -1,0 +1,45 @@
+"""Reconstruction of the killerbeez-utils surface (SURVEY §2.11).
+
+The reference's utility library is a sibling repo absent from the
+checkout; this package rebuilds the API surface inferred from call
+sites: leveled logging configured by a JSON option string, JSON option
+parsing helpers (the PARSE_OPTION_* macro family), file IO helpers,
+and mem-array encoding for multi-part input serialization.
+"""
+
+from .logging import (
+    setup_logging,
+    logging_help,
+    DEBUG_MSG,
+    INFO_MSG,
+    WARNING_MSG,
+    ERROR_MSG,
+    CRITICAL_MSG,
+    FATAL_MSG,
+    get_logger,
+)
+from .options import (
+    parse_options,
+    get_option,
+    add_int_option_to_json,
+    add_option_to_json,
+)
+from .fileio import (
+    read_file,
+    write_buffer_to_file,
+    file_exists,
+    get_temp_filename,
+    md5_hex,
+)
+from .serialization import encode_mem_array, decode_mem_array
+
+__all__ = [
+    "setup_logging", "logging_help", "get_logger",
+    "DEBUG_MSG", "INFO_MSG", "WARNING_MSG", "ERROR_MSG", "CRITICAL_MSG",
+    "FATAL_MSG",
+    "parse_options", "get_option", "add_int_option_to_json",
+    "add_option_to_json",
+    "read_file", "write_buffer_to_file", "file_exists", "get_temp_filename",
+    "md5_hex",
+    "encode_mem_array", "decode_mem_array",
+]

@@ -1,0 +1,228 @@
+"""Bit-for-bit parity tests for the coverage core.
+
+The oracle is an independent scalar-python transcription of the AFL
+contract described in SURVEY §2.3 (classify buckets, has_new_bits
+return codes, virgin update, simplify_trace, AND-merge).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from killerbeez_tpu import MAP_SIZE
+from killerbeez_tpu.ops import (
+    classify_counts, simplify_trace, has_new_bits, has_new_bits_seq,
+    has_new_bits_batch, has_new_bits_with_ignore, update_virgin,
+    merge_virgin, build_bitmap, count_non_255_bytes, count_bytes,
+    hash_bitmaps, murmur3_32, murmur3_32_np, xxh64,
+)
+
+M = 256  # small map for oracle loops
+
+
+def oracle_classify(b):
+    if b == 0:
+        return 0
+    if b == 1:
+        return 1
+    if b == 2:
+        return 2
+    if b == 3:
+        return 4
+    if b < 8:
+        return 8
+    if b < 16:
+        return 16
+    if b < 32:
+        return 32
+    if b < 128:
+        return 64
+    return 128
+
+
+def oracle_has_new_bits(virgin, trace):
+    ret = 0
+    virgin = virgin.copy()
+    for i in range(len(virgin)):
+        if trace[i] and (trace[i] & virgin[i]):
+            if ret < 2:
+                ret = 2 if virgin[i] == 0xFF else 1
+        virgin[i] &= ~trace[i] & 0xFF
+    return ret, virgin
+
+
+def test_classify_all_256():
+    raw = np.arange(256, dtype=np.uint8)
+    got = np.asarray(classify_counts(jnp.asarray(raw)))
+    want = np.array([oracle_classify(b) for b in range(256)], dtype=np.uint8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_simplify_trace():
+    raw = np.arange(256, dtype=np.uint8)
+    got = np.asarray(simplify_trace(jnp.asarray(raw)))
+    want = np.where(raw == 0, 1, 128).astype(np.uint8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_has_new_bits_parity(rng):
+    for trial in range(20):
+        virgin = rng.integers(0, 256, M).astype(np.uint8)
+        virgin[rng.random(M) < 0.5] = 0xFF
+        trace = rng.integers(0, 256, M).astype(np.uint8)
+        trace[rng.random(M) < 0.7] = 0  # sparse like real traces
+        trace = np.array([oracle_classify(b) for b in trace], dtype=np.uint8)
+        want_ret, want_v = oracle_has_new_bits(virgin, trace)
+        ret, v = has_new_bits(jnp.asarray(virgin), jnp.asarray(trace))
+        assert int(ret) == want_ret, trial
+        np.testing.assert_array_equal(np.asarray(v), want_v)
+
+
+def test_has_new_bits_cases():
+    virgin = np.full(M, 0xFF, dtype=np.uint8)
+    trace = np.zeros(M, dtype=np.uint8)
+    ret, v = has_new_bits(jnp.asarray(virgin), jnp.asarray(trace))
+    assert int(ret) == 0  # nothing hit
+    trace[7] = 1
+    ret, v = has_new_bits(jnp.asarray(virgin), jnp.asarray(trace))
+    assert int(ret) == 2  # brand new edge
+    # same edge, same count class again -> 0
+    ret2, v2 = has_new_bits(v, jnp.asarray(trace))
+    assert int(ret2) == 0
+    # same edge, new count class -> 1
+    trace2 = np.zeros(M, dtype=np.uint8)
+    trace2[7] = 2
+    ret3, _ = has_new_bits(v, jnp.asarray(trace2))
+    assert int(ret3) == 1
+
+
+def test_seq_matches_singles(rng):
+    virgin = np.full(M, 0xFF, dtype=np.uint8)
+    traces = np.zeros((16, M), dtype=np.uint8)
+    for i in range(16):
+        idx = rng.integers(0, M, 4)
+        traces[i, idx] = np.array(
+            [oracle_classify(c) for c in rng.integers(1, 200, 4)],
+            dtype=np.uint8)
+    rets, final_v = has_new_bits_seq(jnp.asarray(virgin), jnp.asarray(traces))
+    v = virgin
+    for i in range(16):
+        want, v = oracle_has_new_bits(v, traces[i])
+        assert int(rets[i]) == want, i
+    np.testing.assert_array_equal(np.asarray(final_v), v)
+
+
+def test_batch_mode_dedups_and_unions(rng):
+    virgin = np.full(M, 0xFF, dtype=np.uint8)
+    t = np.zeros((4, M), dtype=np.uint8)
+    t[0, 3] = 1
+    t[1, 3] = 1          # duplicate of lane 0 -> deduped by hash
+    t[2, 9] = 1          # distinct new path
+    # lane 3 all zero -> not new
+    hashes = hash_bitmaps(jnp.asarray(t))
+    rets, v = has_new_bits_batch(jnp.asarray(virgin), jnp.asarray(t), hashes)
+    assert list(np.asarray(rets)) == [2, 0, 2, 0]
+    # virgin updated with union of the new lanes
+    assert np.asarray(v)[3] == 0xFF & ~1
+    assert np.asarray(v)[9] == 0xFF & ~1
+    # second batch with the same traces: nothing new
+    rets2, _ = has_new_bits_batch(v, jnp.asarray(t), hashes)
+    assert list(np.asarray(rets2)) == [0, 0, 0, 0]
+
+
+def test_ignore_mask():
+    virgin = np.full(M, 0xFF, dtype=np.uint8)
+    trace = np.zeros(M, dtype=np.uint8)
+    trace[5] = 1
+    ignore = np.zeros(M, dtype=np.uint8)
+    ignore[5] = 0xFF
+    ret, v = has_new_bits_with_ignore(
+        jnp.asarray(virgin), jnp.asarray(trace), jnp.asarray(ignore))
+    assert int(ret) == 0
+    np.testing.assert_array_equal(np.asarray(v), virgin)
+    # ignore is byte-granular: ANY nonzero ignore byte excludes the
+    # whole trace byte (reference if (!ignore_bytes[i]) semantics)
+    ignore2 = np.zeros(M, dtype=np.uint8)
+    ignore2[5] = 0x01
+    trace2 = np.zeros(M, dtype=np.uint8)
+    trace2[5] = 0x08
+    ret2, v2 = has_new_bits_with_ignore(
+        jnp.asarray(virgin), jnp.asarray(trace2), jnp.asarray(ignore2))
+    assert int(ret2) == 0
+    np.testing.assert_array_equal(np.asarray(v2), virgin)
+
+
+def test_merge_virgin_is_union_of_coverage():
+    a = np.full(M, 0xFF, dtype=np.uint8)
+    b = np.full(M, 0xFF, dtype=np.uint8)
+    a[1] &= ~1 & 0xFF
+    b[2] &= ~4 & 0xFF
+    m = np.asarray(merge_virgin(jnp.asarray(a), jnp.asarray(b)))
+    assert m[1] == 0xFE and m[2] == 0xFB
+
+
+def test_build_bitmap_counts_and_wrap():
+    ids = np.array([[5, 5, 5, 9, 0]], dtype=np.int32)
+    valid = np.array([[True, True, True, True, False]])
+    bm = np.asarray(build_bitmap(jnp.asarray(ids), jnp.asarray(valid),
+                                 map_size=64))
+    assert bm.shape == (1, 64)
+    assert bm[0, 5] == 3 and bm[0, 9] == 1 and bm[0, 0] == 0
+    # uint8 wraparound like the C trampoline's u8 increment
+    ids300 = np.zeros((1, 300), dtype=np.int32)
+    valid300 = np.ones((1, 300), dtype=bool)
+    bm2 = np.asarray(build_bitmap(jnp.asarray(ids300), jnp.asarray(valid300),
+                                  map_size=64))
+    assert bm2[0, 0] == 300 % 256
+    # out-of-range ids (incl. negative, which .at[] would wrap) are dropped
+    ids_bad = np.array([[70000, -1, 3]], dtype=np.int32)
+    ok = np.ones((1, 3), dtype=bool)
+    bm3 = np.asarray(build_bitmap(jnp.asarray(ids_bad), jnp.asarray(ok),
+                                  map_size=64))
+    assert bm3.sum() == 1 and bm3[0, 3] == 1
+
+
+def test_counters():
+    v = np.full(M, 0xFF, dtype=np.uint8)
+    v[3] = 0xFE
+    assert int(count_non_255_bytes(jnp.asarray(v))) == 1
+    t = np.zeros(M, dtype=np.uint8)
+    t[1] = t[8] = 7
+    assert int(count_bytes(jnp.asarray(t))) == 2
+
+
+def test_murmur_device_vs_host(rng):
+    for n_words in (1, 4, 16384):
+        data = rng.integers(0, 256, n_words * 4).astype(np.uint8).tobytes()
+        words = np.frombuffer(data, dtype="<u4")
+        got = int(murmur3_32(jnp.asarray(words)))
+        want = murmur3_32_np(data)
+        assert got == want, n_words
+
+
+def test_murmur_known_vectors():
+    # public MurmurHash3_x86_32 test vectors
+    assert murmur3_32_np(b"", seed=0) == 0
+    assert murmur3_32_np(b"", seed=1) == 0x514E28B7
+    assert murmur3_32_np(b"abc", seed=0) == 0xB3DD93FA
+    assert murmur3_32_np(b"Hello, world!", seed=1234) == 0xFAF6CDB3
+
+
+def test_hash_bitmaps_batched(rng):
+    maps = rng.integers(0, 3, (8, 1024)).astype(np.uint8)
+    hs = np.asarray(hash_bitmaps(jnp.asarray(maps)))
+    assert hs.shape == (8,)
+    for i in range(8):
+        assert int(hs[i]) == murmur3_32_np(maps[i].tobytes())
+    # distinct maps should (overwhelmingly) hash distinctly
+    assert len(set(hs.tolist())) == 8
+
+
+def test_xxh64_known_vectors():
+    # public XXH64 test vectors
+    assert xxh64(b"") == 0xEF46DB3751D8E999
+    assert xxh64(b"a") == 0xD24EC4F1A98C6E5B
+    assert xxh64(b"abc") == 0x44BC2CF5AD770999
+    long = bytes(range(256)) * 8
+    assert xxh64(long) == xxh64(long)
+    assert xxh64(long) != xxh64(long[:-1])
+    assert xxh64(b"abc", seed=1) != xxh64(b"abc")

@@ -1,0 +1,111 @@
+import json
+import os
+
+import numpy as np
+import pytest
+
+from killerbeez_tpu.utils import (
+    setup_logging, logging_help, parse_options, add_int_option_to_json,
+    read_file, write_buffer_to_file, file_exists, get_temp_filename,
+    md5_hex, encode_mem_array, decode_mem_array,
+)
+from killerbeez_tpu.utils.options import OptionError, format_help
+from killerbeez_tpu.utils.serialization import (
+    encode_array, decode_array, state_dumps, state_loads,
+)
+from killerbeez_tpu.utils.logging import FatalError, FATAL_MSG
+
+
+def test_parse_options_schema():
+    schema = {"path": str, "timeout": int, "ratio": float}
+    opts = parse_options('{"path": "/bin/x", "timeout": 3}', schema,
+                         defaults={"ratio": 2.0})
+    assert opts == {"path": "/bin/x", "timeout": 3, "ratio": 2.0}
+
+
+def test_parse_options_rejects_unknown_and_badtype():
+    schema = {"timeout": int}
+    with pytest.raises(OptionError):
+        parse_options('{"timeoot": 1}', schema)
+    with pytest.raises(OptionError):
+        parse_options('{"timeout": "x"}', schema)
+    with pytest.raises(OptionError):
+        parse_options('not json', schema)
+    with pytest.raises(OptionError):
+        parse_options('{"ratio": true}', {"ratio": float})
+
+
+def test_parse_options_empty():
+    assert parse_options(None, {"a": int}) == {}
+    assert parse_options("", None) == {}
+
+
+def test_add_int_option():
+    s = add_int_option_to_json('{"a": 1}', "edges", 1)
+    assert json.loads(s) == {"a": 1, "edges": 1}
+    s2 = add_int_option_to_json(None, "edges", 1)
+    assert json.loads(s2) == {"edges": 1}
+
+
+def test_format_help():
+    h = format_help("file", {"path": str}, {"path": "target binary"})
+    assert "path" in h and "file" in h
+
+
+def test_fileio_roundtrip(tmp_path):
+    p = tmp_path / "buf.bin"
+    write_buffer_to_file(p, b"ABCD")
+    assert file_exists(p)
+    assert read_file(p) == b"ABCD"
+    assert md5_hex(b"ABCD") == "cb08ca4a7bb5f9683c19133a84872ca7"
+
+
+def test_temp_filename():
+    p = get_temp_filename("kbz_test")
+    assert os.path.exists(p)
+    os.unlink(p)
+
+
+def test_mem_array_roundtrip():
+    bufs = [b"\x00\x01", b"", b"packet2" * 100]
+    assert decode_mem_array(encode_mem_array(bufs)) == bufs
+
+
+def test_array_codec_roundtrip():
+    a = (np.arange(65536) % 251).astype(np.uint8)
+    d = encode_array(a)
+    assert json.dumps(d)  # json-safe
+    np.testing.assert_array_equal(decode_array(d), a)
+    d2 = encode_array(a.reshape(256, 256), compress=False)
+    np.testing.assert_array_equal(decode_array(d2), a.reshape(256, 256))
+
+
+def test_state_codec():
+    s = state_dumps({"iteration": 5, "x": "y"})
+    assert state_loads(s) == {"iteration": 5, "x": "y"}
+    assert state_loads("") == {}
+
+
+def test_logging_config_and_fatal(tmp_path, capsys):
+    logf = tmp_path / "log.txt"
+    setup_logging(json.dumps({"level": 2, "file": str(logf)}))
+    from killerbeez_tpu.utils import INFO_MSG, WARNING_MSG
+    INFO_MSG("hidden %d", 1)
+    WARNING_MSG("shown %s", "msg")
+    with pytest.raises(FatalError):
+        FATAL_MSG("boom")
+    text = logf.read_text()
+    assert "hidden" not in text
+    assert "shown msg" in text and "WARNING" in text
+    assert "boom" in text and "FATAL" in text
+    assert "level" in logging_help()
+    # reset for other tests
+    setup_logging('{"level": 1}')
+    from killerbeez_tpu.utils.logging import _state
+    import sys
+    _state.stream = sys.stderr
+
+
+def test_logging_bad_level():
+    with pytest.raises(ValueError):
+        setup_logging('{"level": 9}')

@@ -1,0 +1,73 @@
+"""Benchmark: execs/sec/chip on the corpus-test workload.
+
+Measures the fused on-device fuzzing pipeline (havoc mutation -> KBVM
+execution of the `test` ABCD-crasher -> AFL-map coverage triage) on
+the real chip, against the reference's ~1k execs/sec forkserver
+baseline (BASELINE.md). Prints exactly one JSON line.
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from killerbeez_tpu import MAP_SIZE
+    from killerbeez_tpu.models import targets
+    from killerbeez_tpu.instrumentation.jit_harness import _fused_step
+    from killerbeez_tpu.ops.mutate_core import havoc_at
+
+    BASELINE = 1000.0  # execs/sec, reference forkserver (BASELINE.md)
+    B = 32768
+    L = 8
+    STEPS = 20
+
+    prog = targets.get_target("test")
+    instrs = jnp.asarray(prog.instrs)
+    seed = b"ABC@"
+    seed_buf = np.zeros(L, dtype=np.uint8)
+    seed_buf[:len(seed)] = np.frombuffer(seed, dtype=np.uint8)
+    seed_buf = jnp.asarray(seed_buf)
+    seed_len = jnp.int32(len(seed))
+
+    @jax.jit
+    def fuzz_step(vb, vc, vh, it):
+        base = jax.random.fold_in(jax.random.key(0), it)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(B, dtype=jnp.uint32))
+        bufs, lens = jax.vmap(
+            lambda k: havoc_at(seed_buf, seed_len, k, stack_pow2=4))(keys)
+        statuses, new_paths, uc, uh, ec, vb2, vc2, vh2, _ = _fused_step(
+            instrs, bufs, lens, vb, vc, vh, prog.mem_size,
+            prog.max_steps, False)
+        return vb2, vc2, vh2, jnp.sum(statuses == 2), jnp.sum(new_paths > 0)
+
+    virgin = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
+    vb, vc, vh = virgin, virgin, virgin
+    # warmup/compile
+    vb, vc, vh, crashes, news = fuzz_step(vb, vc, vh, jnp.uint32(0))
+    jax.block_until_ready(vb)
+
+    t0 = time.time()
+    total_crashes = 0
+    for i in range(1, STEPS + 1):
+        vb, vc, vh, crashes, news = fuzz_step(vb, vc, vh, jnp.uint32(i))
+    total_crashes = int(crashes)
+    jax.block_until_ready(vb)
+    dt = time.time() - t0
+
+    execs_per_sec = B * STEPS / dt
+    print(json.dumps({
+        "metric": "execs/sec/chip on corpus test (fused havoc+KBVM+AFL-map triage)",
+        "value": round(execs_per_sec, 1),
+        "unit": "execs/sec",
+        "vs_baseline": round(execs_per_sec / BASELINE, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

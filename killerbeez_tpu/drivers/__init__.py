@@ -1,0 +1,12 @@
+"""Driver layer: delivers candidate inputs to the target
+(reference driver/driver.h:26-34 vtable + factories)."""
+
+from .base import Driver
+from .factory import (
+    driver_factory, driver_help, driver_names, register_driver,
+)
+from .file_driver import FileDriver
+from .stdin_driver import StdinDriver
+
+__all__ = ["Driver", "driver_factory", "driver_help", "driver_names",
+           "register_driver", "FileDriver", "StdinDriver"]

@@ -1,0 +1,111 @@
+"""Driver base class (reference driver/driver.h:26-34).
+
+Vtable parity: cleanup / test_input / test_next_input /
+get_last_input. ``test_next_input`` returns the FUZZ_* verdict or
+``None`` when the mutator is exhausted (the reference's -2 return,
+fuzzer/main.c:374-383).
+
+TPU addition: ``test_batch(n)`` — mutate and execute ``n`` candidates
+in one device round-trip when both the mutator and the
+instrumentation support batching.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+from ..instrumentation.base import BatchResult, Instrumentation
+from ..mutators.base import Mutator
+from ..utils.options import format_help, parse_options
+
+
+class BatchOutcome(NamedTuple):
+    result: BatchResult
+    inputs: np.ndarray    # uint8[B, L]
+    lengths: np.ndarray   # int32[B]
+
+
+class Driver:
+    name = "base"
+    OPTION_SCHEMA: Dict[str, type] = {}
+    OPTION_DESCS: Dict[str, str] = {}
+    DEFAULTS: Dict[str, Any] = {}
+
+    def __init__(self, options: Optional[str],
+                 instrumentation: Instrumentation,
+                 mutator: Optional[Mutator] = None):
+        self.options = parse_options(options, self.OPTION_SCHEMA,
+                                     self.DEFAULTS)
+        self.instrumentation = instrumentation
+        self.mutator = mutator
+        self.last_input: Optional[bytes] = None
+        self._check_input_info()
+
+    def _check_input_info(self) -> None:
+        """Single-input drivers require num_inputs == 1 (reference
+        file_driver.c:137-139)."""
+        if self.mutator is not None:
+            num, _ = self.mutator.get_input_info()
+            if num != 1:
+                raise ValueError(
+                    f"{self.name} driver requires a single-input mutator, "
+                    f"got {num} parts")
+
+    @property
+    def supports_batch(self) -> bool:
+        return (self.instrumentation.supports_batch
+                and self.mutator is not None
+                and type(self.mutator).mutate_batch is Mutator.mutate_batch)
+
+    # -- single-exec ----------------------------------------------------
+
+    def test_input(self, buf: bytes) -> int:
+        raise NotImplementedError
+
+    def test_next_input(self) -> Optional[int]:
+        """Mutate then test (reference generic_test_next_input,
+        driver/driver.c:75-89). None = mutator exhausted."""
+        if self.mutator is None:
+            raise RuntimeError(f"{self.name}: no mutator attached")
+        buf = self.mutator.mutate()
+        if buf is None:
+            return None
+        return self.test_input(buf)
+
+    def get_last_input(self) -> Optional[bytes]:
+        return self.last_input
+
+    # -- batched --------------------------------------------------------
+
+    def test_batch(self, n: int, pad_to: Optional[int] = None
+                   ) -> BatchOutcome:
+        """Mutate + execute ``n`` candidates. ``pad_to`` pads the lane
+        dimension with copies of lane 0 (shape-stable jit across tail
+        batches; duplicate lanes are coverage no-ops and callers triage
+        only the first ``n``)."""
+        if not self.supports_batch:
+            raise RuntimeError(f"{self.name}: batch path unavailable")
+        bufs, lens = self.mutator.mutate_batch(n)
+        if pad_to is not None and pad_to > n:
+            pad = pad_to - n
+            bufs = np.concatenate(
+                [bufs, np.repeat(bufs[:1], pad, axis=0)], axis=0)
+            lens = np.concatenate([lens, np.repeat(lens[:1], pad)])
+        result = self.instrumentation.run_batch(bufs, lens)
+        if n > 0:
+            self.last_input = bufs[n - 1, :int(lens[n - 1])].tobytes()
+        return BatchOutcome(result=result, inputs=bufs, lengths=lens)
+
+    def cleanup(self) -> None:
+        pass
+
+    @classmethod
+    def help(cls) -> str:
+        head = f"{cls.name} driver"
+        doc = (cls.__doc__ or "").strip().splitlines()
+        if doc:
+            head += f" — {doc[0]}"
+        return head + "\n" + format_help(cls.name, cls.OPTION_SCHEMA,
+                                         cls.OPTION_DESCS)

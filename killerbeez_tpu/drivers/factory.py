@@ -1,0 +1,36 @@
+"""Driver factories (reference driver_factory.c:25-132): the reference
+exposes driver_factory (no deps), driver_instrumentation_factory,
+driver_mutator_factory and driver_all_factory; here one factory takes
+optional instrumentation/mutator and the aggregated help mirrors
+driver_help (driver_factory.c:146-158)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+from .base import Driver
+
+_REGISTRY: Dict[str, Type[Driver]] = {}
+
+
+def register_driver(cls: Type[Driver]) -> Type[Driver]:
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def driver_names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def driver_factory(name: str, options: Optional[str],
+                   instrumentation, mutator=None) -> Driver:
+    """driver_all_factory equivalent: name -> driver wired to its
+    instrumentation and (optionally) mutator."""
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown driver {name!r}; known: {', '.join(driver_names())}")
+    return _REGISTRY[name](options, instrumentation, mutator)
+
+
+def driver_help() -> str:
+    return "\n".join(_REGISTRY[n].help() for n in driver_names())

@@ -1,0 +1,127 @@
+"""fuzzer CLI — argument parity with the reference client
+(fuzzer/main.c:34-69): positional ``driver instrumentation mutator``
+plus -n/-sf/-o/-d/-i/-m/-isd/-isf/-msd/-msf/-l and a batch-size knob.
+
+Usage:
+    python -m killerbeez_tpu.fuzzer file jit_harness bit_flip \
+        -i '{"target": "test"}' -sf seed.bin -n 2000 -o output
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from ..drivers.factory import driver_factory, driver_help
+from ..instrumentation.factory import (
+    instrumentation_factory, instrumentation_help,
+)
+from ..mutators.factory import mutator_factory, mutator_help
+from ..utils.fileio import read_file, write_buffer_to_file
+from ..utils.logging import FatalError, INFO_MSG, setup_logging
+from .loop import Fuzzer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="killerbeez-tpu-fuzzer",
+        description="TPU-native fuzzer (driver / instrumentation / "
+                    "mutator architecture)",
+        epilog="Use -h with no positionals for module help listings.",
+        prefix_chars="-",
+    )
+    p.add_argument("driver", help="driver name (file, stdin, ...)")
+    p.add_argument("instrumentation",
+                   help="instrumentation name (jit_harness, return_code, ...)")
+    p.add_argument("mutator", help="mutator name (bit_flip, havoc, afl, ...)")
+    p.add_argument("-n", "--iterations", type=int, default=-1,
+                   help="number of executions (-1 = until exhausted)")
+    p.add_argument("-sf", "--seed-file", help="seed input file")
+    p.add_argument("-ss", "--seed-string", help="seed input as a string")
+    p.add_argument("-o", "--output", default="output",
+                   help="findings directory (default ./output)")
+    p.add_argument("-d", "--driver-options", help="driver JSON options")
+    p.add_argument("-i", "--instrumentation-options",
+                   help="instrumentation JSON options")
+    p.add_argument("-m", "--mutator-options", help="mutator JSON options")
+    p.add_argument("-isf", "--instrumentation-state-file",
+                   help="load instrumentation state from file")
+    p.add_argument("-isd", "--instrumentation-state-dump",
+                   help="dump instrumentation state to file on exit")
+    p.add_argument("-msf", "--mutator-state-file",
+                   help="load mutator state from file")
+    p.add_argument("-msd", "--mutator-state-dump",
+                   help="dump mutator state to file on exit")
+    p.add_argument("-l", "--logging-options", help="logging JSON options")
+    p.add_argument("-b", "--batch-size", type=int, default=1024,
+                   help="candidates per device step (batched backends)")
+    p.add_argument("--list", action="store_true",
+                   help="list components and their options, then exit")
+    return p
+
+
+def list_components() -> str:
+    return (driver_help() + "\n" + instrumentation_help() + "\n"
+            + mutator_help())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list:
+        print(list_components())
+        return 0
+    try:
+        setup_logging(args.logging_options)
+
+        if args.seed_file:
+            seed = read_file(args.seed_file)
+        elif args.seed_string:
+            seed = args.seed_string.encode()
+        else:
+            print("error: a seed is required (-sf or -ss)",
+                  file=sys.stderr)
+            return 2
+
+        instrumentation = instrumentation_factory(
+            args.instrumentation, args.instrumentation_options)
+        if args.instrumentation_state_file:
+            instrumentation.set_state(
+                read_file(args.instrumentation_state_file).decode())
+
+        mutator = mutator_factory(args.mutator, args.mutator_options, seed)
+        if args.mutator_state_file:
+            mutator.set_state(read_file(args.mutator_state_file).decode())
+
+        driver = driver_factory(args.driver, args.driver_options,
+                                instrumentation, mutator)
+
+        fuzzer = Fuzzer(driver, output_dir=args.output,
+                        batch_size=args.batch_size)
+        stats = fuzzer.run(args.iterations)
+        INFO_MSG(
+            "results: %d crashes (%d unique), %d hangs (%d unique), "
+            "%d new paths",
+            stats.crashes, stats.unique_crashes, stats.hangs,
+            stats.unique_hangs, stats.new_paths)
+
+        # state dumps on exit (reference fuzzer/main.c:426-447)
+        if args.instrumentation_state_dump:
+            write_buffer_to_file(args.instrumentation_state_dump,
+                                 instrumentation.get_state().encode())
+        if args.mutator_state_dump:
+            write_buffer_to_file(args.mutator_state_dump,
+                                 mutator.get_state().encode())
+        driver.cleanup()
+        instrumentation.cleanup()
+        mutator.cleanup()
+        return 0
+    except FatalError:
+        return 1
+    except (ValueError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,158 @@
+"""The fuzzing main loop — batch generalization of the reference's
+iteration loop (fuzzer/main.c:370-418).
+
+Per step: mutate a candidate batch on device -> execute (device VM or
+host backend) -> novelty/verdict reduce on device -> gather only the
+interesting lanes to host -> md5-dedup and write findings to
+``output/{crashes,hangs,new_paths}/<md5>`` exactly like the reference
+(fuzzer/main.c:404-417). Single-exec backends fall back to the
+reference-shaped scalar loop.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+from .. import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_NONE
+from ..drivers.base import Driver
+from ..utils.fileio import ensure_dir, md5_hex, write_buffer_to_file
+from ..utils.logging import CRITICAL_MSG, DEBUG_MSG, INFO_MSG, WARNING_MSG
+
+FINDING_DIRS = {FUZZ_CRASH: "crashes", FUZZ_HANG: "hangs"}
+
+
+@dataclass
+class FuzzStats:
+    iterations: int = 0
+    crashes: int = 0
+    hangs: int = 0
+    new_paths: int = 0
+    unique_crashes: int = 0
+    unique_hangs: int = 0
+    errors: int = 0
+    elapsed: float = 0.0
+
+    def as_dict(self) -> Dict[str, float]:
+        return dict(self.__dict__)
+
+
+class Fuzzer:
+    """Drives driver/instrumentation/mutator to completion."""
+
+    def __init__(self, driver: Driver, output_dir: str = "output",
+                 batch_size: int = 1024, write_findings: bool = True):
+        self.driver = driver
+        self.output_dir = output_dir
+        self.batch_size = int(batch_size)
+        self.write_findings = write_findings
+        self.stats = FuzzStats()
+        self._seen = {k: set() for k in ("crashes", "hangs", "new_paths")}
+        if write_findings:
+            for sub in ("crashes", "hangs", "new_paths"):
+                ensure_dir(os.path.join(output_dir, sub))
+
+    # -- finding triage (reference fuzzer/main.c:393-417) ---------------
+
+    def _record(self, kind: str, buf: bytes) -> bool:
+        """Write a finding, deduped by input md5. Returns True if new."""
+        digest = md5_hex(buf)
+        if digest in self._seen[kind]:
+            return False
+        self._seen[kind].add(digest)
+        path = os.path.join(self.output_dir, kind, digest)
+        if self.write_findings:
+            if os.path.exists(path):  # left over from a previous run
+                return False
+            write_buffer_to_file(path, buf)
+            CRITICAL_MSG("Found a %s! Saving result to %s",
+                         kind.rstrip("es") if kind != "crashes"
+                         else "crash", path)
+        else:
+            CRITICAL_MSG("Found a %s (%s)",
+                         kind.rstrip("es") if kind != "crashes"
+                         else "crash", digest)
+        return True
+
+    def _triage_lane(self, status: int, new_path: int, buf: bytes,
+                     unique_crash: bool = False,
+                     unique_hang: bool = False) -> None:
+        s = self.stats
+        if status == FUZZ_CRASH:
+            s.crashes += 1
+            s.unique_crashes += int(unique_crash)
+            self._record("crashes", buf)
+        elif status == FUZZ_HANG:
+            s.hangs += 1
+            s.unique_hangs += int(unique_hang)
+            self._record("hangs", buf)
+        elif status == FUZZ_ERROR:
+            s.errors += 1
+            WARNING_MSG("target exec error on iteration %d", s.iterations)
+        if new_path > 0:
+            s.new_paths += 1
+            self._record("new_paths", buf)
+
+    # -- loops ----------------------------------------------------------
+
+    def run(self, n_iterations: int = -1) -> FuzzStats:
+        """Run ``n_iterations`` executions (-1 = until the mutator
+        exhausts). Uses the batched path when available."""
+        start = time.time()
+        if self.driver.supports_batch:
+            self._run_batched(n_iterations)
+        else:
+            self._run_single(n_iterations)
+        self.stats.elapsed = time.time() - start
+        INFO_MSG("Ran %d iterations in %.1f seconds",
+                 self.stats.iterations, self.stats.elapsed)
+        return self.stats
+
+    def _remaining(self, n_iterations: int) -> int:
+        if n_iterations < 0:
+            return 2**62 - self.stats.iterations
+        return n_iterations - self.stats.iterations
+
+    def _run_batched(self, n_iterations: int) -> None:
+        mut = self.driver.mutator
+        while True:
+            room = min(self._remaining(n_iterations), mut.remaining(),
+                       self.batch_size)
+            if room <= 0:
+                break
+            # a smaller tail batch would change tensor shapes and force
+            # a full XLA recompile; the driver pads to batch_size with
+            # duplicate lanes (coverage no-ops) and we triage only the
+            # first `room` real lanes
+            out = self.driver.test_batch(room, pad_to=self.batch_size)
+            self.stats.iterations += room
+            res = out.result
+            interesting = np.flatnonzero(
+                (res.statuses[:room] != FUZZ_NONE)
+                | (res.new_paths[:room] > 0))
+            for i in interesting:
+                buf = out.inputs[i, :int(out.lengths[i])].tobytes()
+                self._triage_lane(int(res.statuses[i]),
+                                  int(res.new_paths[i]), buf,
+                                  bool(res.unique_crashes[i]),
+                                  bool(res.unique_hangs[i]))
+            DEBUG_MSG("batch done: %d iterations total",
+                      self.stats.iterations)
+
+    def _run_single(self, n_iterations: int) -> None:
+        instr = self.driver.instrumentation
+        while self._remaining(n_iterations) > 0:
+            result = self.driver.test_next_input()
+            if result is None:  # mutator exhausted (reference -2)
+                INFO_MSG("mutator exhausted after %d iterations",
+                         self.stats.iterations)
+                break
+            self.stats.iterations += 1
+            buf = self.driver.get_last_input() or b""
+            self._triage_lane(result, instr.is_new_path(), buf,
+                              instr.last_unique_crash(),
+                              instr.last_unique_hang())

@@ -1,0 +1,22 @@
+"""Instrumentation layer: runs the target and classifies outcomes.
+
+Vtable parity with the reference (instrumentation/instrumentation.h:
+40-63): create/cleanup/merge/get_state/set_state/enable/is_new_path/
+get_fuzz_result + optional get_module_info/get_edges/is_process_done —
+plus the TPU-native ``run_batch`` fast path.
+"""
+
+from .base import BatchResult, Instrumentation
+from .factory import (
+    instrumentation_factory, instrumentation_help, instrumentation_names,
+    register_instrumentation,
+)
+from .jit_harness import JitHarnessInstrumentation
+from .return_code import ReturnCodeInstrumentation
+
+__all__ = [
+    "Instrumentation", "BatchResult",
+    "instrumentation_factory", "instrumentation_help",
+    "instrumentation_names", "register_instrumentation",
+    "JitHarnessInstrumentation", "ReturnCodeInstrumentation",
+]

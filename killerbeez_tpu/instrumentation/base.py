@@ -1,0 +1,117 @@
+"""Instrumentation base class (reference instrumentation.h:40-63).
+
+Two execution APIs:
+
+  * single-exec (reference-shaped): ``enable(input)`` runs one input;
+    ``get_fuzz_result()`` / ``is_new_path()`` report on it. Drivers
+    built for host exec backends use this.
+  * batched (TPU-native): ``run_batch(inputs, lengths)`` executes a
+    whole candidate tensor and returns per-lane verdicts + novelty in
+    one device round-trip. ``supports_batch`` advertises it.
+
+State is a JSON string (get_state/set_state) and ``merge`` folds two
+states' coverage together — the cross-node primitive the merger tool
+and the ICI allreduce tier both build on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from .. import FUZZ_NONE
+from ..utils.options import format_help, parse_options
+
+
+class BatchResult(NamedTuple):
+    """Per-lane outcome of a batched execution."""
+    statuses: np.ndarray      # int32[B] FUZZ_* (RUNNING already -> HANG)
+    new_paths: np.ndarray     # int32[B] 0 / 1 (new bucket) / 2 (new edge)
+    unique_crashes: np.ndarray  # bool[B] first-seen crash shape
+    unique_hangs: np.ndarray    # bool[B] first-seen hang shape
+    exit_codes: np.ndarray    # int32[B]
+
+
+class Instrumentation:
+    name = "base"
+    OPTION_SCHEMA: Dict[str, type] = {}
+    OPTION_DESCS: Dict[str, str] = {}
+    DEFAULTS: Dict[str, Any] = {}
+    supports_batch = False
+
+    def __init__(self, options: Optional[str] = None):
+        self.options = parse_options(options, self.OPTION_SCHEMA,
+                                     self.DEFAULTS)
+        self.last_status = FUZZ_NONE
+        self.last_new_path = 0
+
+    # -- single-exec API ------------------------------------------------
+
+    def enable(self, input_bytes: Optional[bytes] = None,
+               cmd_line: Optional[str] = None) -> None:
+        """Run the target on one input (blocking in this framework —
+        the reference's async enable + is_process_done poll loop
+        collapses into one call). Host-exec backends take the
+        driver-built ``cmd_line``; device backends ignore it."""
+        raise NotImplementedError
+
+    def is_process_done(self) -> bool:
+        return True
+
+    def get_fuzz_result(self) -> int:
+        return self.last_status
+
+    def is_new_path(self) -> int:
+        return self.last_new_path
+
+    def last_unique_crash(self) -> bool:
+        """Whether the last exec's crash had a first-seen coverage
+        shape (AFL virgin_crash gating). Coverage-less backends have
+        no uniqueness notion and report False."""
+        return False
+
+    def last_unique_hang(self) -> bool:
+        return False
+
+    # -- batched API ----------------------------------------------------
+
+    def run_batch(self, inputs: np.ndarray, lengths: np.ndarray
+                  ) -> BatchResult:
+        raise NotImplementedError(f"{self.name} has no batch path")
+
+    # -- coverage plumbing ---------------------------------------------
+
+    def merge(self, other_state: str) -> None:
+        """Fold another instrumentation state's coverage into this one
+        (reference merge; afl AND-fold). Raises if unsupported."""
+        raise NotImplementedError(f"{self.name} cannot merge")
+
+    def get_edges(self) -> Optional[List[Tuple[int, int]]]:
+        """Edge list of the last execution (tracer support);
+        None when the backend can't report edges."""
+        return None
+
+    def get_module_info(self) -> List[str]:
+        """Names of instrumented modules (per-module coverage)."""
+        return []
+
+    # -- state ----------------------------------------------------------
+
+    def get_state(self) -> str:
+        raise NotImplementedError
+
+    def set_state(self, state: str) -> None:
+        raise NotImplementedError
+
+    def cleanup(self) -> None:
+        pass
+
+    @classmethod
+    def help(cls) -> str:
+        head = f"{cls.name} instrumentation"
+        doc = (cls.__doc__ or "").strip().splitlines()
+        if doc:
+            head += f" — {doc[0]}"
+        return head + "\n" + format_help(cls.name, cls.OPTION_SCHEMA,
+                                         cls.OPTION_DESCS)

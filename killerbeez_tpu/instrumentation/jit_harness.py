@@ -1,0 +1,238 @@
+"""jit_harness — whole-loop-on-device instrumentation over the KBVM.
+
+The TPU-native replacement for the reference's forkserver+SHM path
+(afl_instrumentation.c): the target is a compiled KBVM program, a
+candidate batch executes under one jit, and coverage triage (classify
+-> novelty vs three virgin maps -> unique crash/hang via simplified
+traces) happens on-device in the same program — nothing crosses the
+host boundary except the few interesting lanes.
+
+AFL-map semantics (SURVEY §2.3): ``virgin_bits`` gates new paths,
+``virgin_crash``/``virgin_tmout`` gate *unique* crashes/hangs via
+``simplify_trace`` (reference afl_instrumentation.c:668-707
+finish_fuzz_round).
+
+Novelty modes:
+  * ``exact``      — lanes judged sequentially (lane i sees the virgin
+                     map after lanes < i): bit-for-bit the single-exec
+                     loop's counts; the smoke-test parity gates run in
+                     this mode.
+  * ``throughput`` — all lanes vs the incoming map + in-batch hash
+                     dedup; over-reports within a batch the same benign
+                     way the reference's persistence mode does.
+"""
+
+from __future__ import annotations
+
+import json
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_NONE, FUZZ_RUNNING, MAP_SIZE
+from ..models import targets as targets_mod
+from ..models.vm import Program, run_batch as vm_run_batch
+from ..ops.coverage import (
+    build_bitmap, classify_counts, count_non_255_bytes, has_new_bits,
+    merge_virgin, simplify_trace,
+)
+from ..ops.sparse_coverage import sparse_triage
+from ..utils.serialization import decode_array, encode_array
+from .base import BatchResult, Instrumentation
+from .factory import register_instrumentation
+
+
+def _triage_throughput(vb, vc, vh, edge_ids, valid, statuses):
+    """Sparse-path triage: O(B*T) instead of O(B*MAP_SIZE)."""
+    crash = statuses == FUZZ_CRASH
+    hang = statuses == FUZZ_HANG
+    return sparse_triage(vb, vc, vh, edge_ids, valid, crash, hang)
+
+
+def _triage_exact(vb, vc, vh, cls, simp, statuses):
+    def step(carry, x):
+        vb, vc, vh = carry
+        cls_i, simp_i, st = x
+        ret, vb_n = has_new_bits(vb, cls_i)
+        cret, vc_n = has_new_bits(vc, simp_i)
+        hret, vh_n = has_new_bits(vh, simp_i)
+        is_crash = st == FUZZ_CRASH
+        is_hang = st == FUZZ_HANG
+        vc = jnp.where(is_crash, vc_n, vc)
+        vh = jnp.where(is_hang, vh_n, vh)
+        uc = is_crash & (cret > 0)
+        uh = is_hang & (hret > 0)
+        return (vb_n, vc, vh), (ret, uc, uh)
+
+    (vb2, vc2, vh2), (new_paths, uc, uh) = jax.lax.scan(
+        step, (vb, vc, vh), (cls, simp, statuses))
+    return new_paths, uc, uh, vb2, vc2, vh2
+
+
+@partial(jax.jit, static_argnames=("mem_size", "max_steps", "exact"))
+def _fused_step(instrs, inputs, lengths, vb, vc, vh, mem_size, max_steps,
+                exact):
+    """mutated batch -> VM exec -> bitmaps -> triage, one XLA program."""
+    from ..models.vm import _run_one  # shared step machine
+    f = partial(_run_one, instrs, mem_size, max_steps)
+    res = jax.vmap(f)(inputs, lengths)
+    statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG, res.status)
+    if exact:
+        bitmap = build_bitmap(res.edge_ids, res.edge_ids >= 0)
+        cls = classify_counts(bitmap)
+        simp = simplify_trace(bitmap)
+        new_paths, uc, uh, vb2, vc2, vh2 = _triage_exact(
+            vb, vc, vh, cls, simp, statuses)
+    else:
+        new_paths, uc, uh, vb2, vc2, vh2 = _triage_throughput(
+            vb, vc, vh, res.edge_ids, res.edge_ids >= 0, statuses)
+    return (statuses, new_paths, uc, uh, res.exit_code, vb2, vc2, vh2,
+            res.edge_ids)
+
+
+@register_instrumentation
+class JitHarnessInstrumentation(Instrumentation):
+    """Executes KBVM targets fully on-device with AFL-map triage."""
+    name = "jit_harness"
+    supports_batch = True
+    OPTION_SCHEMA = {"target": str, "program_file": str, "max_steps": int,
+                     "novelty": str, "edges": int}
+    OPTION_DESCS = {
+        "target": "built-in KBVM target name (test/hang/libtest/cgc_like)",
+        "program_file": "path to a .npz compiled KBVM program",
+        "max_steps": "override the program's hang step budget",
+        "novelty": '"exact" (sequential parity, default) or "throughput"',
+        "edges": "1 = record per-exec edge lists (tracer mode)",
+    }
+    DEFAULTS = {"novelty": "exact", "edges": 0}
+
+    def __init__(self, options: Optional[str] = None):
+        super().__init__(options)
+        prog = self._load_program()
+        if "max_steps" in self.options:
+            prog = Program(instrs=prog.instrs, name=prog.name,
+                           mem_size=prog.mem_size,
+                           max_steps=int(self.options["max_steps"]),
+                           n_blocks=prog.n_blocks,
+                           block_ids=prog.block_ids)
+        self.program = prog
+        if self.options["novelty"] not in ("exact", "throughput"):
+            raise ValueError('novelty must be "exact" or "throughput"')
+        self.exact = self.options["novelty"] == "exact"
+        self._instrs = jnp.asarray(prog.instrs)
+        self.virgin_bits = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
+        self.virgin_crash = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
+        self.virgin_tmout = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
+        self.total_execs = 0
+        self._last_edges: Optional[np.ndarray] = None
+        self._last_unique_crash = False
+        self._last_unique_hang = False
+
+    def _load_program(self) -> Program:
+        if "program_file" in self.options:
+            d = np.load(self.options["program_file"], allow_pickle=False)
+            return Program(
+                instrs=d["instrs"].astype(np.int32),
+                name=str(d["name"]) if "name" in d else "file",
+                mem_size=int(d["mem_size"]), max_steps=int(d["max_steps"]),
+                n_blocks=int(d.get("n_blocks", 0)))
+        target = self.options.get("target")
+        if not target:
+            raise ValueError(
+                'jit_harness needs {"target": name} or {"program_file": path}')
+        return targets_mod.get_target(target)
+
+    # -- batched --------------------------------------------------------
+
+    def run_batch(self, inputs, lengths) -> BatchResult:
+        inputs = jnp.asarray(inputs, dtype=jnp.uint8)
+        lengths = jnp.asarray(lengths, dtype=jnp.int32)
+        (statuses, new_paths, uc, uh, exit_codes, vb, vc, vh,
+         edge_ids) = _fused_step(
+            self._instrs, inputs, lengths, self.virgin_bits,
+            self.virgin_crash, self.virgin_tmout, self.program.mem_size,
+            self.program.max_steps, self.exact)
+        self.virgin_bits, self.virgin_crash, self.virgin_tmout = vb, vc, vh
+        self.total_execs += int(inputs.shape[0])
+        if self.options.get("edges"):
+            self._last_edges = np.asarray(edge_ids)
+        return BatchResult(
+            statuses=np.asarray(statuses),
+            new_paths=np.asarray(new_paths),
+            unique_crashes=np.asarray(uc),
+            unique_hangs=np.asarray(uh),
+            exit_codes=np.asarray(exit_codes),
+        )
+
+    # -- single-exec shim ----------------------------------------------
+
+    def enable(self, input_bytes: Optional[bytes] = None,
+               cmd_line: Optional[str] = None) -> None:
+        if input_bytes is None:
+            raise ValueError("jit_harness needs input bytes")
+        L = max(((len(input_bytes) + 7) // 8) * 8, 8)
+        buf = np.zeros((1, L), dtype=np.uint8)
+        buf[0, :len(input_bytes)] = np.frombuffer(input_bytes,
+                                                  dtype=np.uint8)
+        res = self.run_batch(buf, np.array([len(input_bytes)],
+                                           dtype=np.int32))
+        self.last_status = int(res.statuses[0])
+        self.last_new_path = int(res.new_paths[0])
+        self._last_unique_crash = bool(res.unique_crashes[0])
+        self._last_unique_hang = bool(res.unique_hangs[0])
+
+    def last_unique_crash(self) -> bool:
+        return self._last_unique_crash
+
+    def last_unique_hang(self) -> bool:
+        return self._last_unique_hang
+
+    def get_edges(self) -> Optional[List[Tuple[int, int]]]:
+        """Edge list of the last exec (lane 0) as (prev, cur)-hashed
+        ids; tracer consumes these (requires {"edges": 1})."""
+        if self._last_edges is None:
+            return None
+        ids = self._last_edges[0]
+        return [(int(e), 1) for e in ids if e >= 0]
+
+    def get_module_info(self) -> List[str]:
+        return [self.program.name]
+
+    # -- state / merge --------------------------------------------------
+
+    def get_state(self) -> str:
+        return json.dumps({
+            "instrumentation": self.name,
+            "target": self.program.name,
+            "total_execs": self.total_execs,
+            "virgin_bits": encode_array(np.asarray(self.virgin_bits)),
+            "virgin_crash": encode_array(np.asarray(self.virgin_crash)),
+            "virgin_tmout": encode_array(np.asarray(self.virgin_tmout)),
+        })
+
+    def set_state(self, state: str) -> None:
+        d = json.loads(state)
+        if d.get("instrumentation") not in (None, self.name):
+            raise ValueError(
+                f"state is for {d.get('instrumentation')!r}, not "
+                f"{self.name!r}")
+        self.total_execs = int(d.get("total_execs", 0))
+        for key in ("virgin_bits", "virgin_crash", "virgin_tmout"):
+            if key in d:
+                setattr(self, key, jnp.asarray(decode_array(d[key])))
+
+    def merge(self, other_state: str) -> None:
+        d = json.loads(other_state)
+        for key in ("virgin_bits", "virgin_crash", "virgin_tmout"):
+            if key in d:
+                mine = getattr(self, key)
+                theirs = jnp.asarray(decode_array(d[key]))
+                setattr(self, key, merge_virgin(mine, theirs))
+        self.total_execs += int(d.get("total_execs", 0))
+
+    def coverage_bytes(self) -> int:
+        """Touched virgin bytes (status reporting)."""
+        return int(count_non_255_bytes(self.virgin_bits))

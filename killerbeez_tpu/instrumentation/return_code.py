@@ -1,0 +1,75 @@
+"""return_code — dumb host-exec instrumentation.
+
+Parity with the reference's return_code instrumentation
+(return_code_instrumentation.c): run the target process, verdict from
+the exit status only (signal -> crash, timeout -> hang), no coverage
+(``is_new_path`` always 0, ``merge`` unsupported). The process-control
+path is host-side by nature; the batched variant simply loops (the
+native C++ batch executor accelerates this later).
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+import signal
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from .. import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_NONE
+from .base import BatchResult, Instrumentation
+from .factory import register_instrumentation
+
+
+@register_instrumentation
+class ReturnCodeInstrumentation(Instrumentation):
+    """Exit-status-only verdicts for real host binaries."""
+    name = "return_code"
+    supports_batch = False
+    OPTION_SCHEMA = {"timeout": float}
+    OPTION_DESCS = {"timeout": "seconds before an exec counts as a hang "
+                               "(default 2.0)"}
+    DEFAULTS = {"timeout": 2.0}
+
+    def __init__(self, options: Optional[str] = None):
+        super().__init__(options)
+        self.last_exit_code = 0
+        self.total_execs = 0
+
+    def enable(self, input_bytes: Optional[bytes] = None,
+               cmd_line: Optional[str] = None) -> None:
+        if not cmd_line:
+            raise ValueError("return_code needs a command line from the "
+                             "driver")
+        try:
+            proc = subprocess.run(
+                shlex.split(cmd_line),
+                input=input_bytes,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                timeout=float(self.options["timeout"]))
+            rc = proc.returncode
+            if rc < 0:  # killed by signal -> crash (WIFSIGNALED)
+                self.last_status = FUZZ_CRASH
+            else:
+                self.last_status = FUZZ_NONE
+            self.last_exit_code = rc
+        except subprocess.TimeoutExpired:
+            self.last_status = FUZZ_HANG
+            self.last_exit_code = -int(signal.SIGKILL)
+        except OSError:
+            self.last_status = FUZZ_ERROR
+            self.last_exit_code = -1
+        self.total_execs += 1
+        self.last_new_path = 0  # dumb fuzzing: no coverage signal
+
+    # merge: the reference returns NULL state and no merge for
+    # return_code; keep get_state minimal for -isd parity
+    def get_state(self) -> str:
+        return json.dumps({"instrumentation": self.name,
+                           "total_execs": self.total_execs})
+
+    def set_state(self, state: str) -> None:
+        d = json.loads(state)
+        self.total_execs = int(d.get("total_execs", 0))

@@ -1,0 +1,18 @@
+"""Target execution models.
+
+The reference runs native target binaries under a forkserver, QEMU, or
+DynamoRIO (SURVEY §2.3/§2.5/§2.6). The TPU-native equivalent of the
+binary-translation tier is the **KBVM**: targets are compiled to a
+fixed int32 instruction tensor and executed *batched on-device* — a
+``lax.scan`` step machine under ``vmap``, with AFL-style edge coverage
+(``trace[cur ^ prev]++``, ``prev = cur >> 1``) recorded from BLOCK
+instructions the compiler inserts at basic-block heads, exactly where
+afl-as puts its trampolines (reference afl_progs/afl-as.c).
+"""
+
+from .vm import Program, VMResult, compile_runner, run_batch
+from .compiler import Assembler, assign_block_ids
+from . import targets
+
+__all__ = ["Program", "VMResult", "compile_runner", "run_batch",
+           "Assembler", "assign_block_ids", "targets"]

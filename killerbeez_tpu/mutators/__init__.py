@@ -1,0 +1,15 @@
+"""Mutator engine: vmapped byte-tensor mutators behind the reference's
+mutator vtable (SURVEY §2.4)."""
+
+from .base import (
+    MUTATE_INDEX_MASK, MUTATE_MULTIPLE_INPUTS, MUTATE_THREAD_SAFE, Mutator,
+)
+from .factory import (
+    mutator_factory, mutator_help, mutator_names, register_mutator,
+)
+
+__all__ = [
+    "Mutator", "MUTATE_THREAD_SAFE", "MUTATE_MULTIPLE_INPUTS",
+    "MUTATE_INDEX_MASK", "mutator_factory", "mutator_help",
+    "mutator_names", "register_mutator",
+]

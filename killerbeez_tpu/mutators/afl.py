@@ -1,0 +1,131 @@
+"""The `afl` mutator: AFL's full deterministic pipeline, then havoc.
+
+Stage order mirrors AFL (and the reference's afl mutator, SURVEY
+§2.4): walking bit flips (1/2/4), walking byte flips (8/16/32 bits),
+arithmetic, interesting values — then endless havoc. The absolute
+iteration index decodes to (stage, local index); a batch may span a
+stage boundary, in which case it is assembled from per-stage device
+calls (stage transitions are rare relative to stage sizes).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import mutate_core as mc
+from .base import Mutator
+
+
+class AflMutator(Mutator):
+    """AFL deterministic stages then havoc (never exhausts)."""
+    name = "afl"
+    OPTION_SCHEMA = {"skip_deterministic": int, "stack_pow2": int}
+    OPTION_DESCS = {
+        "skip_deterministic": "1 = jump straight to havoc (AFL -d)",
+        "stack_pow2": "havoc stack: max edits = 2**stack_pow2 (default 4)",
+    }
+    DEFAULTS = {"skip_deterministic": 0, "stack_pow2": 4}
+
+    def __init__(self, options, input_bytes):
+        super().__init__(options, input_bytes)
+        self._build_stages()
+        sp = int(self.options["stack_pow2"])
+        self._havoc = jax.jit(jax.vmap(
+            lambda b, ln, k: mc.havoc_at(b, ln, k, stack_pow2=sp),
+            in_axes=(None, None, 0)))
+        self._flip = {}
+        for nb in (1, 2, 4, 8, 16, 32):
+            self._flip[nb] = jax.jit(jax.vmap(
+                lambda b, ln, it, nb=nb: mc.bit_flip_at(b, ln, it,
+                                                        num_bits=nb),
+                in_axes=(None, None, 0)))
+        self._arith = jax.jit(jax.vmap(mc.arithmetic_at,
+                                       in_axes=(None, None, 0)))
+        self._interest = jax.jit(jax.vmap(mc.interesting_at,
+                                          in_axes=(None, None, 0)))
+
+    def _build_stages(self) -> None:
+        n = self.seed_len
+        bits = n * 8
+        stages: List[Tuple[str, int, int]] = []  # (kind, param, size)
+        if not self.options["skip_deterministic"]:
+            stages += [
+                ("flip", 1, mc.bit_flip_total(n, 1)),
+                ("flip", 2, mc.bit_flip_total(n, 2)),
+                ("flip", 4, mc.bit_flip_total(n, 4)),
+                # byte flips: byte-aligned windows, one per start byte
+                ("byteflip", 8, max(n, 0)),
+                ("byteflip", 16, max(n - 1, 0)),
+                ("byteflip", 32, max(n - 3, 0)),
+                ("arith", 0, mc.arithmetic_total(n)),
+                ("interest", 0, mc.interesting_total(n)),
+            ]
+        self.stages = stages
+        self.det_total = sum(s[2] for s in stages)
+        del bits
+
+    def set_input(self, input_bytes: bytes) -> None:
+        super().set_input(input_bytes)
+        self._build_stages()
+
+    def get_total_iteration_count(self) -> int:
+        return -1  # havoc tail never exhausts
+
+    def stage_name(self, it: int | None = None) -> str:
+        """Human-readable stage for an iteration (status reporting)."""
+        it = self.iteration if it is None else it
+        for kind, param, size in self.stages:
+            if it < size:
+                return f"{kind}{param or ''}"
+            it -= size
+        return "havoc"
+
+    def _run_stage(self, kind: str, param: int,
+                   local: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        sb = jnp.asarray(self.seed_buf)
+        sl = jnp.int32(self.seed_len)
+        if kind == "flip":
+            b, ln = self._flip[param](sb, sl,
+                                      jnp.asarray(local, dtype=jnp.int32))
+        elif kind == "byteflip":
+            b, ln = self._flip[param](sb, sl,
+                                      jnp.asarray(local * 8,
+                                                  dtype=jnp.int32))
+        elif kind == "arith":
+            b, ln = self._arith(sb, sl, jnp.asarray(local, dtype=jnp.int32))
+        elif kind == "interest":
+            b, ln = self._interest(sb, sl,
+                                   jnp.asarray(local, dtype=jnp.int32))
+        else:
+            raise AssertionError(kind)
+        return np.asarray(b), np.asarray(ln)
+
+    def _generate(self, its: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        out_b = np.empty((len(its), self.max_length), dtype=np.uint8)
+        out_l = np.empty(len(its), dtype=np.int32)
+        rel = np.asarray(its, dtype=np.int64)
+        offset = 0
+        remaining_mask = np.ones(len(its), dtype=bool)
+        for kind, param, size in self.stages:
+            in_stage = remaining_mask & (rel >= offset) & (rel < offset + size)
+            if in_stage.any():
+                local = (rel[in_stage] - offset).astype(np.int64)
+                b, ln = self._run_stage(kind, param, local)
+                out_b[in_stage] = b
+                out_l[in_stage] = ln
+                remaining_mask &= ~in_stage
+            offset += size
+        if remaining_mask.any():  # havoc tail
+            local = rel[remaining_mask] - offset
+            base = jax.random.key(int(self.options.get("seed", 0)))
+            keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+                jnp.asarray(local, dtype=jnp.uint32))
+            b, ln = self._havoc(jnp.asarray(self.seed_buf),
+                                jnp.int32(self.seed_len), keys)
+            out_b[remaining_mask] = np.asarray(b)
+            out_l[remaining_mask] = np.asarray(ln)
+        return out_b, out_l

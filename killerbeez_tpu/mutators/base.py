@@ -1,0 +1,183 @@
+"""Mutator base class — the reference's mutator vtable, batch-first.
+
+API parity (reference docs/api/api_mutator.tex, docs/api/files/
+mutator_t.c): create/cleanup/mutate/mutate_extended/get_state/
+set_state/get_current_iteration/get_total_iteration_count/
+get_input_info/set_input/help. ``mutate`` returns the mutated buffer
+or ``None`` when the walk is exhausted (the C API's 0 return); errors
+raise (the C API's -1).
+
+The TPU-native addition is ``mutate_batch(n)``: generate candidates
+for iterations ``[it, it+n)`` in one device call as
+``(uint8[n, L], int32[n] lengths)``. ``mutate`` is the n==1 case, so
+single-buffer semantics and batch semantics cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.options import parse_options, format_help
+from ..utils.serialization import b64, unb64
+
+# mutate_extended flags (reference api_mutator.tex:89-119)
+MUTATE_THREAD_SAFE = 1 << 30
+MUTATE_MULTIPLE_INPUTS = 1 << 31
+MUTATE_INDEX_MASK = 0x00FFFFFF
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+class Mutator:
+    """Base mutator. Subclasses set ``name``, ``OPTION_SCHEMA``,
+    ``OPTION_DESCS`` and implement ``_generate(its) -> (bufs, lens)``
+    over absolute iteration indices."""
+
+    name = "base"
+    OPTION_SCHEMA: Dict[str, type] = {}
+    OPTION_DESCS: Dict[str, str] = {}
+    DEFAULTS: Dict[str, Any] = {}
+
+    #: extra schema shared by every mutator
+    _COMMON_SCHEMA = {"ratio": float, "seed": int}
+    _COMMON_DESCS = {
+        "ratio": "output buffer size as a multiple of the seed size "
+                 "(reference setup_mutate_buffer semantics; default 2.0)",
+        "seed": "PRNG seed for randomized mutators (default 0)",
+    }
+    _COMMON_DEFAULTS = {"ratio": 2.0, "seed": 0}
+
+    def __init__(self, options: Optional[str], input_bytes: bytes):
+        schema = {**self.OPTION_SCHEMA, **self._COMMON_SCHEMA}
+        defaults = {**self._COMMON_DEFAULTS, **self.DEFAULTS}
+        self.options = parse_options(options, schema, defaults)
+        self.iteration = 0
+        self._set_seed_buffer(bytes(input_bytes))
+
+    # -- seed management ------------------------------------------------
+
+    def _set_seed_buffer(self, input_bytes: bytes) -> None:
+        if len(input_bytes) == 0:
+            raise ValueError(f"{self.name}: empty seed input")
+        self.seed_bytes = input_bytes
+        ratio = float(self.options.get("ratio", 2.0))
+        L = max(int(np.ceil(len(input_bytes) * max(ratio, 1.0))), 8)
+        self.max_length = _round_up(L, 8)  # keep maps/hashes word-aligned
+        buf = np.zeros(self.max_length, dtype=np.uint8)
+        buf[:len(input_bytes)] = np.frombuffer(input_bytes, dtype=np.uint8)
+        self.seed_buf = buf
+        self.seed_len = len(input_bytes)
+
+    def set_input(self, input_bytes: bytes) -> None:
+        """Swap the seed (reference set_input, api_mutator.tex:198-214).
+        Resets the walk position."""
+        self._set_seed_buffer(bytes(input_bytes))
+        self.iteration = 0
+
+    # -- iteration bookkeeping -----------------------------------------
+
+    def get_current_iteration(self) -> int:
+        return self.iteration
+
+    def get_total_iteration_count(self) -> int:
+        """-1 = infinite (randomized mutators never exhaust)."""
+        return -1
+
+    def remaining(self) -> int:
+        total = self.get_total_iteration_count()
+        if total < 0:
+            return 2**62
+        return max(total - self.iteration, 0)
+
+    # -- generation -----------------------------------------------------
+
+    def _generate(self, its: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Produce candidates for absolute iteration indices ``its``.
+        Returns (uint8[n, L], int32[n])."""
+        raise NotImplementedError
+
+    def mutate_batch(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Generate the next ``n`` candidates and advance the walk.
+        Raises if a finite walk has fewer than ``n`` left — callers
+        clamp with ``remaining()``."""
+        if n <= 0:
+            raise ValueError("batch size must be positive")
+        if self.remaining() < n:
+            raise ValueError(
+                f"{self.name}: only {self.remaining()} iterations left, "
+                f"requested {n}")
+        its = np.arange(self.iteration, self.iteration + n, dtype=np.int64)
+        bufs, lens = self._generate(its)
+        self.iteration += n
+        return np.asarray(bufs, dtype=np.uint8), np.asarray(
+            lens, dtype=np.int32)
+
+    def mutate(self, max_size: Optional[int] = None) -> Optional[bytes]:
+        """Single-buffer API: next candidate, or None when exhausted."""
+        if self.remaining() == 0:
+            return None
+        bufs, lens = self.mutate_batch(1)
+        out = bufs[0, :int(lens[0])].tobytes()
+        if max_size is not None:
+            out = out[:max_size]
+        return out
+
+    def mutate_extended(self, flags: int = 0,
+                        max_size: Optional[int] = None) -> Optional[bytes]:
+        """Flagged mutate (reference api_mutator.tex:89-119).
+        MUTATE_MULTIPLE_INPUTS selects a part on multipart mutators;
+        single-input mutators accept only part 0."""
+        if flags & MUTATE_MULTIPLE_INPUTS:
+            part = flags & MUTATE_INDEX_MASK
+            if part != 0:
+                raise ValueError(
+                    f"{self.name}: single-input mutator, part {part} invalid")
+        return self.mutate(max_size)
+
+    # -- multi-part contract -------------------------------------------
+
+    def get_input_info(self) -> Tuple[int, List[int]]:
+        """(num_inputs, per-input sizes) — single-input by default
+        (reference get_input_info, api_mutator.tex:179-196)."""
+        return 1, [self.max_length]
+
+    # -- state ----------------------------------------------------------
+
+    def _state_dict(self) -> Dict[str, Any]:
+        return {
+            "mutator": self.name,
+            "iteration": self.iteration,
+            "seed": b64(self.seed_bytes),
+        }
+
+    def get_state(self) -> str:
+        return json.dumps(self._state_dict())
+
+    def set_state(self, state: str) -> None:
+        d = json.loads(state)
+        if d.get("mutator") not in (None, self.name):
+            raise ValueError(
+                f"state is for mutator {d['mutator']!r}, not {self.name!r}")
+        if "seed" in d:
+            self._set_seed_buffer(unb64(d["seed"]))
+        self.iteration = int(d.get("iteration", 0))
+
+    # -- misc -----------------------------------------------------------
+
+    def cleanup(self) -> None:
+        pass
+
+    @classmethod
+    def help(cls) -> str:
+        schema = {**cls.OPTION_SCHEMA, **cls._COMMON_SCHEMA}
+        descs = {**cls.OPTION_DESCS, **cls._COMMON_DESCS}
+        head = f"{cls.name} mutator"
+        doc = (cls.__doc__ or "").strip().splitlines()
+        if doc:
+            head += f" — {doc[0]}"
+        return head + "\n" + format_help(cls.name, schema, descs)

@@ -1,0 +1,146 @@
+"""Deterministic walking mutators: nop, bit_flip, arithmetic,
+interesting_value, dictionary.
+
+Each decodes an absolute iteration index into an exact mutation
+(AFL-style walking order), so runs are reproducible and resumable from
+the serialized iteration counter alone — matching the reference's
+deterministic-iteration contract (api_mutator.tex:154-177).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import mutate_core as mc
+from .base import Mutator
+
+
+class NopMutator(Mutator):
+    """Returns the seed unchanged every iteration (plumbing tests)."""
+    name = "nop"
+
+    def _generate(self, its: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        n = len(its)
+        return (np.tile(self.seed_buf, (n, 1)),
+                np.full(n, self.seed_len, dtype=np.int32))
+
+
+class BitFlipMutator(Mutator):
+    """Walks the seed flipping num_bits consecutive bits per iteration."""
+    name = "bit_flip"
+    OPTION_SCHEMA = {"num_bits": int}
+    OPTION_DESCS = {"num_bits": "consecutive bits flipped per iteration "
+                                "(1/2/4, default 1)"}
+    DEFAULTS = {"num_bits": 1}
+
+    def __init__(self, options, input_bytes):
+        super().__init__(options, input_bytes)
+        nb = int(self.options["num_bits"])
+        if nb not in (1, 2, 4, 8, 16, 32):
+            raise ValueError(f"bit_flip: unsupported num_bits {nb}")
+        self._fn = jax.jit(jax.vmap(
+            lambda b, ln, it: mc.bit_flip_at(b, ln, it, num_bits=nb),
+            in_axes=(None, None, 0)))
+
+    def get_total_iteration_count(self) -> int:
+        return mc.bit_flip_total(self.seed_len,
+                                 int(self.options["num_bits"]))
+
+    def _generate(self, its):
+        bufs, lens = self._fn(jnp.asarray(self.seed_buf),
+                              jnp.int32(self.seed_len),
+                              jnp.asarray(its, dtype=jnp.int32))
+        return np.asarray(bufs), np.asarray(lens)
+
+
+class ArithmeticMutator(Mutator):
+    """Walks +/- deltas (1..35) over 1/2/4-byte fields, both ends."""
+    name = "arithmetic"
+
+    def __init__(self, options, input_bytes):
+        super().__init__(options, input_bytes)
+        self._fn = jax.jit(jax.vmap(mc.arithmetic_at,
+                                    in_axes=(None, None, 0)))
+
+    def get_total_iteration_count(self) -> int:
+        return mc.arithmetic_total(self.seed_len)
+
+    def _generate(self, its):
+        bufs, lens = self._fn(jnp.asarray(self.seed_buf),
+                              jnp.int32(self.seed_len),
+                              jnp.asarray(its, dtype=jnp.int32))
+        return np.asarray(bufs), np.asarray(lens)
+
+
+class InterestingValueMutator(Mutator):
+    """Walks boundary values (AFL interesting 8/16/32) over the seed."""
+    name = "interesting_value"
+
+    def __init__(self, options, input_bytes):
+        super().__init__(options, input_bytes)
+        self._fn = jax.jit(jax.vmap(mc.interesting_at,
+                                    in_axes=(None, None, 0)))
+
+    def get_total_iteration_count(self) -> int:
+        return mc.interesting_total(self.seed_len)
+
+    def _generate(self, its):
+        bufs, lens = self._fn(jnp.asarray(self.seed_buf),
+                              jnp.int32(self.seed_len),
+                              jnp.asarray(its, dtype=jnp.int32))
+        return np.asarray(bufs), np.asarray(lens)
+
+
+class DictionaryMutator(Mutator):
+    """Overwrites/inserts dictionary tokens at every position."""
+    name = "dictionary"
+    OPTION_SCHEMA = {"dictionary": str, "tokens": list}
+    OPTION_DESCS = {
+        "dictionary": "path to a token file (one token per line; "
+                      "\\xNN escapes allowed)",
+        "tokens": "inline token list (strings)",
+    }
+
+    def __init__(self, options, input_bytes):
+        super().__init__(options, input_bytes)
+        toks: List[bytes] = []
+        if "tokens" in self.options:
+            toks += [t.encode() if isinstance(t, str) else bytes(t)
+                     for t in self.options["tokens"]]
+        if "dictionary" in self.options:
+            path = self.options["dictionary"]
+            if not os.path.isfile(path):
+                raise ValueError(f"dictionary file not found: {path}")
+            with open(path, "rb") as f:
+                for line in f.read().splitlines():
+                    if line and not line.startswith(b"#"):
+                        toks.append(
+                            line.decode("latin-1").encode("latin-1")
+                            .decode("unicode_escape").encode("latin-1"))
+        if not toks:
+            raise ValueError("dictionary mutator needs tokens")
+        toks = [t[:self.max_length] for t in toks if t]
+        tl = max(len(t) for t in toks)
+        arr = np.zeros((len(toks), tl), dtype=np.uint8)
+        for i, t in enumerate(toks):
+            arr[i, :len(t)] = np.frombuffer(t, dtype=np.uint8)
+        self.tokens = arr
+        self.token_lens = np.array([len(t) for t in toks], dtype=np.int32)
+        self._fn = jax.jit(jax.vmap(
+            mc.dictionary_at, in_axes=(None, None, 0, None, None)))
+
+    def get_total_iteration_count(self) -> int:
+        return mc.dictionary_total(self.seed_len, len(self.token_lens))
+
+    def _generate(self, its):
+        bufs, lens = self._fn(jnp.asarray(self.seed_buf),
+                              jnp.int32(self.seed_len),
+                              jnp.asarray(its, dtype=jnp.int32),
+                              jnp.asarray(self.tokens),
+                              jnp.asarray(self.token_lens))
+        return np.asarray(bufs), np.asarray(lens)

@@ -1,0 +1,55 @@
+"""Mutator factory: name -> instance, plus aggregated help.
+
+Mirrors the reference's mutator_factory/mutator_factory_directory
+(fuzzer/main.c:344) — except mutators here are Python classes over
+JAX kernels, not DLLs, so the "directory of DLLs" becomes a registry
+(extensible via ``register_mutator`` for out-of-tree mutators).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+from .afl import AflMutator
+from .base import Mutator
+from .deterministic import (
+    ArithmeticMutator, BitFlipMutator, DictionaryMutator,
+    InterestingValueMutator, NopMutator,
+)
+from .multipart import ManagerMutator
+from .radamsa import RadamsaMutator
+from .randomized import (
+    HavocMutator, HonggfuzzMutator, NiMutator, SpliceMutator, ZzufMutator,
+)
+
+_REGISTRY: Dict[str, Type[Mutator]] = {}
+
+
+def register_mutator(cls: Type[Mutator]) -> Type[Mutator]:
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+for _cls in (NopMutator, BitFlipMutator, ArithmeticMutator,
+             InterestingValueMutator, DictionaryMutator, HavocMutator,
+             ZzufMutator, NiMutator, HonggfuzzMutator, SpliceMutator,
+             AflMutator, ManagerMutator, RadamsaMutator):
+    register_mutator(_cls)
+
+
+def mutator_names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def mutator_factory(name: str, options: Optional[str] = None,
+                    input_bytes: bytes = b"") -> Mutator:
+    """Create a mutator by name (reference mutator_factory_directory)."""
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown mutator {name!r}; known: {', '.join(mutator_names())}")
+    return _REGISTRY[name](options, input_bytes)
+
+
+def mutator_help() -> str:
+    """Aggregated help across all mutators (reference mutator help)."""
+    return "\n".join(_REGISTRY[n].help() for n in mutator_names())

@@ -1,0 +1,146 @@
+"""The `manager` mutator: composes child mutators over a multi-part
+input (reference tests/test-fuzzer.sh:220-228 `{"mutators":
+["bit_flip","bit_flip"]}`; api_mutator.tex:179-196 get_input_info).
+
+A multi-part seed (e.g. a sequence of network packets) is split into
+parts; child mutator i owns part i. ``mutate`` advances one child per
+call round-robin (the others replay their current part), and
+``mutate_extended(MUTATE_MULTIPLE_INPUTS | i)`` returns part i of the
+current composite candidate — exactly the contract the network
+drivers consume.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.serialization import b64, unb64, decode_mem_array
+from .base import MUTATE_INDEX_MASK, MUTATE_MULTIPLE_INPUTS, Mutator
+
+
+class ManagerMutator(Mutator):
+    """Composes child mutators, one per input part."""
+    name = "manager"
+    OPTION_SCHEMA = {"mutators": list, "mutator_options": list}
+    OPTION_DESCS = {
+        "mutators": 'child mutator names, e.g. ["bit_flip","havoc"]',
+        "mutator_options": "per-child JSON option objects (optional)",
+    }
+
+    def __init__(self, options, input_bytes):
+        # input_bytes: either an encoded mem array (JSON list of b64
+        # parts) or raw bytes treated as one part
+        from .factory import mutator_factory  # local import: cycle
+        self._factory = mutator_factory
+        super().__init__(options, input_bytes)
+        names = self.options.get("mutators")
+        if not names:
+            raise ValueError('manager mutator needs {"mutators": [...]}')
+        child_opts = self.options.get("mutator_options") or [None] * len(names)
+        if len(child_opts) != len(names):
+            raise ValueError("mutator_options length != mutators length")
+        if len(self.parts) != len(names):
+            raise ValueError(
+                f"seed has {len(self.parts)} parts but {len(names)} "
+                "child mutators were configured")
+        self.children: List[Mutator] = []
+        for name, opts, part in zip(names, child_opts, self.parts):
+            o = json.dumps(opts) if isinstance(opts, dict) else opts
+            self.children.append(self._factory(name, o, part))
+        self.current: List[bytes] = list(self.parts)
+        self._next_child = 0
+
+    # -- seed handling: parts ------------------------------------------
+
+    def _set_seed_buffer(self, input_bytes: bytes) -> None:
+        try:
+            parts = decode_mem_array(input_bytes.decode("ascii"))
+            assert isinstance(parts, list) and parts
+        except Exception:
+            parts = [input_bytes]
+        self.parts = [bytes(p) for p in parts]
+        self.seed_bytes = input_bytes
+        self.seed_len = len(input_bytes)
+        self.max_length = max(len(p) for p in self.parts)
+
+    # -- iteration ------------------------------------------------------
+
+    def get_total_iteration_count(self) -> int:
+        totals = [c.get_total_iteration_count() for c in self.children]
+        if any(t < 0 for t in totals):
+            return -1
+        return sum(totals)
+
+    def remaining(self) -> int:
+        rems = [c.remaining() for c in self.children]
+        return sum(rems)
+
+    def mutate(self, max_size: Optional[int] = None) -> Optional[bytes]:
+        """Advance one child (round-robin over non-exhausted children),
+        return the concatenated composite candidate."""
+        n = len(self.children)
+        for probe in range(n):
+            i = (self._next_child + probe) % n
+            child = self.children[i]
+            if child.remaining() > 0:
+                out = child.mutate()
+                if out is not None:
+                    self.current[i] = out
+                    self._next_child = (i + 1) % n
+                    self.iteration += 1
+                    whole = b"".join(self.current)
+                    return whole[:max_size] if max_size else whole
+        return None  # all children exhausted
+
+    def mutate_extended(self, flags: int = 0,
+                        max_size: Optional[int] = None) -> Optional[bytes]:
+        if flags & MUTATE_MULTIPLE_INPUTS:
+            part = flags & MUTATE_INDEX_MASK
+            if not (0 <= part < len(self.children)):
+                raise ValueError(f"part index {part} out of range")
+            if part == 0:
+                # advancing happens when part 0 is requested; parts > 0
+                # replay the same composite (network drivers iterate
+                # parts 0..N-1 per candidate)
+                if self.mutate() is None:
+                    return None
+            out = self.current[part]
+            return out[:max_size] if max_size else out
+        return self.mutate(max_size)
+
+    def mutate_batch(self, n: int):
+        raise NotImplementedError(
+            "manager mutator is host-composed; use per-part batching via "
+            "children instead")
+
+    def get_input_info(self) -> Tuple[int, List[int]]:
+        return len(self.children), [len(p) for p in self.current]
+
+    # -- state ----------------------------------------------------------
+
+    def _state_dict(self) -> Dict[str, Any]:
+        return {
+            "mutator": self.name,
+            "iteration": self.iteration,
+            "next_child": self._next_child,
+            "current": [b64(p) for p in self.current],
+            "children": [c.get_state() for c in self.children],
+        }
+
+    def set_state(self, state: str) -> None:
+        d = json.loads(state)
+        if d.get("mutator") not in (None, self.name):
+            raise ValueError(f"state is for {d.get('mutator')!r}")
+        self.iteration = int(d.get("iteration", 0))
+        self._next_child = int(d.get("next_child", 0))
+        if "current" in d:
+            self.current = [unb64(p) for p in d["current"]]
+        for child, cs in zip(self.children, d.get("children", [])):
+            child.set_state(cs)
+
+    def cleanup(self) -> None:
+        for c in self.children:
+            c.cleanup()

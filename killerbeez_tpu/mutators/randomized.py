@@ -1,0 +1,189 @@
+"""Randomized mutators: havoc, zzuf, ni, honggfuzz, splice.
+
+Each lane's PRNG key is derived from (base seed, absolute iteration
+index) with ``jax.random.fold_in``, so candidate i is the same bytes
+whether generated alone or inside any batch — per-lane mutator state
+is carried as arrays, never Python state (SPMD-safe, SURVEY §7 hard
+part 4).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import mutate_core as mc
+from .base import Mutator
+
+
+class _KeyedMutator(Mutator):
+    """Shared plumbing: iteration index -> per-lane key."""
+
+    def _keys(self, its: np.ndarray) -> jax.Array:
+        base = jax.random.key(int(self.options.get("seed", 0)))
+        return jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.asarray(its, dtype=jnp.uint32))
+
+
+class HavocMutator(_KeyedMutator):
+    """AFL havoc: stacked random edits (flip/arith/interesting/blocks)."""
+    name = "havoc"
+    OPTION_SCHEMA = {"stack_pow2": int}
+    OPTION_DESCS = {"stack_pow2": "max stacked edits = 2**stack_pow2 "
+                                  "(default 4; AFL uses 7)"}
+    DEFAULTS = {"stack_pow2": 4}
+
+    def __init__(self, options, input_bytes):
+        super().__init__(options, input_bytes)
+        sp = int(self.options["stack_pow2"])
+        if not (1 <= sp <= 7):
+            raise ValueError("stack_pow2 must be in 1..7")
+        self._fn = jax.jit(jax.vmap(
+            lambda b, ln, k: mc.havoc_at(b, ln, k, stack_pow2=sp),
+            in_axes=(None, None, 0)))
+
+    def _generate(self, its):
+        bufs, lens = self._fn(jnp.asarray(self.seed_buf),
+                              jnp.int32(self.seed_len), self._keys(its))
+        return np.asarray(bufs), np.asarray(lens)
+
+
+class ZzufMutator(_KeyedMutator):
+    """zzuf-style: flips each bit with probability ``ratio_bits``."""
+    name = "zzuf"
+    OPTION_SCHEMA = {"ratio_bits": float}
+    OPTION_DESCS = {"ratio_bits": "per-bit flip probability "
+                                  "(default 0.004, zzuf's default)"}
+    DEFAULTS = {"ratio_bits": 0.004}
+
+    def __init__(self, options, input_bytes):
+        super().__init__(options, input_bytes)
+        r = float(self.options["ratio_bits"])
+        if not (0.0 < r <= 1.0):
+            raise ValueError("ratio_bits must be in (0, 1]")
+        self._fn = jax.jit(jax.vmap(
+            lambda b, ln, k: mc.zzuf_at(b, ln, k, ratio=r),
+            in_axes=(None, None, 0)))
+
+    def _generate(self, its):
+        bufs, lens = self._fn(jnp.asarray(self.seed_buf),
+                              jnp.int32(self.seed_len), self._keys(its))
+        return np.asarray(bufs), np.asarray(lens)
+
+
+class NiMutator(_KeyedMutator):
+    """ni-style structure-blind chunk shuffler: swaps/duplicates
+    aligned chunks of the seed plus light byte noise."""
+    name = "ni"
+    OPTION_SCHEMA = {"chunk_size": int}
+    OPTION_DESCS = {"chunk_size": "chunk granularity in bytes (default 4)"}
+    DEFAULTS = {"chunk_size": 4}
+
+    def __init__(self, options, input_bytes):
+        super().__init__(options, input_bytes)
+        cs = int(self.options["chunk_size"])
+        if cs < 1:
+            raise ValueError("chunk_size must be >= 1")
+
+        def _ni(buf, length, key):
+            L = buf.shape[-1]
+            ks = jax.random.split(key, 5)
+            n_chunks = jnp.maximum(length // cs, 1)
+            a = jax.random.randint(ks[0], (), 0, n_chunks) * cs
+            b = jax.random.randint(ks[1], (), 0, n_chunks) * cs
+            idx = jnp.arange(L, dtype=jnp.int32)
+            in_a = (idx >= a) & (idx < a + cs)
+            in_b = (idx >= b) & (idx < b + cs)
+            from_b = buf[jnp.clip(b + (idx - a), 0, L - 1)]
+            from_a = buf[jnp.clip(a + (idx - b), 0, L - 1)]
+            swapped = jnp.where(in_a, from_b, jnp.where(in_b, from_a, buf))
+            # light noise: one random byte xor
+            pos = jax.random.randint(ks[2], (), 0, jnp.maximum(length, 1))
+            val = jax.random.randint(ks[3], (), 1, 256).astype(jnp.uint8)
+            noisy = swapped.at[pos].set(swapped[pos] ^ val)
+            use_noise = jax.random.bernoulli(ks[4], 0.5)
+            return jnp.where(use_noise, noisy, swapped), length
+
+        self._fn = jax.jit(jax.vmap(_ni, in_axes=(None, None, 0)))
+
+    def _generate(self, its):
+        bufs, lens = self._fn(jnp.asarray(self.seed_buf),
+                              jnp.int32(self.seed_len), self._keys(its))
+        return np.asarray(bufs), np.asarray(lens)
+
+
+class HonggfuzzMutator(_KeyedMutator):
+    """honggfuzz-style mangle: run-oriented byte-set/copy/magic/inc/dec."""
+    name = "honggfuzz"
+    OPTION_SCHEMA = {"max_ops": int}
+    OPTION_DESCS = {"max_ops": "max stacked mangle ops (default 8)"}
+    DEFAULTS = {"max_ops": 8}
+
+    def __init__(self, options, input_bytes):
+        super().__init__(options, input_bytes)
+        mo = int(self.options["max_ops"])
+        if not (1 <= mo <= 64):
+            raise ValueError("max_ops must be in 1..64")
+        self._fn = jax.jit(jax.vmap(
+            lambda b, ln, k: mc.mangle_at(b, ln, k, max_ops=mo),
+            in_axes=(None, None, 0)))
+
+    def _generate(self, its):
+        bufs, lens = self._fn(jnp.asarray(self.seed_buf),
+                              jnp.int32(self.seed_len), self._keys(its))
+        return np.asarray(bufs), np.asarray(lens)
+
+
+class SpliceMutator(_KeyedMutator):
+    """Splices the seed with corpus files at random cut points."""
+    name = "splice"
+    OPTION_SCHEMA = {"corpus": list, "corpus_dir": str}
+    OPTION_DESCS = {
+        "corpus": "inline list of base64 or plain-string second inputs",
+        "corpus_dir": "directory of files to splice with",
+    }
+
+    def __init__(self, options, input_bytes):
+        super().__init__(options, input_bytes)
+        import os
+        partners = []
+        for item in self.options.get("corpus", []):
+            partners.append(item.encode() if isinstance(item, str)
+                            else bytes(item))
+        if "corpus_dir" in self.options:
+            d = self.options["corpus_dir"]
+            for fn in sorted(os.listdir(d)):
+                p = os.path.join(d, fn)
+                if os.path.isfile(p):
+                    with open(p, "rb") as f:
+                        partners.append(f.read())
+        partners = [p for p in partners if p]
+        if not partners:
+            raise ValueError("splice mutator needs corpus/corpus_dir")
+        L = self.max_length
+        arr = np.zeros((len(partners), L), dtype=np.uint8)
+        lens = np.zeros(len(partners), dtype=np.int32)
+        for i, p in enumerate(partners):
+            p = p[:L]
+            arr[i, :len(p)] = np.frombuffer(p, dtype=np.uint8)
+            lens[i] = len(p)
+        self.partners, self.partner_lens = arr, lens
+
+        def _splice(buf, length, pbufs, plens, key):
+            k0, k1 = jax.random.split(key)
+            j = jax.random.randint(k0, (), 0, pbufs.shape[0])
+            return mc.splice_at(buf, length, pbufs[j], plens[j], k1)
+
+        self._fn = jax.jit(jax.vmap(
+            _splice, in_axes=(None, None, None, None, 0)))
+
+    def _generate(self, its):
+        bufs, lens = self._fn(jnp.asarray(self.seed_buf),
+                              jnp.int32(self.seed_len),
+                              jnp.asarray(self.partners),
+                              jnp.asarray(self.partner_lens),
+                              self._keys(its))
+        return np.asarray(bufs), np.asarray(lens)

@@ -1,0 +1,545 @@
+"""Pure, jittable mutation kernels over fixed-size byte tensors.
+
+The reference's mutators are scalar C DLLs mutating one buffer at a
+time (API: docs/api/api_mutator.tex, SURVEY §2.4). On TPU a candidate
+batch is generated in one shot: every kernel here is a pure function
+of ``(buf uint8[L], length int32, iteration or PRNG key)`` returning
+``(buf uint8[L], length int32)``, designed to be ``vmap``-ed over the
+iteration/key axis. Deterministic mutators keep AFL's walking-order
+semantics (iteration index decodes to the exact mutation), so parity
+tests against the scalar contract hold lane-for-lane.
+
+Buffers are padded to a static L; ``length`` tracks the live prefix.
+Length-changing ops (havoc delete/insert) move bytes with gathers and
+clamp to L.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ARITH_MAX = 35  # AFL's bound for +/- arithmetic walks
+
+INTERESTING_8 = np.array(
+    [-128, -1, 0, 1, 16, 32, 64, 100, 127], dtype=np.int32)
+INTERESTING_16 = np.array(
+    [-32768, -129, 128, 255, 256, 512, 1000, 1024, 4096, 32767],
+    dtype=np.int32)
+INTERESTING_32 = np.array(
+    [-2147483648, -100663046, -32769, 32768, 65535, 65536, 100663045,
+     2147483647], dtype=np.int64)
+
+
+# --------------------------------------------------------------------
+# primitive byte/bit edits (mask-select based; no dynamic slicing)
+# --------------------------------------------------------------------
+
+def flip_bits(buf: jax.Array, start_bit: jax.Array,
+              num_bits: int) -> jax.Array:
+    """Flip ``num_bits`` consecutive bits starting at ``start_bit``,
+    MSB-first within each byte (AFL's FLIP_BIT: 128 >> (b & 7))."""
+    L = buf.shape[-1]
+    idx = jnp.arange(L, dtype=jnp.int32)
+    mask = jnp.zeros((L,), dtype=jnp.uint8)
+    for j in range(num_bits):  # num_bits is static and small (1/2/4)
+        b = start_bit + j
+        byte_i = b >> 3
+        bit = jnp.uint8(128) >> (b & 7).astype(jnp.uint8)
+        mask = mask | jnp.where(idx == byte_i, bit, jnp.uint8(0))
+    return buf ^ mask
+
+
+def write_bytes(buf: jax.Array, pos: jax.Array, value: jax.Array,
+                width: int, big_endian: jax.Array | bool = False
+                ) -> jax.Array:
+    """Overwrite ``width`` bytes at ``pos`` with integer ``value``
+    (uint32), little- or big-endian."""
+    L = buf.shape[-1]
+    idx = jnp.arange(L, dtype=jnp.int32)
+    value = value.astype(jnp.uint32) if hasattr(value, "astype") \
+        else jnp.uint32(value)
+    off = idx - pos  # byte k of the value lands at pos+k
+    k = jnp.where(jnp.asarray(big_endian), width - 1 - off, off)
+    vbytes = ((value >> (8 * jnp.clip(k, 0, width - 1))) & 0xFF
+              ).astype(jnp.uint8)
+    in_range = (off >= 0) & (off < width)
+    return jnp.where(in_range, vbytes, buf)
+
+
+def read_bytes(buf: jax.Array, pos: jax.Array, width: int,
+               big_endian: jax.Array | bool = False) -> jax.Array:
+    """Read ``width`` bytes at ``pos`` as uint32."""
+    L = buf.shape[-1]
+    picked = [buf[jnp.clip(pos + k, 0, L - 1)].astype(jnp.uint32)
+              for k in range(width)]
+    le = sum(picked[k] << (8 * k) for k in range(width))
+    be = sum(picked[k] << (8 * (width - 1 - k)) for k in range(width))
+    return jnp.where(jnp.asarray(big_endian), be, le).astype(jnp.uint32)
+
+
+def delete_block(buf: jax.Array, length: jax.Array, pos: jax.Array,
+                 del_len: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Remove ``del_len`` bytes at ``pos`` (shift-left gather)."""
+    L = buf.shape[-1]
+    idx = jnp.arange(L, dtype=jnp.int32)
+    src = jnp.where(idx >= pos, idx + del_len, idx)
+    out = buf[jnp.clip(src, 0, L - 1)]
+    new_len = jnp.maximum(length - del_len, 1)
+    return out, new_len
+
+
+def insert_block(buf: jax.Array, length: jax.Array, pos: jax.Array,
+                 ins_len: jax.Array, src_pos: jax.Array,
+                 fill: jax.Array, use_fill: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Insert ``ins_len`` bytes at ``pos``: either a copy from
+    ``src_pos`` (clone) or a constant ``fill`` byte. Result clamped
+    to the static buffer size."""
+    L = buf.shape[-1]
+    idx = jnp.arange(L, dtype=jnp.int32)
+    after = idx >= pos + ins_len
+    inside = (idx >= pos) & ~after
+    shifted = buf[jnp.clip(idx - ins_len, 0, L - 1)]
+    cloned = buf[jnp.clip(src_pos + (idx - pos), 0, L - 1)]
+    ins = jnp.where(use_fill, fill.astype(jnp.uint8), cloned)
+    out = jnp.where(after, shifted, jnp.where(inside, ins, buf))
+    new_len = jnp.minimum(length + ins_len, L)
+    return out, new_len
+
+
+def overwrite_block(buf: jax.Array, pos: jax.Array, blk_len: jax.Array,
+                    src_pos: jax.Array, fill: jax.Array,
+                    use_fill: jax.Array) -> jax.Array:
+    L = buf.shape[-1]
+    idx = jnp.arange(L, dtype=jnp.int32)
+    inside = (idx >= pos) & (idx < pos + blk_len)
+    cloned = buf[jnp.clip(src_pos + (idx - pos), 0, L - 1)]
+    src = jnp.where(use_fill, fill.astype(jnp.uint8), cloned)
+    return jnp.where(inside, src, buf)
+
+
+# --------------------------------------------------------------------
+# deterministic walking mutators (iteration index -> exact mutation)
+# --------------------------------------------------------------------
+
+def bit_flip_total(length_bytes: int, num_bits: int) -> int:
+    """Number of iterations for a bit_flip walk (AFL: flip windows of
+    num_bits consecutive bits, one start position per bit)."""
+    total_bits = length_bytes * 8
+    return max(total_bits - (num_bits - 1), 0)
+
+
+@partial(jax.jit, static_argnames=("num_bits",))
+def bit_flip_at(buf: jax.Array, length: jax.Array, it: jax.Array,
+                num_bits: int = 1) -> Tuple[jax.Array, jax.Array]:
+    """Iteration ``it`` of the bit_flip walk: flip bits
+    [it, it+num_bits)."""
+    return flip_bits(buf, it.astype(jnp.int32), num_bits), length
+
+
+def arithmetic_total(length_bytes: int) -> int:
+    """Iterations in the arithmetic walk: widths 1/2/4 bytes x
+    positions x ARITH_MAX deltas x {+,-} x {LE, BE for w>1}."""
+    n = 0
+    for w, ends in ((1, 1), (2, 2), (4, 2)):
+        pos = max(length_bytes - w + 1, 0)
+        n += pos * ARITH_MAX * 2 * ends
+    return n
+
+
+def _arith_decode(it, length):
+    """Decode iteration index -> (width_sel, pos, delta, sign, be).
+
+    Stage layout per width w: pos-major, then delta (1..35), then sign,
+    then endianness. Uses the static padded length for stage sizes is
+    wrong — sizes depend on live length, so this returns stage-relative
+    values computed with jnp ops from the dynamic ``length``.
+    """
+    it = it.astype(jnp.int32)
+    sizes = []
+    for w, ends in ((1, 1), (2, 2), (4, 2)):
+        pos_n = jnp.maximum(length - w + 1, 0)
+        sizes.append(pos_n * ARITH_MAX * 2 * ends)
+    s1, s2, s4 = sizes
+    in1 = it < s1
+    in2 = (~in1) & (it < s1 + s2)
+    local = jnp.where(in1, it, jnp.where(in2, it - s1, it - s1 - s2))
+    width_sel = jnp.where(in1, 0, jnp.where(in2, 1, 2))  # 0:1B 1:2B 2:4B
+
+    def split(local, ends):
+        # local = ((pos * ARITH_MAX + (delta-1)) * 2 + sign) * ends + be
+        be = local % ends
+        rest = local // ends
+        sign = rest % 2
+        rest = rest // 2
+        delta = rest % ARITH_MAX + 1
+        pos = rest // ARITH_MAX
+        return pos, delta, sign, be
+
+    p1, d1, g1, b1 = split(local, 1)
+    p2, d2, g2, b2 = split(local, 2)
+    p4, d4, g4, b4 = split(local, 2)
+    pos = jnp.where(in1, p1, jnp.where(in2, p2, p4))
+    delta = jnp.where(in1, d1, jnp.where(in2, d2, d4))
+    sign = jnp.where(in1, g1, jnp.where(in2, g2, g4))
+    be = jnp.where(in1, b1, jnp.where(in2, b2, b4))
+    return width_sel, pos, delta, sign, be
+
+
+@jax.jit
+def arithmetic_at(buf: jax.Array, length: jax.Array, it: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Iteration ``it`` of the arithmetic walk: add/sub delta at a
+    position for width 1/2/4, both endiannesses for wide ops."""
+    width_sel, pos, delta, sign, be = _arith_decode(it, length)
+    sdelta = jnp.where(sign == 0, delta, -delta).astype(jnp.uint32)
+
+    outs = []
+    for wi, w in enumerate((1, 2, 4)):
+        cur = read_bytes(buf, pos, w, be.astype(bool))
+        newv = (cur + sdelta) & jnp.uint32((1 << (8 * w)) - 1)
+        outs.append(write_bytes(buf, pos, newv, w, be.astype(bool)))
+    out = jnp.where(width_sel == 0, outs[0],
+                    jnp.where(width_sel == 1, outs[1], outs[2]))
+    return out, length
+
+
+def interesting_total(length_bytes: int) -> int:
+    n = max(length_bytes, 0) * len(INTERESTING_8)
+    n += max(length_bytes - 1, 0) * len(INTERESTING_16) * 2
+    n += max(length_bytes - 3, 0) * len(INTERESTING_32) * 2
+    return n
+
+
+def _interesting_decode(it, length):
+    it = it.astype(jnp.int32)
+    n8 = len(INTERESTING_8)
+    n16 = len(INTERESTING_16)
+    n32 = len(INTERESTING_32)
+    s8 = jnp.maximum(length, 0) * n8
+    s16 = jnp.maximum(length - 1, 0) * n16 * 2
+    in8 = it < s8
+    in16 = (~in8) & (it < s8 + s16)
+    local = jnp.where(in8, it, jnp.where(in16, it - s8, it - s8 - s16))
+    width_sel = jnp.where(in8, 0, jnp.where(in16, 1, 2))
+
+    def split(local, nvals, ends):
+        be = local % ends
+        rest = local // ends
+        val_i = rest % nvals
+        pos = rest // nvals
+        return pos, val_i, be
+
+    p8, v8, _ = split(local, n8, 1)
+    p16, v16, b16 = split(local, n16, 2)
+    p32, v32, b32 = split(local, n32, 2)
+    pos = jnp.where(in8, p8, jnp.where(in16, p16, p32))
+    val_i = jnp.where(in8, v8, jnp.where(in16, v16, v32))
+    be = jnp.where(in8, 0, jnp.where(in16, b16, b32))
+    return width_sel, pos, val_i, be
+
+
+@jax.jit
+def interesting_at(buf: jax.Array, length: jax.Array, it: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Iteration ``it`` of the interesting-value walk."""
+    width_sel, pos, val_i, be = _interesting_decode(it, length)
+    i8 = jnp.asarray(INTERESTING_8.astype(np.uint32))
+    i16 = jnp.asarray(INTERESTING_16.astype(np.uint32))
+    i32 = jnp.asarray((INTERESTING_32 & 0xFFFFFFFF).astype(np.uint32))
+    out8 = write_bytes(buf, pos, i8[jnp.clip(val_i, 0, len(INTERESTING_8) - 1)]
+                       & 0xFF, 1)
+    out16 = write_bytes(buf, pos,
+                        i16[jnp.clip(val_i, 0, len(INTERESTING_16) - 1)]
+                        & 0xFFFF, 2, be.astype(bool))
+    out32 = write_bytes(buf, pos,
+                        i32[jnp.clip(val_i, 0, len(INTERESTING_32) - 1)],
+                        4, be.astype(bool))
+    out = jnp.where(width_sel == 0, out8,
+                    jnp.where(width_sel == 1, out16, out32))
+    return out, length
+
+
+# --------------------------------------------------------------------
+# randomized mutators (PRNG-key driven)
+# --------------------------------------------------------------------
+
+N_HAVOC_OPS = 15
+
+
+def _havoc_one(buf, length, key):
+    """One stacked havoc edit, chosen uniformly from the op table.
+
+    Branch-free: under vmap a 15-way ``lax.switch`` lowers to
+    computing every branch for every lane (~120 vector ops/step).
+    Instead every op is expressed in one unified form —
+
+        out[i] = set_mask[i] ? set_val[i]
+                             : buf[src_idx[i]] ^ xor_mask[i]
+
+    and the per-op differences collapse into scalar parameter selects
+    (~30 vector ops/step, ~3x faster havoc end-to-end).
+
+    Op table (AFL havoc mix): 0 bit flip, 1-3 interesting 8/16/32,
+    4-9 arith +/- on 8/16/32, 10 xor byte, 11-12 delete block (double
+    odds, like AFL), 13 insert clone/fill block, 14 overwrite
+    clone/fill block.
+    """
+    L = buf.shape[-1]
+    ks = jax.random.split(key, 8)
+    op = jax.random.randint(ks[0], (), 0, N_HAVOC_OPS)
+    pos = jax.random.randint(ks[1], (), 0, jnp.maximum(length, 1))
+    pos2 = jax.random.randint(ks[2], (), 0, jnp.maximum(length, 1))
+    rbyte = jax.random.randint(ks[3], (), 0, 256).astype(jnp.uint32)
+    rint = jax.random.randint(ks[4], (), 0, 2**31 - 1).astype(jnp.uint32)
+    be = jax.random.bernoulli(ks[5])
+    blk = jax.random.randint(ks[6], (), 1,
+                             jnp.maximum(length // 2, 2)).astype(jnp.int32)
+    bit = jax.random.randint(ks[7], (), 0, jnp.maximum(length * 8, 1))
+    delta = (rint % ARITH_MAX + 1).astype(jnp.uint32)
+    use_fill = (rint % 4) == 0  # insert/overwrite: 25% fill, 75% clone
+
+    is_flip = op == 0
+    is_int = (op >= 1) & (op <= 3)
+    is_arith = (op >= 4) & (op <= 9)
+    is_xor = op == 10
+    is_del = (op == 11) | (op == 12)
+    is_ins = op == 13
+    is_ovw = op == 14
+    is_write = is_int | is_arith  # value write through set-mask
+
+    # --- scalar parameters ---
+    width = jnp.select(
+        [is_int, is_arith],
+        [jnp.select([op == 1, op == 2], [1, 2], 4),
+         jnp.select([op <= 5, op <= 7], [1, 2], 4)], 1)
+    i8 = jnp.asarray(INTERESTING_8.astype(np.uint32))
+    i16 = jnp.asarray(INTERESTING_16.astype(np.uint32))
+    i32 = jnp.asarray((INTERESTING_32 & 0xFFFFFFFF).astype(np.uint32))
+    int_val = jnp.select(
+        [op == 1, op == 2],
+        [i8[rint % len(INTERESTING_8)] & 0xFF,
+         i16[rint % len(INTERESTING_16)] & 0xFFFF],
+        i32[rint % len(INTERESTING_32)])
+    cur = read_bytes(buf, pos, 4, False)  # LE dword at pos
+    cur_w = jnp.select(
+        [width == 1, width == 2],
+        [cur & 0xFF,
+         jnp.where(be, ((cur & 0xFF) << 8) | ((cur >> 8) & 0xFF),
+                   cur & 0xFFFF)],
+        jnp.where(be,
+                  ((cur & 0xFF) << 24) | ((cur & 0xFF00) << 8)
+                  | ((cur >> 8) & 0xFF00) | ((cur >> 24) & 0xFF),
+                  cur))
+    sign_add = (op == 5) | (op == 7) | (op == 9)
+    d = jnp.where(sign_add, delta, jnp.uint32(0) - delta)
+    arith_val = (cur_w + d) & jnp.uint32(0xFFFFFFFF)
+    wmask = jnp.select([width == 1, width == 2],
+                       [jnp.uint32(0xFF), jnp.uint32(0xFFFF)],
+                       jnp.uint32(0xFFFFFFFF))
+    write_val = jnp.where(is_arith, arith_val, int_val) & wmask
+
+    # --- vector masks ---
+    idx = jnp.arange(L, dtype=jnp.int32)
+
+    # source index remap (delete shifts left; insert shifts right and
+    # clones; overwrite clones in place)
+    src_del = jnp.where(idx >= pos, idx + blk, idx)
+    in_ins = (idx >= pos) & (idx < pos + blk)
+    src_ins = jnp.where(idx >= pos + blk, idx - blk,
+                        jnp.where(in_ins, pos2 + (idx - pos), idx))
+    src_ovw = jnp.where(in_ins & ~use_fill, pos2 + (idx - pos), idx)
+    src = jnp.where(is_del, src_del,
+                    jnp.where(is_ins, src_ins,
+                              jnp.where(is_ovw, src_ovw, idx)))
+    gathered = buf[jnp.clip(src, 0, L - 1)]
+
+    # xor mask (bit flip / xor byte)
+    xval = jnp.where(is_flip, jnp.uint32(128) >> (bit & 7).astype(
+        jnp.uint32), jnp.maximum(rbyte, 1))
+    xbyte = jnp.where(is_flip, bit >> 3, pos)
+    xor_mask = jnp.where((idx == xbyte) & (is_flip | is_xor),
+                         xval.astype(jnp.uint8), jnp.uint8(0))
+
+    # set mask/val: width-w value write at pos, or block fill
+    off = idx - pos
+    k = jnp.where(be, width - 1 - off, off)
+    vbytes = ((write_val >> (8 * jnp.clip(k, 0, 3))) & 0xFF).astype(
+        jnp.uint8)
+    in_write = is_write & (off >= 0) & (off < width)
+    in_fill = (is_ins | is_ovw) & use_fill & in_ins
+    set_mask = in_write | in_fill
+    set_val = jnp.where(in_write, vbytes, rbyte.astype(jnp.uint8))
+
+    out = jnp.where(set_mask, set_val, gathered ^ xor_mask)
+    new_len = jnp.select(
+        [is_del, is_ins],
+        [jnp.maximum(length - blk, 1), jnp.minimum(length + blk, L)],
+        length)
+    return out, new_len
+
+
+@partial(jax.jit, static_argnames=("stack_pow2",))
+def havoc_at(buf: jax.Array, length: jax.Array, key: jax.Array,
+             stack_pow2: int = 4) -> Tuple[jax.Array, jax.Array]:
+    """AFL-style havoc: 2..2**stack_pow2 stacked random edits.
+
+    The reference's havoc stacks up to 128 edits (HAVOC_STACK_POW2=7);
+    the default here is 16 because under vmap every switch branch is
+    computed for every lane — raise ``stack_pow2`` via mutator options
+    to trade throughput for per-candidate aggression.
+    """
+    k0, k1 = jax.random.split(key)
+    n_steps = 1 << stack_pow2
+    stack = jnp.uint32(1) << (1 + jax.random.randint(
+        k0, (), 0, stack_pow2)).astype(jnp.uint32)
+
+    def step(carry, i):
+        b, ln = carry
+        kk = jax.random.fold_in(k1, i)
+        nb, nln = _havoc_one(b, ln, kk)
+        active = i < stack
+        b = jnp.where(active, nb, b)
+        ln = jnp.where(active, nln, ln)
+        return (b, ln), None
+
+    (out, out_len), _ = jax.lax.scan(
+        step, (buf, length), jnp.arange(n_steps, dtype=jnp.uint32))
+    return out, out_len
+
+
+@jax.jit
+def zzuf_at(buf: jax.Array, length: jax.Array, key: jax.Array,
+            ratio: jax.Array | float = 0.004) -> Tuple[jax.Array, jax.Array]:
+    """zzuf-style fuzzing: flip each bit independently with
+    probability ``ratio`` (zzuf's default 0.004)."""
+    L = buf.shape[-1]
+    bits = jax.random.bernoulli(key, ratio, (L, 8))
+    mask = jnp.packbits(bits, axis=-1, bitorder="big").reshape(L)
+    idx = jnp.arange(L, dtype=jnp.int32)
+    mask = jnp.where(idx < length, mask, jnp.uint8(0))
+    return buf ^ mask, length
+
+
+@jax.jit
+def splice_at(buf_a: jax.Array, len_a: jax.Array, buf_b: jax.Array,
+              len_b: jax.Array, key: jax.Array
+              ) -> Tuple[jax.Array, jax.Array]:
+    """Splice: head of A up to a random split point, then tail of B
+    from its own split point (AFL splice stage semantics)."""
+    L = buf_a.shape[-1]
+    k0, k1 = jax.random.split(key)
+    cut_a = jax.random.randint(k0, (), 1, jnp.maximum(len_a, 2))
+    cut_b = jax.random.randint(k1, (), 1, jnp.maximum(len_b, 2))
+    idx = jnp.arange(L, dtype=jnp.int32)
+    from_b = buf_b[jnp.clip(cut_b + (idx - cut_a), 0, L - 1)]
+    out = jnp.where(idx < cut_a, buf_a, from_b)
+    new_len = jnp.clip(cut_a + (len_b - cut_b), 1, L)
+    out = jnp.where(idx < new_len, out, jnp.uint8(0))
+    return out, new_len
+
+
+def dictionary_total(length_bytes: int, n_tokens: int) -> int:
+    # per token: overwrite at each position + insert at each position+1
+    return n_tokens * (2 * max(length_bytes, 1))
+
+
+@jax.jit
+def dictionary_at(buf: jax.Array, length: jax.Array, it: jax.Array,
+                  tokens: jax.Array, token_lens: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Iteration ``it`` of the dictionary walk: token t overwritten at
+    position p (first half) or inserted at p (second half).
+
+    ``tokens`` is uint8[N, TL] padded; ``token_lens`` int32[N].
+    """
+    n_tokens = tokens.shape[0]
+    per_tok = 2 * jnp.maximum(length, 1)
+    tok_i = (it // per_tok) % n_tokens
+    local = it % per_tok
+    insert = local >= jnp.maximum(length, 1)
+    pos = jnp.where(insert, local - jnp.maximum(length, 1), local)
+    tok = tokens[tok_i]
+    tlen = token_lens[tok_i]
+    L = buf.shape[-1]
+    idx = jnp.arange(L, dtype=jnp.int32)
+    inside = (idx >= pos) & (idx < pos + tlen)
+    tbytes = tok[jnp.clip(idx - pos, 0, tokens.shape[1] - 1)]
+    ow = jnp.where(inside, tbytes, buf)
+    ow_len = jnp.maximum(length, jnp.minimum(pos + tlen, L))
+    ins, ins_len = insert_block(buf, length, pos, tlen, 0, jnp.uint8(0),
+                                False)
+    ins = jnp.where(inside, tbytes, ins)
+    out = jnp.where(insert, ins, ow)
+    out_len = jnp.where(insert, ins_len, ow_len)
+    return out, out_len
+
+
+# --------------------------------------------------------------------
+# honggfuzz-style mangle (distinct op mix from havoc)
+# --------------------------------------------------------------------
+
+def _mangle_one(buf, length, key):
+    """One honggfuzz-style mangle op: byte-run set/copy, magic values,
+    inc/dec runs, ASCII digit corruption."""
+    L = buf.shape[-1]
+    ks = jax.random.split(key, 6)
+    op = jax.random.randint(ks[0], (), 0, 6)
+    pos = jax.random.randint(ks[1], (), 0, jnp.maximum(length, 1))
+    pos2 = jax.random.randint(ks[2], (), 0, jnp.maximum(length, 1))
+    run = jax.random.randint(ks[3], (), 1, jnp.maximum(length // 4, 2))
+    rbyte = jax.random.randint(ks[4], (), 0, 256).astype(jnp.uint8)
+    idx = jnp.arange(L, dtype=jnp.int32)
+    inside = (idx >= pos) & (idx < pos + run)
+
+    def f_byteset(b, ln):
+        return jnp.where(inside, rbyte, b), ln
+
+    def f_memcpy(b, ln):
+        src = b[jnp.clip(pos2 + (idx - pos), 0, L - 1)]
+        return jnp.where(inside, src, b), ln
+
+    def f_magic(b, ln):
+        magics = jnp.asarray(np.array(
+            [0x00, 0x01, 0x7F, 0x80, 0xFF, 0x41, 0x25, 0x2F],
+            dtype=np.uint8))
+        m = magics[jax.random.randint(ks[5], (), 0, 8)]
+        return jnp.where(inside, m, b), ln
+
+    def f_inc(b, ln):
+        return jnp.where(inside, b + jnp.uint8(1), b), ln
+
+    def f_dec(b, ln):
+        return jnp.where(inside, b - jnp.uint8(1), b), ln
+
+    def f_digit(b, ln):
+        is_digit = (b >= ord("0")) & (b <= ord("9"))
+        d = (rbyte % 10) + jnp.uint8(ord("0"))
+        return jnp.where(inside & is_digit, d, b), ln
+
+    return jax.lax.switch(
+        op, [f_byteset, f_memcpy, f_magic, f_inc, f_dec, f_digit],
+        buf, length)
+
+
+@partial(jax.jit, static_argnames=("max_ops",))
+def mangle_at(buf: jax.Array, length: jax.Array, key: jax.Array,
+              max_ops: int = 8) -> Tuple[jax.Array, jax.Array]:
+    """honggfuzz-style mangle: 1..max_ops stacked run-oriented edits."""
+    k0, k1 = jax.random.split(key)
+    n = jax.random.randint(k0, (), 1, max_ops + 1).astype(jnp.uint32)
+
+    def step(carry, i):
+        b, ln = carry
+        nb, nln = _mangle_one(b, ln, jax.random.fold_in(k1, i))
+        active = i < n
+        return (jnp.where(active, nb, b), jnp.where(active, nln, ln)), None
+
+    (out, out_len), _ = jax.lax.scan(
+        step, (buf, length), jnp.arange(max_ops, dtype=jnp.uint32))
+    return out, out_len

@@ -1,0 +1,16 @@
+"""Multi-chip fuzzing tier.
+
+The reference scales by running independent fuzzer processes and
+merging coverage offline (merger AND-fold, SURVEY §2.12). Here the
+merge is an every-step ICI collective: the candidate batch shards over
+a ``dp`` mesh axis, the 64KB coverage map shards over ``mp``, and
+virgin-map union rides an all-gather + AND-fold (bitwise AND has no
+direct psum; De Morgan over a 64KB array is one cheap gather).
+"""
+
+from .distributed import (
+    ShardedFuzzState, make_mesh, make_sharded_fuzz_step, sharded_state_init,
+)
+
+__all__ = ["make_mesh", "make_sharded_fuzz_step", "sharded_state_init",
+           "ShardedFuzzState"]

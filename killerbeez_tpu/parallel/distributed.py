@@ -1,0 +1,196 @@
+"""shard_map fuzzing step over a (dp, mp) device mesh.
+
+Axes:
+  * ``dp`` — data parallel over candidate lanes (the reference's
+    "N independent fuzzer processes with distinct fuzzer_ids",
+    dynamorio_instrumentation.c:418-431 — here distinct PRNG streams).
+  * ``mp`` — map parallel over the 64KB coverage bitmap: each shard
+    owns a slice of the edge-id space and builds/updates only its
+    slice (the scatter, classify and novelty scans all shrink by the
+    shard factor).
+
+Collectives per step (all ICI-resident):
+  * new-path/crash/hang flags: ``psum`` of per-slice verdicts over mp
+  * virgin union over dp: all_gather + bitwise-AND fold (cleared bit =
+    seen; AND keeps every clear — the merger tool's fold, made
+    per-step)
+
+PRNG: per-lane keys fold in the *global* lane id, so the candidate
+stream is identical regardless of dp width — runs are reproducible
+across mesh shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING, MAP_SIZE
+from ..models.vm import Program, _run_one
+from ..ops.coverage import classify_counts, simplify_trace
+from ..ops.hashing import hash_bitmaps
+from ..ops.mutate_core import havoc_at
+
+
+def make_mesh(n_dp: int, n_mp: int = 1, devices=None) -> Mesh:
+    devices = np.asarray(devices if devices is not None
+                         else jax.devices()[:n_dp * n_mp])
+    if devices.size != n_dp * n_mp:
+        raise ValueError(
+            f"need {n_dp * n_mp} devices, have {devices.size}")
+    return Mesh(devices.reshape(n_dp, n_mp), ("dp", "mp"))
+
+
+class ShardedFuzzState(NamedTuple):
+    """Device-resident fuzzing state: virgin maps sharded over mp."""
+    virgin_bits: jax.Array   # uint8[MAP_SIZE]
+    virgin_crash: jax.Array
+    virgin_tmout: jax.Array
+    step: jax.Array          # int32 scalar, counts batches done
+
+
+def sharded_state_init(mesh: Mesh) -> ShardedFuzzState:
+    spec = NamedSharding(mesh, P("mp"))
+    full = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
+    return ShardedFuzzState(
+        virgin_bits=jax.device_put(full, spec),
+        virgin_crash=jax.device_put(full, spec),
+        virgin_tmout=jax.device_put(full, spec),
+        step=jnp.int32(0),
+    )
+
+
+def _slice_bitmap(edge_ids, valid, slice_size, slice_lo):
+    """Per-lane hit counts for this shard's [lo, lo+size) id range."""
+    b = edge_ids.shape[0]
+    local = edge_ids - slice_lo
+    ok = valid & (local >= 0) & (local < slice_size)
+    ids = jnp.where(ok, local, slice_size)
+    zeros = jnp.zeros((b, slice_size), dtype=jnp.uint8)
+    return zeros.at[jnp.arange(b)[:, None], ids].add(jnp.uint8(1),
+                                                     mode="drop")
+
+
+def _gather_and_fold(v_local, axis):
+    """Virgin union across an axis: all_gather + AND fold."""
+    g = jax.lax.all_gather(v_local, axis)  # [n_axis, M_shard]
+    return jax.lax.reduce(g, jnp.uint8(0xFF), jax.lax.bitwise_and,
+                          dimensions=(0,))
+
+
+def make_sharded_fuzz_step(program: Program, mesh: Mesh,
+                           batch_per_device: int, max_len: int,
+                           stack_pow2: int = 4):
+    """Build the jitted multi-chip fuzz step.
+
+    Returns ``step(state, seed_buf, seed_len, base_it) ->
+    (state', statuses[B], new_paths[B], candidates[B, L], lengths[B])``
+    where B = batch_per_device * n_dp, candidates dp-sharded, virgin
+    maps mp-sharded. ``base_it`` is the global iteration counter the
+    per-lane PRNG keys fold in.
+    """
+    n_dp = mesh.shape["dp"]
+    n_mp = mesh.shape["mp"]
+    if MAP_SIZE % n_mp:
+        raise ValueError("mp must divide MAP_SIZE")
+    slice_size = MAP_SIZE // n_mp
+    instrs = jnp.asarray(program.instrs)
+
+    def local_step(vb, vc, vh, seed_buf, seed_len, base_it):
+        # ---- which shard am I ----
+        dp_i = jax.lax.axis_index("dp")
+        mp_i = jax.lax.axis_index("mp")
+        slice_lo = mp_i.astype(jnp.int32) * slice_size
+
+        # ---- mutate: per-global-lane keys (mesh-shape independent) ----
+        lane = (dp_i.astype(jnp.uint32) * batch_per_device
+                + jnp.arange(batch_per_device, dtype=jnp.uint32))
+        base = jax.random.key(0)
+        keys = jax.vmap(
+            lambda l: jax.random.fold_in(
+                jax.random.fold_in(base, base_it.astype(jnp.uint32)), l)
+        )(lane)
+        bufs, lens = jax.vmap(
+            lambda k: havoc_at(seed_buf, seed_len, k,
+                               stack_pow2=stack_pow2))(keys)
+
+        # ---- execute ----
+        res = jax.vmap(partial(_run_one, instrs, program.mem_size,
+                               program.max_steps))(bufs, lens)
+        statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
+                             res.status)
+
+        # ---- coverage on my map slice ----
+        bm = _slice_bitmap(res.edge_ids, res.edge_ids >= 0, slice_size,
+                           slice_lo)
+        cls = classify_counts(bm)
+        simp = simplify_trace(bm)
+
+        # ---- local novelty (vs my virgin slice) ----
+        inter = cls & vb[None, :]
+        new_count = jnp.any(inter != 0, axis=1)
+        new_tuple = jnp.any((cls != 0) & (vb[None, :] == 0xFF), axis=1)
+        local_ret = jnp.where(new_tuple, 2,
+                              jnp.where(new_count, 1, 0)).astype(jnp.int32)
+        # a lane is new if ANY map slice saw novelty: max over mp
+        rets = jax.lax.pmax(local_ret, "mp")
+
+        # in-batch dedup by full-map hash: slice hashes combined by psum
+        slice_hash = hash_bitmaps(cls)
+        full_hash = jax.lax.psum(slice_hash, "mp")
+        # first occurrence within my dp shard's batch
+        same = full_hash[:, None] == full_hash[None, :]
+        earlier = jnp.tril(
+            jnp.ones((batch_per_device,) * 2, dtype=bool), k=-1)
+        first = ~jnp.any(same & earlier, axis=1)
+        rets = jnp.where(first, rets, 0)
+
+        # ---- virgin updates: clear my slice with new lanes' bits ----
+        def fold_new(traces, active):
+            seen = jax.lax.reduce(
+                jnp.where(active[:, None], traces, jnp.uint8(0)),
+                jnp.uint8(0), jax.lax.bitwise_or, dimensions=(0,))
+            return seen
+
+        vb2 = vb & ~fold_new(cls, rets > 0)
+        crash = statuses == FUZZ_CRASH
+        hang = statuses == FUZZ_HANG
+        vc2 = vc & ~fold_new(simp, crash)
+        vh2 = vh & ~fold_new(simp, hang)
+
+        # ---- union across dp (the per-step "merger") ----
+        vb2 = _gather_and_fold(vb2, "dp")
+        vc2 = _gather_and_fold(vc2, "dp")
+        vh2 = _gather_and_fold(vh2, "dp")
+        return vb2, vc2, vh2, statuses, rets, bufs, lens
+
+    sharded = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("mp"), P("mp"), P("mp"), P(), P(), P()),
+        out_specs=(P("mp"), P("mp"), P("mp"), P("dp"), P("dp"),
+                   P("dp", None), P("dp")),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(state: ShardedFuzzState, seed_buf, seed_len, base_it):
+        if seed_buf.shape[-1] > max_len:
+            raise ValueError(
+                f"seed buffer ({seed_buf.shape[-1]}) exceeds max_len "
+                f"({max_len})")
+        if seed_buf.shape[-1] < max_len:  # trace-time pad to max_len
+            seed_buf = jnp.pad(seed_buf,
+                               (0, max_len - seed_buf.shape[-1]))
+        vb, vc, vh, statuses, rets, bufs, lens = sharded(
+            state.virgin_bits, state.virgin_crash, state.virgin_tmout,
+            seed_buf, seed_len, base_it)
+        new_state = ShardedFuzzState(vb, vc, vh, state.step + 1)
+        return new_state, statuses, rets, bufs, lens
+
+    return step

@@ -1,16 +1,23 @@
 """Test config: run JAX on a virtual 8-device CPU mesh.
 
 Multi-chip hardware is not available in CI; sharding tests run on
-XLA's forced host platform device count. Must be set before jax import.
+XLA's forced host platform device count.
+
+Note: the environment's sitecustomize imports jax at interpreter
+start (axon TPU tunnel), so env vars are too late here — the platform
+must be forced through jax.config before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

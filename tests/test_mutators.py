@@ -1,0 +1,288 @@
+"""Mutator engine tests: walking-order parity, determinism,
+batch==single consistency, state resume, multipart contract."""
+
+import json
+
+import numpy as np
+import pytest
+
+from killerbeez_tpu.mutators import (
+    MUTATE_MULTIPLE_INPUTS, mutator_factory, mutator_help, mutator_names,
+)
+from killerbeez_tpu.ops.mutate_core import (
+    ARITH_MAX, INTERESTING_8, arithmetic_total, bit_flip_total,
+    interesting_total,
+)
+from killerbeez_tpu.utils.serialization import encode_mem_array
+
+SEED = b"ABC@"
+
+
+def test_factory_names_and_help():
+    names = mutator_names()
+    for expected in ("bit_flip", "arithmetic", "interesting_value", "havoc",
+                     "nop", "ni", "zzuf", "afl", "honggfuzz", "dictionary",
+                     "splice", "manager", "radamsa"):
+        assert expected in names
+    with pytest.raises(ValueError, match="unknown mutator"):
+        mutator_factory("bitflipper", None, SEED)
+    h = mutator_help()
+    assert "bit_flip" in h and "ratio" in h
+
+
+def test_nop():
+    m = mutator_factory("nop", None, SEED)
+    assert m.mutate() == SEED
+    assert m.mutate() == SEED
+    assert m.get_current_iteration() == 2
+    assert m.get_total_iteration_count() == -1
+
+
+def test_bit_flip_walk_order():
+    m = mutator_factory("bit_flip", None, SEED)
+    total = m.get_total_iteration_count()
+    assert total == bit_flip_total(len(SEED), 1) == 32
+    outs = [m.mutate() for _ in range(total)]
+    assert m.mutate() is None  # exhausted -> the C API's 0 return
+    for i, out in enumerate(outs):
+        want = bytearray(SEED)
+        want[i // 8] ^= 128 >> (i % 8)  # AFL FLIP_BIT, MSB-first
+        assert out == bytes(want), i
+    # seed "ABC@" is one bit from "ABCD": flipping bit of 0x40->0x44
+    assert SEED[3] == 0x40
+    assert b"ABCD" in outs
+
+
+def test_bit_flip_batch_equals_singles():
+    m1 = mutator_factory("bit_flip", None, SEED)
+    m2 = mutator_factory("bit_flip", None, SEED)
+    bufs, lens = m1.mutate_batch(10)
+    for i in range(10):
+        assert m2.mutate() == bufs[i, :lens[i]].tobytes()
+
+
+def test_bit_flip_num_bits_and_overclamp():
+    m = mutator_factory("bit_flip", '{"num_bits": 4}', SEED)
+    assert m.get_total_iteration_count() == 29
+    out = m.mutate()
+    want = bytearray(SEED)
+    want[0] ^= 0b11110000
+    assert out == bytes(want)
+    with pytest.raises(ValueError):
+        mutator_factory("bit_flip", '{"num_bits": 3}', SEED)
+
+
+def test_bit_flip_exhaustion_batch_guard():
+    m = mutator_factory("bit_flip", None, SEED)
+    with pytest.raises(ValueError, match="left"):
+        m.mutate_batch(33)
+    m.mutate_batch(32)
+    assert m.remaining() == 0
+
+
+def test_arithmetic_walk_start():
+    m = mutator_factory("arithmetic", None, SEED)
+    assert m.get_total_iteration_count() == arithmetic_total(len(SEED))
+    outs = [m.mutate() for _ in range(4)]
+    # width-1 stage, pos 0: +1, -1, +2, -2
+    assert outs[0][0] == (SEED[0] + 1) & 0xFF
+    assert outs[1][0] == (SEED[0] - 1) & 0xFF
+    assert outs[2][0] == (SEED[0] + 2) & 0xFF
+    assert outs[3][0] == (SEED[0] - 2) & 0xFF
+    for o in outs:
+        assert o[1:4] == SEED[1:4]
+
+
+def test_arithmetic_covers_all_stages():
+    m = mutator_factory("arithmetic", None, SEED)
+    total = m.get_total_iteration_count()
+    # 1B: 4 pos; 2B: 3 pos x LE/BE; 4B: 1 pos x LE/BE — x35 deltas x2 signs
+    assert total == (4 * 35 * 2) + (3 * 35 * 2 * 2) + (1 * 35 * 2 * 2)
+    bufs, lens = m.mutate_batch(total)
+    assert (lens == len(SEED)).all()
+    # every candidate differs from the seed
+    seed_arr = np.frombuffer(SEED, dtype=np.uint8)
+    assert (bufs[:, :4] != seed_arr).any(axis=1).all()
+
+
+def test_interesting_value_walk_start():
+    m = mutator_factory("interesting_value", None, SEED)
+    assert m.get_total_iteration_count() == interesting_total(len(SEED))
+    out = m.mutate()
+    assert out[0] == INTERESTING_8[0] & 0xFF  # -128 -> 0x80
+    assert out[1:4] == SEED[1:4]
+
+
+def test_havoc_deterministic_and_batch_consistent():
+    m1 = mutator_factory("havoc", '{"seed": 7}', SEED)
+    m2 = mutator_factory("havoc", '{"seed": 7}', SEED)
+    outs1 = [m1.mutate() for _ in range(8)]
+    bufs, lens = m2.mutate_batch(8)
+    for i in range(8):
+        assert outs1[i] == bufs[i, :lens[i]].tobytes()
+    m3 = mutator_factory("havoc", '{"seed": 8}', SEED)
+    outs3 = [m3.mutate() for _ in range(8)]
+    assert outs1 != outs3  # different PRNG seed -> different stream
+    # lengths bounded by ratio*seed
+    assert all(1 <= len(o) <= m1.max_length for o in outs1)
+
+
+def test_havoc_bad_options():
+    with pytest.raises(ValueError):
+        mutator_factory("havoc", '{"stack_pow2": 9}', SEED)
+
+
+def test_zzuf_flips_only_within_length():
+    m = mutator_factory("zzuf", '{"ratio_bits": 0.5, "seed": 3}', b"AAAA")
+    bufs, lens = m.mutate_batch(16)
+    assert (lens == 4).all()
+    assert (bufs[:, 4:] == 0).all()  # padding untouched
+    assert (bufs[:, :4] != ord("A")).any()  # something flipped at p=.5
+
+
+def test_ni_swaps_chunks():
+    m = mutator_factory("ni", '{"seed": 1}', bytes(range(32)))
+    outs = [m.mutate() for _ in range(8)]
+    assert all(len(o) == 32 for o in outs)
+    assert any(o != bytes(range(32)) for o in outs)
+
+
+def test_honggfuzz_mangle():
+    m = mutator_factory("honggfuzz", '{"seed": 5}', b"0123456789")
+    outs = [m.mutate() for _ in range(8)]
+    assert any(o != b"0123456789" for o in outs)
+    m2 = mutator_factory("honggfuzz", '{"seed": 5}', b"0123456789")
+    assert [m2.mutate() for _ in range(8)] == outs
+
+
+def test_dictionary_overwrite_then_insert():
+    m = mutator_factory("dictionary", '{"tokens": ["XY"]}', SEED)
+    assert m.get_total_iteration_count() == 2 * len(SEED)
+    outs = [m.mutate() for _ in range(m.get_total_iteration_count())]
+    # first half: overwrite at each position
+    assert outs[0][:2] == b"XY" and outs[0][2:4] == SEED[2:4]
+    assert outs[1][0:1] == SEED[0:1] and outs[1][1:3] == b"XY"
+    # second half: insert at each position
+    ins0 = outs[len(SEED)]
+    assert ins0[:2] == b"XY" and ins0[2:6] == SEED
+    assert m.mutate() is None
+
+
+def test_splice_head_a_tail_b():
+    m = mutator_factory("splice", '{"corpus": ["WXYZ9876"], "seed": 2}',
+                        SEED)
+    outs = [m.mutate() for _ in range(8)]
+    partner = b"WXYZ9876"
+    for o in outs:
+        assert o[0:1] == SEED[0:1]  # head starts with seed bytes
+        # head is a prefix of the seed, tail a contiguous run of the
+        # partner (possibly clamped at the buffer boundary)
+        head_len = 0
+        while head_len < min(len(o), len(SEED)) and \
+                o[head_len] == SEED[head_len]:
+            head_len += 1
+        assert 1 <= head_len < len(o)
+        assert o[head_len:] in partner
+
+
+def test_afl_pipeline_stages():
+    m = mutator_factory("afl", None, SEED)
+    assert m.get_total_iteration_count() == -1
+    assert m.stage_name() == "flip1"
+    ref = mutator_factory("bit_flip", None, SEED)
+    for _ in range(32):  # first stage identical to bit_flip walk
+        assert m.mutate() == ref.mutate()
+    assert m.stage_name() == "flip2"
+    # run through all deterministic stages into havoc
+    while m.stage_name() != "havoc":
+        assert m.mutate() is not None
+    assert m.iteration == m.det_total
+    out = m.mutate()  # havoc tail works
+    assert out is not None
+
+
+def test_afl_skip_deterministic():
+    m = mutator_factory("afl", '{"skip_deterministic": 1}', SEED)
+    assert m.stage_name() == "havoc"
+    assert m.det_total == 0
+
+
+def test_afl_batch_spans_stage_boundary():
+    m = mutator_factory("afl", None, SEED)
+    singles = mutator_factory("afl", None, SEED)
+    bufs, lens = m.mutate_batch(40)  # crosses flip1(32) -> flip2
+    for i in range(40):
+        assert singles.mutate() == bufs[i, :lens[i]].tobytes(), i
+
+
+def test_state_resume_deterministic():
+    m = mutator_factory("bit_flip", None, SEED)
+    for _ in range(5):
+        m.mutate()
+    state = m.get_state()
+    next_out = m.mutate()
+    m2 = mutator_factory("bit_flip", None, b"zz")  # different seed input
+    m2.set_state(state)
+    assert m2.mutate() == next_out
+    assert m2.get_current_iteration() == 6
+
+
+def test_state_rejects_wrong_mutator():
+    m = mutator_factory("bit_flip", None, SEED)
+    with pytest.raises(ValueError):
+        m.set_state(json.dumps({"mutator": "havoc", "iteration": 1}))
+
+
+def test_set_input_resets_walk():
+    m = mutator_factory("bit_flip", None, SEED)
+    m.mutate()
+    m.set_input(b"QQQQQQQQ")
+    assert m.get_current_iteration() == 0
+    assert m.get_total_iteration_count() == 64
+    out = m.mutate()
+    assert out[0] == ord("Q") ^ 0x80
+
+
+def test_manager_multipart():
+    parts = [b"AAAA", b"BBBB"]
+    seed = encode_mem_array(parts).encode()
+    m = mutator_factory(
+        "manager", '{"mutators": ["bit_flip", "bit_flip"]}', seed)
+    num, sizes = m.get_input_info()
+    assert num == 2 and sizes == [4, 4]
+    # part-0 request advances; both parts retrievable
+    p0 = m.mutate_extended(MUTATE_MULTIPLE_INPUTS | 0)
+    p1 = m.mutate_extended(MUTATE_MULTIPLE_INPUTS | 1)
+    assert p0 is not None and p1 is not None
+    assert len(p0) == 4 and len(p1) == 4
+    # round-robin: first advance mutated part 0, second mutates part 1
+    whole1 = m.mutate()
+    assert whole1 is not None and len(whole1) == 8
+    # finite children -> finite total (2 walks of 32)
+    assert m.get_total_iteration_count() == 64
+    # state round-trip
+    st = m.get_state()
+    m2 = mutator_factory(
+        "manager", '{"mutators": ["bit_flip", "bit_flip"]}', seed)
+    m2.set_state(st)
+    assert m2.mutate() == m.mutate()
+
+
+def test_manager_part_count_mismatch():
+    seed = encode_mem_array([b"AAAA"]).encode()
+    with pytest.raises(ValueError, match="parts"):
+        mutator_factory("manager",
+                        '{"mutators": ["bit_flip", "bit_flip"]}', seed)
+
+
+def test_radamsa_gated():
+    import shutil
+    if shutil.which("radamsa"):
+        pytest.skip("radamsa present; gating not triggerable")
+    with pytest.raises(ValueError, match="radamsa"):
+        mutator_factory("radamsa", None, SEED)
+
+
+def test_empty_seed_rejected():
+    with pytest.raises(ValueError, match="empty seed"):
+        mutator_factory("bit_flip", None, b"")

@@ -1,0 +1,22 @@
+/* Hang fixture — input beginning with 'H' loops forever (reference
+ * corpus/hang behavior per SURVEY.md §2.9; fresh implementation). */
+#include <stdio.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  unsigned char buf[16];
+  size_t n;
+  if (argc > 1) {
+    FILE *f = fopen(argv[1], "rb");
+    if (!f) return 1;
+    n = fread(buf, 1, sizeof(buf), f);
+    fclose(f);
+  } else {
+    n = fread(buf, 1, sizeof(buf), stdin);
+  }
+  if (n > 0 && buf[0] == 'H') {
+    for (;;) usleep(1000);
+  }
+  printf("no hang\n");
+  return 0;
+}

@@ -1,0 +1,41 @@
+/* Network-client fixture — connects to a server (the fuzzer's
+ * listener), reads packets, crashes on a magic packet (reference
+ * corpus/network client role per SURVEY.md §2.9; fresh code).
+ *
+ * Usage: network_client <port>
+ */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc < 2) return 2;
+  int port = atoi(argv[1]);
+  int s = socket(AF_INET, SOCK_STREAM, 0);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons((unsigned short)port);
+  /* Retry briefly: the fuzzer's listener may still be coming up. */
+  int ok = -1;
+  for (int i = 0; i < 50 && ok != 0; i++) {
+    ok = connect(s, (struct sockaddr *)&addr, sizeof(addr));
+    if (ok != 0) usleep(20000);
+  }
+  if (ok != 0) return 3;
+
+  unsigned char buf[256];
+  for (;;) {
+    ssize_t n = recv(s, buf, sizeof(buf), 0);
+    if (n <= 0) break;
+    if (n >= 4 && memcmp(buf, "KILL", 4) == 0)
+      *(volatile int *)0 = 1;
+  }
+  close(s);
+  return 0;
+}

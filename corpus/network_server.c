@@ -1,0 +1,50 @@
+/* Network-server fixture — a TCP server that accepts one connection,
+ * reads packets and crashes on a 2-packet magic sequence (reference
+ * corpus/network server role per SURVEY.md §2.9; fresh code).
+ *
+ * Usage: network_server <port> [udp]
+ */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc < 2) return 2;
+  int port = atoi(argv[1]);
+  int udp = argc > 2 && strcmp(argv[2], "udp") == 0;
+
+  int s = socket(AF_INET, udp ? SOCK_DGRAM : SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(s, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons((unsigned short)port);
+  if (bind(s, (struct sockaddr *)&addr, sizeof(addr)) != 0) return 3;
+
+  int c = s;
+  if (!udp) {
+    if (listen(s, 1) != 0) return 4;
+    c = accept(s, NULL, NULL);
+    if (c < 0) return 5;
+  }
+
+  unsigned char buf[256];
+  int got_hello = 0;
+  for (;;) {
+    ssize_t n = recv(c, buf, sizeof(buf), 0);
+    if (n <= 0) break;
+    if (!got_hello) {
+      if (n >= 4 && memcmp(buf, "HELO", 4) == 0) got_hello = 1;
+    } else if (n >= 4 && memcmp(buf, "BOOM", 4) == 0) {
+      *(volatile int *)0 = 1; /* crash on the 2-packet sequence */
+    }
+    if (udp) break; /* one datagram per run in udp mode */
+  }
+  return 0;
+}

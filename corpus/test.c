@@ -1,0 +1,54 @@
+/* Canonical crasher fixture — same observable behavior as the
+ * reference's corpus/test (SURVEY.md §2.9: 4-byte "ABCD" input
+ * triggers a NULL write; each matched prefix byte takes a distinct
+ * branch so coverage deepens as a fuzzer homes in).  Written from
+ * scratch.
+ *
+ * Input: first argv[1] names a file; with no argument, stdin.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+int __kb_persistent_loop(unsigned max_cnt) __attribute__((weak));
+
+static int check(const unsigned char *buf, size_t n) {
+  if (n < 1 || buf[0] != 'A') return 0;
+  if (n < 2 || buf[1] != 'B') return 1;
+  if (n < 3 || buf[2] != 'C') return 2;
+  if (n < 4 || buf[3] != 'D') return 3;
+  /* full magic: die */
+  *(volatile int *)0 = 42;
+  return 4;
+}
+
+static int run_once(const char *path) {
+  unsigned char buf[64];
+  size_t n;
+  if (path) {
+    FILE *f = fopen(path, "rb");
+    if (!f) return 1;
+    n = fread(buf, 1, sizeof(buf), f);
+    fclose(f);
+  } else {
+    /* Raw read: under persistence the fuzzer rewinds our stdin's file
+     * description each iteration; stdio's EOF latch would hide that. */
+    ssize_t r = read(0, buf, sizeof(buf));
+    n = r > 0 ? (size_t)r : 0;
+  }
+  int depth = check(buf, n);
+  printf("matched %d bytes\n", depth);
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  const char *path = argc > 1 ? argv[1] : NULL;
+  if (__kb_persistent_loop) {
+    while (__kb_persistent_loop(1000)) {
+      if (run_once(path)) return 1;
+    }
+    return 0;
+  }
+  return run_once(path);
+}

@@ -41,6 +41,7 @@ class Driver:
         self.instrumentation = instrumentation
         self.mutator = mutator
         self.last_input: Optional[bytes] = None
+        self._host_prepared = False
         self._check_input_info()
 
     def _check_input_info(self) -> None:
@@ -55,9 +56,18 @@ class Driver:
 
     @property
     def supports_batch(self) -> bool:
-        return (self.instrumentation.supports_batch
+        host_ok = (self.instrumentation.device_backed or
+                   type(self)._host_exec_spec is not Driver._host_exec_spec)
+        return (self.instrumentation.supports_batch and host_ok
                 and self.mutator is not None
                 and type(self.mutator).mutate_batch is Mutator.mutate_batch)
+
+    def _host_exec_spec(self) -> Dict[str, Any]:
+        """How a host backend should execute the target for the
+        batched path: {"cmd_line", "use_stdin", "input_file"}.
+        Drivers that can't describe one don't batch host backends."""
+        raise NotImplementedError(
+            f"{self.name}: no host-exec batch support")
 
     # -- single-exec ----------------------------------------------------
 
@@ -87,6 +97,10 @@ class Driver:
         only the first ``n``)."""
         if not self.supports_batch:
             raise RuntimeError(f"{self.name}: batch path unavailable")
+        if not self.instrumentation.device_backed and \
+                not self._host_prepared:
+            self.instrumentation.prepare_host(**self._host_exec_spec())
+            self._host_prepared = True
         bufs, lens = self.mutator.mutate_batch(n)
         if pad_to is not None and pad_to > n:
             pad = pad_to - n

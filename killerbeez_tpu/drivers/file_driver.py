@@ -36,7 +36,7 @@ class FileDriver(Driver):
 
     def __init__(self, options, instrumentation, mutator=None):
         super().__init__(options, instrumentation, mutator)
-        self._device_backed = instrumentation.supports_batch
+        self._device_backed = instrumentation.device_backed
         if not self._device_backed and "path" not in self.options:
             raise ValueError(
                 'file driver needs {"path": target} for host backends')
@@ -46,6 +46,12 @@ class FileDriver(Driver):
     def _cmd_line(self) -> str:
         args = self.options["arguments"].replace("@@", self.test_filename)
         return f'{self.options["path"]} {args}'
+
+    def _host_exec_spec(self):
+        # The exec backend stages the input file itself in the batched
+        # path (C-side write per exec, no Python file I/O).
+        return {"cmd_line": self._cmd_line(), "use_stdin": False,
+                "input_file": self.test_filename}
 
     def test_input(self, buf: bytes) -> int:
         self.last_input = bytes(buf)

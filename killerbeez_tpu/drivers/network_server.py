@@ -1,0 +1,222 @@
+"""network_server driver — the target is a server; the fuzzer
+connects and delivers the input as a sequence of network packets.
+
+Behavioral parity with the reference network_server driver
+(SURVEY §2.2, reference driver/network_server_driver.c): start the
+target via the instrumentation's async enable, poll until the port is
+listening, connect (TCP or UDP), send N packets with optional
+inter-packet sleeps, then wait for process completion with the
+timeout->FUZZ_HANG rule. Multi-packet inputs come from multipart
+mutators via ``mutate_extended(MUTATE_MULTIPLE_INPUTS|i)`` and the
+last input serializes via ``encode_mem_array``.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import List, Optional
+
+from ..mutators.base import MUTATE_MULTIPLE_INPUTS
+from ..utils.logging import DEBUG_MSG, WARNING_MSG
+from ..utils.serialization import decode_mem_array, encode_mem_array
+from .. import FUZZ_ERROR, FUZZ_NONE
+from .base import Driver
+from .factory import register_driver
+
+
+_TCP_LISTEN = 0x0A
+
+
+def is_port_listening(port: int, udp: bool = False,
+                      host: str = "127.0.0.1") -> bool:
+    """True when a socket is bound/listening on host:port, determined
+    from /proc/net/{tcp,udp} WITHOUT connecting (reference
+    is_port_listening reads the kernel table for the same reason: a
+    probe connection would consume the target's accept()). A listener
+    on INADDR_ANY matches any host."""
+    try:
+        want = int.from_bytes(socket.inet_aton(host), "little")
+    except OSError:
+        want = None  # non-IPv4 host string: match port only
+    tables = (["/proc/net/udp", "/proc/net/udp6"] if udp
+              else ["/proc/net/tcp", "/proc/net/tcp6"])
+    for table in tables:
+        v6 = table.endswith("6")
+        try:
+            with open(table) as f:
+                lines = f.readlines()[1:]
+        except OSError:
+            continue
+        for ln in lines:
+            fields = ln.split()
+            if len(fields) < 4:
+                continue
+            try:
+                addr_hex, port_hex = fields[1].split(":")
+                local_port = int(port_hex, 16)
+                state = int(fields[3], 16)
+            except (ValueError, IndexError):
+                continue
+            if local_port != port:
+                continue
+            if not udp and state != _TCP_LISTEN:
+                continue
+            if want is not None:
+                if v6:
+                    # match v4-mapped (::ffff:a.b.c.d) or in6addr_any
+                    tail = int(addr_hex[-8:], 16)
+                    if int(addr_hex, 16) != 0 and tail != want:
+                        continue
+                else:
+                    addr = int(addr_hex, 16)
+                    if addr != 0 and addr != want:
+                        continue
+            return True
+    return False
+
+
+@register_driver
+class NetworkServerDriver(Driver):
+    """Fuzzes a server target over TCP/UDP packet sequences."""
+    name = "network_server"
+    OPTION_SCHEMA = {"path": str, "arguments": str, "port": int,
+                     "ip": str, "udp": int, "sleeps": list,
+                     "timeout": float, "ratio": float,
+                     "skip_network_check": int, "listen_timeout": float}
+    OPTION_DESCS = {
+        "path": "target server executable",
+        "arguments": "argument string for the target",
+        "port": "port the target listens on (required)",
+        "ip": "target address (default 127.0.0.1)",
+        "udp": "1 = datagrams instead of a TCP stream",
+        "sleeps": "per-packet pre-send sleeps in ms",
+        "timeout": "seconds to wait for target exit after sending "
+                   "(then FUZZ_HANG; default 2.0)",
+        "ratio": "mutate-buffer size ratio (default 2.0)",
+        "skip_network_check": "1 = don't wait for the port to listen",
+        "listen_timeout": "max seconds to wait for the port (default 5)",
+    }
+    DEFAULTS = {"arguments": "", "ip": "127.0.0.1", "udp": 0,
+                "timeout": 2.0, "ratio": 2.0, "skip_network_check": 0,
+                "listen_timeout": 5.0}
+
+    def __init__(self, options, instrumentation, mutator=None):
+        super().__init__(options, instrumentation, mutator)
+        if "path" not in self.options or "port" not in self.options:
+            raise ValueError(
+                'network_server needs {"path": ..., "port": ...}')
+        self.port = int(self.options["port"])
+        self.udp = bool(self.options["udp"])
+        self.num_inputs = 1
+        self.input_sizes: List[int] = []
+        if self.mutator is not None:
+            self.num_inputs, self.input_sizes = \
+                self.mutator.get_input_info()
+        self._last_parts: Optional[List[bytes]] = None
+
+    def _check_input_info(self) -> None:
+        # Multi-input is this driver's point; accept any part count.
+        pass
+
+    @property
+    def supports_batch(self) -> bool:
+        return False  # live-socket interaction is inherently per-exec
+
+    def _cmd_line(self) -> str:
+        return (f'{self.options["path"]} '
+                f'{self.options["arguments"]}').strip()
+
+    # -- packet delivery ------------------------------------------------
+
+    def _wait_listening(self) -> bool:
+        if self.options["skip_network_check"]:
+            return True
+        deadline = time.time() + float(self.options["listen_timeout"])
+        while time.time() < deadline:
+            if self.instrumentation.is_process_done():
+                return False  # died before listening
+            if is_port_listening(self.port, self.udp,
+                                 self.options["ip"]):
+                return True
+            time.sleep(0.005)
+        return False
+
+    def _send_packets(self, parts: List[bytes]) -> bool:
+        sleeps = self.options.get("sleeps") or []
+        try:
+            if self.udp:
+                sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            else:
+                sock = socket.create_connection(
+                    (self.options["ip"], self.port), timeout=2.0)
+            with sock:
+                for i, part in enumerate(parts):
+                    if i < len(sleeps) and sleeps[i]:
+                        time.sleep(float(sleeps[i]) / 1000.0)
+                    if self.udp:
+                        sock.sendto(part,
+                                    (self.options["ip"], self.port))
+                    else:
+                        sock.sendall(part)
+            return True
+        except OSError as e:
+            DEBUG_MSG("network_server send failed: %s", e)
+            return False
+
+    def _run(self, parts: List[bytes]) -> int:
+        self.instrumentation.start_process(self._cmd_line())
+        if not self._wait_listening():
+            # died or never listened: collect the verdict (a crash
+            # before listen is still a crash)
+            return self.instrumentation.wait_done(0.1)
+        if not self._send_packets(parts):
+            # a mid-sequence crash resets the connection and fails the
+            # send — the target's verdict is the real signal
+            verdict = self.instrumentation.wait_done(0.1)
+            return verdict if verdict != FUZZ_NONE else FUZZ_ERROR
+        return self.instrumentation.wait_done(
+            float(self.options["timeout"]))
+
+    # -- vtable ---------------------------------------------------------
+
+    def test_input(self, buf: bytes) -> int:
+        """Input is an encoded mem array of packets (reference
+        decode_mem_array contract)."""
+        try:
+            parts = decode_mem_array(buf.decode())
+        except Exception:
+            parts = [buf]  # raw bytes: single packet
+        self._last_parts = parts
+        self.last_input = encode_mem_array(parts).encode()
+        return self._run(parts)
+
+    def test_next_input(self) -> Optional[int]:
+        if self.mutator is None:
+            raise RuntimeError("network_server: no mutator attached")
+        parts: List[bytes] = []
+        if self.num_inputs > 1:
+            for i in range(self.num_inputs):
+                part = self.mutator.mutate_extended(
+                    MUTATE_MULTIPLE_INPUTS | i)
+                if part is None:
+                    return None
+                parts.append(part)
+        else:
+            buf = self.mutator.mutate()
+            if buf is None:
+                return None
+            parts = [buf]
+        self._last_parts = parts
+        self.last_input = encode_mem_array(parts).encode()
+        return self._run(parts)
+
+    def get_last_input(self) -> Optional[bytes]:
+        return self.last_input
+
+    def cleanup(self) -> None:
+        try:
+            if not self.instrumentation.is_process_done():
+                self.instrumentation.wait_done(0.0)
+        except (NotImplementedError, RuntimeError) as e:
+            WARNING_MSG("network_server cleanup: %s", e)

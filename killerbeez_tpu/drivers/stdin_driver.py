@@ -22,7 +22,7 @@ class StdinDriver(Driver):
 
     def __init__(self, options, instrumentation, mutator=None):
         super().__init__(options, instrumentation, mutator)
-        self._device_backed = instrumentation.supports_batch
+        self._device_backed = instrumentation.device_backed
         if not self._device_backed and "path" not in self.options:
             raise ValueError(
                 'stdin driver needs {"path": target} for host backends')
@@ -30,6 +30,10 @@ class StdinDriver(Driver):
     def _cmd_line(self) -> str:
         args = self.options["arguments"]
         return f'{self.options["path"]} {args}'.strip()
+
+    def _host_exec_spec(self):
+        return {"cmd_line": self._cmd_line(), "use_stdin": True,
+                "input_file": None}
 
     def test_input(self, buf: bytes) -> int:
         self.last_input = bytes(buf)

@@ -11,6 +11,7 @@ from .factory import (
     instrumentation_factory, instrumentation_help, instrumentation_names,
     register_instrumentation,
 )
+from .afl import AflInstrumentation
 from .jit_harness import JitHarnessInstrumentation
 from .return_code import ReturnCodeInstrumentation
 
@@ -18,5 +19,6 @@ __all__ = [
     "Instrumentation", "BatchResult",
     "instrumentation_factory", "instrumentation_help",
     "instrumentation_names", "register_instrumentation",
-    "JitHarnessInstrumentation", "ReturnCodeInstrumentation",
+    "AflInstrumentation", "JitHarnessInstrumentation",
+    "ReturnCodeInstrumentation",
 ]

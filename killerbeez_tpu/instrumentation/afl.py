@@ -1,0 +1,304 @@
+"""afl — forkserver + SHM-bitmap instrumentation for real host
+binaries (the reference's AFL-style path, SURVEY §2.3: reference
+afl_instrumentation.c — SysV SHM 64KB map, three virgin maps
+virgin_bits/tmout/crash, has_new_bits novelty, simplify_trace for
+crash/hang dedup, forkserver options; re-architected here as a native
+C++ exec backend (native/kb_exec.cpp) that collects per-exec bitmaps
+and a device-side triage that scans the whole batch's maps in one XLA
+program).
+
+Targets are built with the kb-cc wrapper (compiled-in runtime,
+native/kb_rt.c) or run with the LD_PRELOAD forkserver; the wire
+protocol is the reference's (fds 198/199, __AFL_SHM_ID).
+
+Options (reference afl_instrumentation.c:322-337 parity):
+  use_fork_server, persistence_max_cnt, deferred_startup, qemu_mode,
+  qemu_path, timeout, mem_limit, preload_forkserver, novelty.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_NONE, MAP_SIZE
+from ..native.exec_backend import ExecTarget, classify
+from ..ops.coverage import (
+    COUNT_CLASS_LOOKUP, classify_counts, count_non_255_bytes,
+    merge_virgin, simplify_trace,
+)
+from ..utils.serialization import decode_array, encode_array
+from .base import BatchResult, Instrumentation
+from .factory import register_instrumentation
+from .jit_harness import _triage_exact
+
+
+@partial(jax.jit, donate_argnames=("vb", "vc", "vh"))
+def _triage_host_bitmaps(bitmaps, statuses, vb, vc, vh):
+    """Device triage of host-collected raw bitmaps: classify ->
+    sequential-parity novelty scan vs the three virgin maps (exact
+    single-exec-loop semantics; host exec dominates the step time, so
+    parity costs nothing here)."""
+    cls = classify_counts(bitmaps)
+    simp = simplify_trace(bitmaps)
+    return _triage_exact(vb, vc, vh, cls, simp, statuses)
+
+
+def _np_classify(trace: np.ndarray) -> np.ndarray:
+    return COUNT_CLASS_LOOKUP[trace]
+
+
+def _np_has_new_bits(virgin: np.ndarray, trace: np.ndarray
+                     ) -> Tuple[int, np.ndarray]:
+    inter = trace & virgin
+    if not inter.any():
+        return 0, virgin
+    ret = 2 if bool(((trace != 0) & (virgin == 0xFF)).any()) else 1
+    return ret, virgin & ~trace
+
+
+@register_instrumentation
+class AflInstrumentation(Instrumentation):
+    """Forkserver + 64KB edge bitmap for kb-cc-built host targets."""
+    name = "afl"
+    supports_batch = True
+    device_backed = False
+    OPTION_SCHEMA = {
+        "use_fork_server": int, "persistence_max_cnt": int,
+        "deferred_startup": int, "qemu_mode": int, "qemu_path": str,
+        "timeout": float, "mem_limit": int, "preload_forkserver": int,
+        "device_triage": int,
+    }
+    OPTION_DESCS = {
+        "use_fork_server": "1 = fork per exec via the forkserver "
+                           "(default), 0 = fork+execve per exec",
+        "persistence_max_cnt": "N>0: persistent mode, N inputs per "
+                               "process (SIGSTOP/SIGCONT loop)",
+        "deferred_startup": "1 = target calls __kb_manual_init() "
+                            "itself (skip the pre-main forkserver)",
+        "qemu_mode": "1 = run the target under a QEMU user-mode "
+                     "binary given by qemu_path (binary-only targets)",
+        "qemu_path": "path to an instrumented qemu-user binary",
+        "timeout": "seconds before an exec counts as a hang "
+                   "(default 2.0)",
+        "mem_limit": "child address-space limit in MB (0 = none)",
+        "preload_forkserver": "1 = LD_PRELOAD the forkserver into an "
+                              "uninstrumented target",
+        "device_triage": "1 = batched novelty scan on the TPU "
+                         "(default), 0 = numpy on host",
+    }
+    DEFAULTS = {"use_fork_server": 1, "persistence_max_cnt": 0,
+                "deferred_startup": 0, "qemu_mode": 0, "timeout": 2.0,
+                "mem_limit": 0, "preload_forkserver": 0,
+                "device_triage": 1}
+
+    def __init__(self, options: Optional[str] = None):
+        super().__init__(options)
+        if self.options["qemu_mode"]:
+            qemu = self.options.get("qemu_path")
+            if not qemu or not os.path.exists(qemu):
+                raise ValueError(
+                    "qemu_mode needs qemu_path pointing at a qemu-user "
+                    "binary (none is bundled in this image)")
+        self.virgin_bits = np.full(MAP_SIZE, 0xFF, dtype=np.uint8)
+        self.virgin_crash = np.full(MAP_SIZE, 0xFF, dtype=np.uint8)
+        self.virgin_tmout = np.full(MAP_SIZE, 0xFF, dtype=np.uint8)
+        self.total_execs = 0
+        self._target: Optional[ExecTarget] = None
+        self._target_key: Optional[Tuple] = None
+        self._last_unique_crash = False
+        self._last_unique_hang = False
+        self._last_trace: Optional[np.ndarray] = None
+
+    # -- target lifecycle ----------------------------------------------
+
+    def _build_argv(self, cmd_line: str) -> List[str]:
+        argv = shlex.split(cmd_line)
+        if self.options["qemu_mode"]:
+            argv = [self.options["qemu_path"]] + argv
+        return argv
+
+    def _ensure_target(self, cmd_line: str, use_stdin: bool,
+                       input_file: Optional[str]) -> ExecTarget:
+        key = (cmd_line, use_stdin, input_file)
+        if self._target is not None and self._target_key == key:
+            return self._target
+        if self._target is not None:
+            self._target.close()
+        self._target = ExecTarget(
+            self._build_argv(cmd_line),
+            use_stdin=use_stdin,
+            input_file=input_file,
+            use_forkserver=bool(self.options["use_fork_server"]),
+            use_preload_forkserver=bool(
+                self.options["preload_forkserver"]),
+            persistent=int(self.options["persistence_max_cnt"]),
+            deferred=bool(self.options["deferred_startup"]),
+            mem_limit_mb=int(self.options["mem_limit"]),
+            coverage=True,
+            timeout=float(self.options["timeout"]))
+        self._target_key = key
+        return self._target
+
+    def prepare_host(self, cmd_line: str, use_stdin: bool,
+                     input_file: Optional[str] = None) -> None:
+        self._ensure_target(cmd_line, use_stdin, input_file)
+
+    # -- single-exec ----------------------------------------------------
+
+    def _finish_exec(self, verdict: int) -> None:
+        """Harvest the SHM bitmap and update the three virgin maps
+        (reference finish_fuzz_round semantics)."""
+        trace = self._target.trace_bits().copy()
+        self.total_execs += 1
+        self._last_trace = trace
+        cls = _np_classify(trace)
+        ret, self.virgin_bits = _np_has_new_bits(self.virgin_bits, cls)
+        self._last_unique_crash = False
+        self._last_unique_hang = False
+        if verdict in (FUZZ_CRASH, FUZZ_HANG):
+            simp = np.where(trace == 0, 1, 128).astype(np.uint8)
+            if verdict == FUZZ_CRASH:
+                cret, self.virgin_crash = _np_has_new_bits(
+                    self.virgin_crash, simp)
+                self._last_unique_crash = cret > 0
+            else:
+                hret, self.virgin_tmout = _np_has_new_bits(
+                    self.virgin_tmout, simp)
+                self._last_unique_hang = hret > 0
+        self.last_status = verdict
+        self.last_new_path = ret
+
+    def enable(self, input_bytes: Optional[bytes] = None,
+               cmd_line: Optional[str] = None) -> None:
+        if cmd_line is None:
+            raise ValueError("afl instrumentation needs a cmd_line "
+                             "(use a host driver: file/stdin/network)")
+        use_stdin = input_bytes is not None
+        # File-mode single-exec: the driver already wrote the test
+        # file; the backend must not stage over it.
+        t = self._ensure_target(cmd_line, use_stdin, None)
+        t.clear_trace()
+        status_raw = t.run(input_bytes or b"")
+        verdict, _ = classify(status_raw)
+        self._finish_exec(verdict)
+
+    # -- async exec (network drivers) -----------------------------------
+
+    def start_process(self, cmd_line: str) -> None:
+        t = self._ensure_target(cmd_line, False, None)
+        t.clear_trace()
+        t.launch()
+
+    def is_process_done(self) -> bool:
+        return self._target is None or not self._target.alive()
+
+    def wait_done(self, timeout: float) -> int:
+        verdict, _ = classify(self._target.wait_done(timeout))
+        self._finish_exec(verdict)
+        return verdict
+
+    def last_unique_crash(self) -> bool:
+        return self._last_unique_crash
+
+    def last_unique_hang(self) -> bool:
+        return self._last_unique_hang
+
+    # -- batched --------------------------------------------------------
+
+    def run_batch(self, inputs: np.ndarray, lengths: np.ndarray
+                  ) -> BatchResult:
+        if self._target is None:
+            raise RuntimeError("afl: prepare_host() not called (the "
+                               "driver binds the target command first)")
+        statuses_raw, bitmaps = self._target.run_batch(inputs, lengths)
+        n = len(statuses_raw)
+        self.total_execs += n
+        verdicts = np.full(n, FUZZ_NONE, dtype=np.int32)
+        verdicts[statuses_raw >= 512] = FUZZ_CRASH
+        verdicts[statuses_raw == -1] = FUZZ_HANG
+        verdicts[statuses_raw == -2] = FUZZ_ERROR
+        exit_codes = np.where(statuses_raw >= 512, statuses_raw - 512,
+                              np.maximum(statuses_raw, 0)).astype(np.int32)
+
+        if self.options["device_triage"]:
+            new_paths, uc, uh, vb, vc, vh = _triage_host_bitmaps(
+                jnp.asarray(bitmaps), jnp.asarray(verdicts),
+                jnp.asarray(self.virgin_bits),
+                jnp.asarray(self.virgin_crash),
+                jnp.asarray(self.virgin_tmout))
+            self.virgin_bits = np.asarray(vb)
+            self.virgin_crash = np.asarray(vc)
+            self.virgin_tmout = np.asarray(vh)
+            new_paths, uc, uh = (np.asarray(new_paths), np.asarray(uc),
+                                 np.asarray(uh))
+        else:
+            new_paths = np.zeros(n, dtype=np.int32)
+            uc = np.zeros(n, dtype=bool)
+            uh = np.zeros(n, dtype=bool)
+            for i in range(n):
+                cls = _np_classify(bitmaps[i])
+                new_paths[i], self.virgin_bits = _np_has_new_bits(
+                    self.virgin_bits, cls)
+                simp = np.where(bitmaps[i] == 0, 1, 128).astype(np.uint8)
+                if verdicts[i] == FUZZ_CRASH:
+                    r, self.virgin_crash = _np_has_new_bits(
+                        self.virgin_crash, simp)
+                    uc[i] = r > 0
+                elif verdicts[i] == FUZZ_HANG:
+                    r, self.virgin_tmout = _np_has_new_bits(
+                        self.virgin_tmout, simp)
+                    uh[i] = r > 0
+        self._last_trace = bitmaps[-1] if n else None
+        return BatchResult(statuses=verdicts, new_paths=new_paths,
+                           unique_crashes=uc, unique_hangs=uh,
+                           exit_codes=exit_codes)
+
+    # -- state / merge (reference afl_get_state/afl_set_state/merge) ---
+
+    def get_state(self) -> str:
+        return json.dumps({
+            "instrumentation": self.name,
+            "total_execs": self.total_execs,
+            "virgin_bits": encode_array(self.virgin_bits),
+            "virgin_crash": encode_array(self.virgin_crash),
+            "virgin_tmout": encode_array(self.virgin_tmout),
+        })
+
+    def set_state(self, state: str) -> None:
+        d = json.loads(state)
+        if d.get("instrumentation") not in (None, self.name):
+            raise ValueError(
+                f"state is for {d.get('instrumentation')!r}, not "
+                f"{self.name!r}")
+        self.total_execs = int(d.get("total_execs", 0))
+        for key in ("virgin_bits", "virgin_crash", "virgin_tmout"):
+            if key in d:
+                setattr(self, key, decode_array(d[key]))
+
+    def merge(self, other_state: str) -> None:
+        d = json.loads(other_state)
+        for key in ("virgin_bits", "virgin_crash", "virgin_tmout"):
+            if key in d:
+                mine = getattr(self, key)
+                theirs = decode_array(d[key])
+                setattr(self, key, np.asarray(merge_virgin(mine, theirs)))
+        self.total_execs += int(d.get("total_execs", 0))
+
+    def coverage_bytes(self) -> int:
+        return int(count_non_255_bytes(self.virgin_bits))
+
+    def get_module_info(self) -> List[str]:
+        return ["target"]
+
+    def cleanup(self) -> None:
+        if self._target is not None:
+            self._target.close()
+            self._target = None

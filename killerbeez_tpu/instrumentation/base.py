@@ -39,6 +39,11 @@ class Instrumentation:
     OPTION_DESCS: Dict[str, str] = {}
     DEFAULTS: Dict[str, Any] = {}
     supports_batch = False
+    # device_backed: inputs are tensors handed straight to the device
+    # (no target process, no cmd_line). Host backends (afl,
+    # return_code) execute real processes and need the driver to
+    # describe the command via prepare_host() before batching.
+    device_backed = False
 
     def __init__(self, options: Optional[str] = None):
         self.options = parse_options(options, self.OPTION_SCHEMA,
@@ -59,6 +64,20 @@ class Instrumentation:
     def is_process_done(self) -> bool:
         return True
 
+    # -- async exec (network drivers) -----------------------------------
+
+    def start_process(self, cmd_line: str) -> None:
+        """Start the target WITHOUT waiting (reference enable's async
+        half). The driver interacts with the live process, then calls
+        wait_done() for the verdict + novelty update."""
+        raise NotImplementedError(
+            f"{self.name} cannot run live targets")
+
+    def wait_done(self, timeout: float) -> int:
+        """Wait for a start_process() target; kill on timeout (hang).
+        Returns the FUZZ_* verdict and updates novelty state."""
+        raise NotImplementedError
+
     def get_fuzz_result(self) -> int:
         return self.last_status
 
@@ -75,6 +94,11 @@ class Instrumentation:
         return False
 
     # -- batched API ----------------------------------------------------
+
+    def prepare_host(self, cmd_line: str, use_stdin: bool,
+                     input_file: Optional[str] = None) -> None:
+        """Host backends: bind the target command before batch runs
+        (drivers call this once; device backends ignore it)."""
 
     def run_batch(self, inputs: np.ndarray, lengths: np.ndarray
                   ) -> BatchResult:

@@ -98,6 +98,7 @@ class JitHarnessInstrumentation(Instrumentation):
     """Executes KBVM targets fully on-device with AFL-map triage."""
     name = "jit_harness"
     supports_batch = True
+    device_backed = True
     OPTION_SCHEMA = {"target": str, "program_file": str, "max_steps": int,
                      "novelty": str, "edges": int}
     OPTION_DESCS = {

@@ -64,6 +64,33 @@ class ReturnCodeInstrumentation(Instrumentation):
         self.total_execs += 1
         self.last_new_path = 0  # dumb fuzzing: no coverage signal
 
+    # -- async exec (network drivers) -----------------------------------
+
+    def start_process(self, cmd_line: str) -> None:
+        self._proc = subprocess.Popen(
+            shlex.split(cmd_line),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def is_process_done(self) -> bool:
+        proc = getattr(self, "_proc", None)
+        return proc is None or proc.poll() is not None
+
+    def wait_done(self, timeout: float) -> int:
+        proc = self._proc
+        try:
+            rc = proc.wait(timeout=timeout)
+            self.last_status = FUZZ_CRASH if rc < 0 else FUZZ_NONE
+            self.last_exit_code = rc
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            self.last_status = FUZZ_HANG
+            self.last_exit_code = -int(signal.SIGKILL)
+        self._proc = None
+        self.total_execs += 1
+        self.last_new_path = 0
+        return self.last_status
+
     # merge: the reference returns NULL state and no merge for
     # return_code; keep get_state minimal for -isd parity
     def get_state(self) -> str:

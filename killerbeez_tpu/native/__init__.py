@@ -1,0 +1,8 @@
+"""Native (C/C++) host components: exec backend, target runtime,
+preload forkserver, kb-cc wrapper. See native/ at the repo root for
+the sources and killerbeez_tpu.native.exec_backend for the bindings."""
+
+from .build import (  # noqa: F401
+    build_native, kb_cc_path, native_available, preload_path, rt_obj_path,
+)
+from .exec_backend import ExecTarget, KB_MAP_SIZE  # noqa: F401

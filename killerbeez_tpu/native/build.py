@@ -1,0 +1,92 @@
+"""On-demand build of the native components (native/ at the repo
+root) — make is invoked at most once per process and only when an
+artifact is missing or older than its sources. Keeps `pip install`
+out of the loop: the toolchain (gcc/g++/make) is part of the image.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+BUILD_DIR = os.path.join(NATIVE_DIR, "build")
+
+_lock = threading.Lock()
+_built = False
+_build_error: Optional[str] = None
+
+_ARTIFACTS = ("libkbexec.so", "kb_rt.o", "libkbpreload.so", "kb-cc")
+_SOURCES = ("kb_exec.cpp", "kb_rt.c", "kb_preload.c", "kb_cc.c",
+            "kb_protocol.h", "Makefile")
+
+
+def _stale() -> bool:
+    try:
+        newest_src = max(
+            os.path.getmtime(os.path.join(NATIVE_DIR, s)) for s in _SOURCES)
+    except OSError:
+        return True
+    for a in _ARTIFACTS:
+        p = os.path.join(BUILD_DIR, a)
+        if not os.path.exists(p) or os.path.getmtime(p) < newest_src:
+            return True
+    return False
+
+
+def build_native(force: bool = False) -> bool:
+    """Ensure native artifacts exist and are current. Returns True on
+    success; failures are cached (native_available() stays False)."""
+    global _built, _build_error
+    with _lock:
+        if _built and not force:
+            return _build_error is None
+        _built = True
+        if not os.path.isdir(NATIVE_DIR):
+            _build_error = f"native source dir missing: {NATIVE_DIR}"
+            return False
+        if not force and not _stale():
+            _build_error = None
+            return True
+        proc = subprocess.run(
+            ["make", "-C", NATIVE_DIR], capture_output=True, text=True)
+        if proc.returncode != 0:
+            _build_error = proc.stderr[-2000:]
+            return False
+        _build_error = None
+        return True
+
+
+def native_available() -> bool:
+    return build_native()
+
+
+def build_error() -> Optional[str]:
+    build_native()
+    return _build_error
+
+
+def _artifact(name: str) -> str:
+    if not build_native():
+        raise RuntimeError(f"native build failed: {_build_error}")
+    return os.path.join(BUILD_DIR, name)
+
+
+def exec_lib_path() -> str:
+    return _artifact("libkbexec.so")
+
+
+def rt_obj_path() -> str:
+    return _artifact("kb_rt.o")
+
+
+def preload_path() -> str:
+    return _artifact("libkbpreload.so")
+
+
+def kb_cc_path() -> str:
+    return _artifact("kb-cc")

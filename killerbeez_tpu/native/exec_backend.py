@@ -1,0 +1,243 @@
+"""ctypes bindings for libkbexec — the C++ host exec backend.
+
+``ExecTarget`` wraps one target process configuration: plain
+fork+execve or forkserver (fds 198/199 protocol), optional SysV-SHM
+coverage bitmap, persistence, deferred startup and LD_PRELOAD.
+
+Status codes from the C layer (kb_exec.cpp):
+    0..255   exit code          512+sig  killed by signal
+    -1       hang (timeout)     -2       backend error
+``classify()`` maps them to the framework's FUZZ_* verdicts.
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import os
+import tempfile
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_NONE
+from .build import exec_lib_path, preload_path
+
+KB_MAP_SIZE = 1 << 16
+
+_lib = None
+
+
+def _load() -> ct.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ct.CDLL(exec_lib_path())
+    lib.kb_target_create.restype = ct.c_void_p
+    lib.kb_target_create.argtypes = [
+        ct.POINTER(ct.c_char_p), ct.c_int, ct.c_char_p, ct.c_int,
+        ct.c_char_p, ct.c_int, ct.c_int, ct.c_long, ct.c_int]
+    lib.kb_target_start.restype = ct.c_int
+    lib.kb_target_start.argtypes = [ct.c_void_p, ct.c_double]
+    lib.kb_target_run.restype = ct.c_int
+    lib.kb_target_run.argtypes = [ct.c_void_p, ct.c_char_p, ct.c_int32,
+                                  ct.c_double]
+    lib.kb_target_run_batch.restype = ct.c_int
+    lib.kb_target_run_batch.argtypes = [
+        ct.c_void_p, ct.c_void_p, ct.c_void_p, ct.c_int, ct.c_int,
+        ct.c_double, ct.c_void_p, ct.c_void_p]
+    lib.kb_target_launch.restype = ct.c_int
+    lib.kb_target_launch.argtypes = [ct.c_void_p, ct.c_double]
+    lib.kb_target_alive.restype = ct.c_int
+    lib.kb_target_alive.argtypes = [ct.c_void_p]
+    lib.kb_target_wait_done.restype = ct.c_int
+    lib.kb_target_wait_done.argtypes = [ct.c_void_p, ct.c_double]
+    lib.kb_target_fork.restype = ct.c_int
+    lib.kb_target_fork.argtypes = [ct.c_void_p, ct.c_double]
+    lib.kb_target_resume.restype = ct.c_int
+    lib.kb_target_resume.argtypes = [ct.c_void_p, ct.c_double]
+    lib.kb_target_trace_bits.restype = ct.POINTER(ct.c_uint8)
+    lib.kb_target_trace_bits.argtypes = [ct.c_void_p]
+    lib.kb_target_clear_trace.argtypes = [ct.c_void_p]
+    lib.kb_target_pid.restype = ct.c_int
+    lib.kb_target_pid.argtypes = [ct.c_void_p]
+    lib.kb_target_total_execs.restype = ct.c_long
+    lib.kb_target_total_execs.argtypes = [ct.c_void_p]
+    lib.kb_target_stop.argtypes = [ct.c_void_p]
+    lib.kb_target_free.argtypes = [ct.c_void_p]
+    lib.kb_last_error.restype = ct.c_char_p
+    _lib = lib
+    return lib
+
+
+def classify(status: int) -> Tuple[int, int]:
+    """(FUZZ_* verdict, exit_code) from a backend status code."""
+    if status == -1:
+        return FUZZ_HANG, -1
+    if status == -2:
+        return FUZZ_ERROR, -2
+    if status >= 512:
+        return FUZZ_CRASH, status - 512
+    return FUZZ_NONE, status
+
+
+class ExecTarget:
+    """One configured target; reusable across many executions."""
+
+    def __init__(self, argv: Sequence[str], *,
+                 use_stdin: bool = False,
+                 input_file: Optional[str] = None,
+                 use_forkserver: bool = False,
+                 preload: Optional[str] = None,
+                 use_preload_forkserver: bool = False,
+                 persistent: int = 0,
+                 deferred: bool = False,
+                 mem_limit_mb: int = 0,
+                 coverage: bool = False,
+                 timeout: float = 2.0):
+        self._lib = _load()
+        self.timeout = float(timeout)
+        self._owns_input_file = input_file is None and use_stdin
+        if self._owns_input_file:
+            fd, input_file = tempfile.mkstemp(prefix="kb_input_")
+            os.close(fd)
+        self.input_file = input_file
+        if use_preload_forkserver and not preload:
+            preload = preload_path()
+
+        c_argv = (ct.c_char_p * (len(argv) + 1))()
+        for i, a in enumerate(argv):
+            c_argv[i] = a.encode()
+        c_argv[len(argv)] = None
+        self._h = self._lib.kb_target_create(
+            c_argv, int(use_stdin),
+            input_file.encode() if input_file else None,
+            int(use_forkserver),
+            preload.encode() if preload else None,
+            int(persistent), int(deferred), int(mem_limit_mb),
+            int(coverage))
+        if not self._h:
+            raise RuntimeError(
+                f"kb_target_create: {self._lib.kb_last_error().decode()}")
+        self.coverage = coverage
+        self.use_forkserver = use_forkserver
+        self._started = False
+
+    def start(self, timeout: float = 10.0) -> None:
+        if self._lib.kb_target_start(self._h, timeout) != 0:
+            raise RuntimeError(
+                f"kb_target_start: {self._lib.kb_last_error().decode()}")
+        self._started = True
+
+    def _ensure_started(self) -> None:
+        if not self._started:
+            self.start()
+
+    def run(self, data: bytes, timeout: Optional[float] = None) -> int:
+        """Execute one input; returns the raw backend status code."""
+        self._ensure_started()
+        return self._lib.kb_target_run(
+            self._h, data, len(data),
+            self.timeout if timeout is None else timeout)
+
+    def run_batch(self, inputs: np.ndarray, lengths: np.ndarray,
+                  want_bitmaps: bool = True,
+                  timeout: Optional[float] = None
+                  ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Execute a [B, L] uint8 batch. Returns (statuses int32[B],
+        bitmaps uint8[B, 64K] or None). One ctypes call for the whole
+        batch — the C layer loops, clearing + copying the SHM bitmap
+        per exec."""
+        self._ensure_started()
+        inputs = np.ascontiguousarray(inputs, dtype=np.uint8)
+        lengths = np.ascontiguousarray(lengths, dtype=np.int32)
+        n, stride = inputs.shape
+        statuses = np.empty(n, dtype=np.int32)
+        bitmaps = (np.empty((n, KB_MAP_SIZE), dtype=np.uint8)
+                   if (want_bitmaps and self.coverage) else None)
+        done = self._lib.kb_target_run_batch(
+            self._h, inputs.ctypes.data_as(ct.c_void_p),
+            lengths.ctypes.data_as(ct.c_void_p), n, stride,
+            self.timeout if timeout is None else timeout,
+            statuses.ctypes.data_as(ct.c_void_p),
+            bitmaps.ctypes.data_as(ct.c_void_p)
+            if bitmaps is not None else None)
+        if done < n:
+            statuses[done:] = -2
+            if bitmaps is not None:
+                # never triage uninitialized rows: zero = no coverage
+                bitmaps[done:] = 0
+        return statuses, bitmaps
+
+    def launch(self, timeout: float = 10.0) -> int:
+        """Start one exec WITHOUT waiting (network-driver pattern:
+        start the server, talk to it, then wait_done). Returns pid."""
+        self._ensure_started()
+        pid = self._lib.kb_target_launch(self._h, timeout)
+        if pid <= 0:
+            raise RuntimeError(
+                f"kb_target_launch: {self._lib.kb_last_error().decode()}")
+        return pid
+
+    def alive(self) -> bool:
+        return bool(self._lib.kb_target_alive(self._h))
+
+    def wait_done(self, timeout: Optional[float] = None) -> int:
+        """Collect the verdict of a launch()ed exec; kills on timeout
+        (hang). Returns a raw backend status code."""
+        return self._lib.kb_target_wait_done(
+            self._h, self.timeout if timeout is None else timeout)
+
+    def fork_stopped(self, timeout: float = 10.0) -> int:
+        """FORK command: spawn a child left SIGSTOPped (tracer attach
+        window). Returns the child pid."""
+        self._ensure_started()
+        pid = self._lib.kb_target_fork(self._h, timeout)
+        if pid <= 0:
+            raise RuntimeError(
+                f"kb_target_fork: {self._lib.kb_last_error().decode()}")
+        return pid
+
+    def resume(self, timeout: Optional[float] = None) -> int:
+        """RUN + GET_STATUS on a forked child; returns a status code."""
+        return self._lib.kb_target_resume(
+            self._h, self.timeout if timeout is None else timeout)
+
+    def trace_bits(self) -> Optional[np.ndarray]:
+        """Zero-copy view of the live SHM coverage bitmap."""
+        if not self.coverage:
+            return None
+        ptr = self._lib.kb_target_trace_bits(self._h)
+        return np.ctypeslib.as_array(ptr, shape=(KB_MAP_SIZE,))
+
+    def clear_trace(self) -> None:
+        self._lib.kb_target_clear_trace(self._h)
+
+    def total_execs(self) -> int:
+        return int(self._lib.kb_target_total_execs(self._h))
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.kb_target_stop(self._h)
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.kb_target_free(self._h)
+            self._h = None
+        if self._owns_input_file and self.input_file:
+            try:
+                os.unlink(self.input_file)
+            except OSError:
+                pass
+            self.input_file = None
+
+    def __enter__(self) -> "ExecTarget":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass

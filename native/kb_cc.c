@@ -1,0 +1,71 @@
+/* kb-cc — compiler wrapper that builds targets with Killerbeez-TPU
+ * edge instrumentation (the role afl-gcc/afl-clang-fast play in the
+ * reference, SURVEY.md §2.5 — fresh implementation: instead of an
+ * assembler rewriter or LLVM pass we use GCC's built-in
+ * -fsanitize-coverage=trace-pc and link the kb_rt runtime that
+ * provides the __sanitizer_cov_trace_pc hook, SHM bitmap, forkserver
+ * and persistence).
+ *
+ * Usage: kb-cc [cc args...]           (C, via gcc)
+ *        kb-c++ [cc args...]          (C++, via g++; argv[0] switch)
+ * Env:   KB_CC / KB_CXX — override the real compiler
+ *        KB_RT_OBJ      — path to kb_rt.o (default: alongside kb-cc)
+ *        KB_CC_VERBOSE  — print the final command line
+ */
+#include <libgen.h>
+#include <limits.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+static char rt_path[PATH_MAX];
+
+static void find_rt(const char *argv0) {
+  const char *env = getenv("KB_RT_OBJ");
+  if (env) {
+    snprintf(rt_path, sizeof(rt_path), "%s", env);
+    return;
+  }
+  char self[PATH_MAX];
+  ssize_t n = readlink("/proc/self/exe", self, sizeof(self) - 1);
+  if (n > 0) {
+    self[n] = 0;
+  } else {
+    snprintf(self, sizeof(self), "%s", argv0);
+  }
+  char *dir = dirname(self);
+  snprintf(rt_path, sizeof(rt_path), "%s/kb_rt.o", dir);
+}
+
+int main(int argc, char **argv) {
+  int is_cxx = strstr(argv[0], "c++") != NULL || strstr(argv[0], "cxx");
+  const char *cc = is_cxx ? getenv("KB_CXX") : getenv("KB_CC");
+  if (!cc) cc = is_cxx ? "g++" : "gcc";
+  find_rt(argv[0]);
+
+  /* Compile-only invocations (-c/-E/-S) must not link the runtime. */
+  int linking = 1;
+  for (int i = 1; i < argc; i++)
+    if (!strcmp(argv[i], "-c") || !strcmp(argv[i], "-E") ||
+        !strcmp(argv[i], "-S"))
+      linking = 0;
+
+  char **out = calloc((size_t)argc + 8, sizeof(char *));
+  int n = 0;
+  out[n++] = (char *)cc;
+  for (int i = 1; i < argc; i++) out[n++] = argv[i];
+  out[n++] = "-fsanitize-coverage=trace-pc";
+  out[n++] = "-g";
+  out[n++] = "-fno-omit-frame-pointer";
+  if (linking) out[n++] = rt_path;
+  out[n] = NULL;
+
+  if (getenv("KB_CC_VERBOSE")) {
+    for (int i = 0; i < n; i++) fprintf(stderr, "%s ", out[i]);
+    fprintf(stderr, "\n");
+  }
+  execvp(cc, out);
+  perror("kb-cc: execvp");
+  return 127;
+}

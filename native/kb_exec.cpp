@@ -1,0 +1,665 @@
+/* kb_exec — host-side target execution backend (C++).
+ *
+ * The native twin of the fuzzer-side process control in the reference
+ * (SURVEY.md §2.3: reference instrumentation/instrumentation.c
+ * run_target / fork_server_init / fork_server_* command senders —
+ * re-implemented from scratch against the documented protocol in
+ * kb_protocol.h).  Exposed as a C ABI for ctypes.
+ *
+ * Responsibilities:
+ *   - spawn a target (plain fork+execve, or under the forkserver with
+ *     fds 198/199), with stdio redirection, setsid, rlimits, optional
+ *     LD_PRELOAD, sanitizer option defaults and the SHM env var;
+ *   - SysV SHM coverage region create/attach/clear;
+ *   - one-exec and batched dispatch: write input (file and/or stdin),
+ *     FORK_RUN or SIGCONT (persistence), poll the status pipe with a
+ *     timeout, classify exit/signal/hang;
+ *   - batch mode copies each exec's 64KB bitmap into a caller buffer
+ *     [n, 65536] so Python ships ONE array to the TPU for classify +
+ *     novelty instead of 65536-byte round trips per exec.
+ *
+ * Status encoding returned to Python:
+ *   0..255   normal exit code
+ *   512+sig  terminated by signal `sig`
+ *   -1       hang (killed after timeout)
+ *   -2       backend error (see kb_last_error)
+ */
+#include <cerrno>
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/ipc.h>
+#include <sys/resource.h>
+#include <sys/shm.h>
+#include <sys/stat.h>
+#include <sys/time.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include "kb_protocol.h"
+
+namespace {
+
+thread_local char g_err[512];
+
+void set_err(const char *fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(g_err, sizeof(g_err), fmt, ap);
+  va_end(ap);
+}
+
+double now_s() {
+  struct timeval tv;
+  gettimeofday(&tv, nullptr);
+  return tv.tv_sec + tv.tv_usec * 1e-6;
+}
+
+/* Read exactly n bytes from fd, waiting at most timeout_s.  Returns 0
+ * on success, -1 on timeout, -2 on error/EOF. */
+int read_timed(int fd, void *buf, size_t n, double timeout_s) {
+  char *p = static_cast<char *>(buf);
+  double deadline = now_s() + timeout_s;
+  while (n > 0) {
+    double left = deadline - now_s();
+    if (left <= 0) return -1;
+    struct pollfd pfd = {fd, POLLIN, 0};
+    int pr = poll(&pfd, 1, static_cast<int>(left * 1000) + 1);
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return -2;
+    }
+    if (pr == 0) return -1;
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) return -2;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct kb_target {
+  std::vector<std::string> argv;
+  std::string input_file;   /* staged input path ("" = none) */
+  std::string preload;      /* LD_PRELOAD library ("" = none) */
+  int use_stdin = 0;        /* input_file is also the target's stdin */
+  int use_forkserver = 0;
+  int persistent = 0;       /* persistence_max_cnt (0 = off) */
+  int deferred = 0;
+  long mem_limit_mb = 0;
+  int use_shm = 0;
+
+  /* runtime state */
+  int shm_id = -1;
+  unsigned char *trace_bits = nullptr;
+  pid_t forksrv_pid = -1;
+  pid_t child_pid = -1;
+  int ctl_fd = -1;   /* -> forkserver fd 198 */
+  int st_fd = -1;    /* <- forkserver fd 199 */
+  int input_fd = -1; /* shared-description fd for stdin delivery */
+  int child_stopped = 0; /* persistent child is SIGSTOPped */
+  int pending_status = 0; /* wstatus harvested early by kb_target_alive */
+  int pending_valid = 0;
+  long total_execs = 0;
+};
+
+const char *kb_last_error(void) { return g_err; }
+
+/* ------------------------------------------------------------------ */
+/* SHM                                                                 */
+/* ------------------------------------------------------------------ */
+
+static int setup_shm(kb_target *t) {
+  t->shm_id = shmget(IPC_PRIVATE, KB_MAP_SIZE, IPC_CREAT | IPC_EXCL | 0600);
+  if (t->shm_id < 0) {
+    set_err("shmget: %s", strerror(errno));
+    return -1;
+  }
+  t->trace_bits = static_cast<unsigned char *>(shmat(t->shm_id, nullptr, 0));
+  if (t->trace_bits == reinterpret_cast<unsigned char *>(-1)) {
+    set_err("shmat: %s", strerror(errno));
+    t->trace_bits = nullptr;
+    return -1;
+  }
+  /* Mark for removal now; the segment lives until the last detach, so
+   * no leak even if we crash. */
+  shmctl(t->shm_id, IPC_RMID, nullptr);
+  return 0;
+}
+
+/* ------------------------------------------------------------------ */
+/* Construction                                                        */
+/* ------------------------------------------------------------------ */
+
+kb_target *kb_target_create(const char *const *argv, int use_stdin,
+                            const char *input_file, int use_forkserver,
+                            const char *preload, int persistent,
+                            int deferred, long mem_limit_mb, int use_shm) {
+  if (!argv || !argv[0]) {
+    set_err("empty argv");
+    return nullptr;
+  }
+  auto *t = new kb_target();
+  for (int i = 0; argv[i]; i++) t->argv.emplace_back(argv[i]);
+  t->input_file = input_file ? input_file : "";
+  t->preload = preload ? preload : "";
+  t->use_stdin = use_stdin;
+  t->use_forkserver = use_forkserver;
+  t->persistent = persistent;
+  t->deferred = deferred;
+  t->mem_limit_mb = mem_limit_mb;
+  t->use_shm = use_shm;
+  if (use_shm && setup_shm(t) != 0) {
+    delete t;
+    return nullptr;
+  }
+  return t;
+}
+
+/* Child-side setup common to forkserver and plain spawns.  Never
+ * returns on failure. */
+static void child_setup(kb_target *t, int ctl_fd, int st_fd) {
+  setsid();
+  int devnull = open("/dev/null", O_RDWR);
+  if (!getenv("KB_DEBUG_CHILD")) {
+    dup2(devnull, 1);
+    dup2(devnull, 2);
+  }
+  if (t->use_stdin && t->input_fd >= 0) {
+    dup2(t->input_fd, 0);
+  } else {
+    dup2(devnull, 0);
+  }
+  if (devnull > 2) close(devnull);
+
+  if (ctl_fd >= 0) {
+    if (dup2(ctl_fd, KB_FORKSRV_FD) < 0 || dup2(st_fd, KB_STATUS_FD) < 0)
+      _exit(124);
+    if (ctl_fd != KB_FORKSRV_FD) close(ctl_fd);
+    if (st_fd != KB_STATUS_FD) close(st_fd);
+  }
+
+  if (t->mem_limit_mb > 0) {
+    struct rlimit rl;
+    rl.rlim_cur = rl.rlim_max =
+        static_cast<rlim_t>(t->mem_limit_mb) << 20;
+    setrlimit(RLIMIT_AS, &rl);
+  }
+  struct rlimit core = {0, 0};
+  setrlimit(RLIMIT_CORE, &core); /* crashes should not write cores */
+
+  if (t->use_shm) {
+    char buf[32];
+    snprintf(buf, sizeof(buf), "%d", t->shm_id);
+    setenv(KB_SHM_ENV, buf, 1);
+  }
+  if (!t->preload.empty()) setenv("LD_PRELOAD", t->preload.c_str(), 1);
+  if (t->persistent > 0) {
+    char buf[32];
+    snprintf(buf, sizeof(buf), "%d", t->persistent);
+    setenv(KB_PERSIST_ENV, buf, 1);
+  }
+  if (t->deferred) setenv(KB_DEFER_ENV, "1", 1);
+  setenv("LD_BIND_NOW", "1", 0); /* resolve PLT before the fork point */
+  /* Sanitizer defaults so crashes surface as signals / magic exit
+   * codes (reference sets the same class of defaults). */
+  setenv("ASAN_OPTIONS",
+         "abort_on_error=1:detect_leaks=0:symbolize=0:"
+         "allocator_may_return_null=1",
+         0);
+  setenv("MSAN_OPTIONS", "exit_code=86:symbolize=0", 0);
+
+  std::vector<char *> cargv;
+  for (auto &a : t->argv) cargv.push_back(const_cast<char *>(a.c_str()));
+  cargv.push_back(nullptr);
+  execv(cargv[0], cargv.data());
+  _exit(127);
+}
+
+/* Open the staged-input file with a shared description so lseek here
+ * repositions the target's inherited stdin.  Idempotent: a forkserver
+ * restart must NOT reopen (O_TRUNC would wipe an already-staged
+ * input). */
+static int open_input_fd(kb_target *t) {
+  if (t->input_file.empty() || t->input_fd >= 0) return 0;
+  t->input_fd = open(t->input_file.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0600);
+  if (t->input_fd < 0) {
+    set_err("open %s: %s", t->input_file.c_str(), strerror(errno));
+    return -1;
+  }
+  return 0;
+}
+
+int kb_target_start(kb_target *t, double timeout_s) {
+  if (open_input_fd(t) != 0) return -2;
+  if (!t->use_forkserver) return 0; /* plain mode spawns per exec */
+
+  int ctl[2], st[2];
+  if (pipe(ctl) != 0 || pipe(st) != 0) {
+    set_err("pipe: %s", strerror(errno));
+    return -2;
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    set_err("fork: %s", strerror(errno));
+    return -2;
+  }
+  if (pid == 0) {
+    close(ctl[1]);
+    close(st[0]);
+    child_setup(t, ctl[0], st[1]);
+  }
+  close(ctl[0]);
+  close(st[1]);
+  t->ctl_fd = ctl[1];
+  t->st_fd = st[0];
+  t->forksrv_pid = pid;
+
+  uint32_t hello = 0;
+  int r = read_timed(t->st_fd, &hello, 4, timeout_s);
+  if (r != 0 || hello != KB_HELLO) {
+    int status = 0;
+    /* Harvest the exec failure for diagnostics before reporting. */
+    waitpid(pid, &status, WNOHANG);
+    set_err("forkserver handshake failed (r=%d hello=0x%x wstatus=0x%x) "
+            "— is the target built with kb-cc or preloaded?",
+            r, hello, status);
+    kill(pid, SIGKILL);
+    waitpid(pid, nullptr, 0);
+    t->forksrv_pid = -1;
+    close(t->ctl_fd);
+    close(t->st_fd);
+    t->ctl_fd = t->st_fd = -1;
+    return -2;
+  }
+  return 0;
+}
+
+/* ------------------------------------------------------------------ */
+/* Execution                                                           */
+/* ------------------------------------------------------------------ */
+
+static int stage_input(kb_target *t, const uint8_t *input, int32_t len) {
+  if (t->input_fd < 0) return 0;
+  if (lseek(t->input_fd, 0, SEEK_SET) < 0 ||
+      write(t->input_fd, input, static_cast<size_t>(len)) != len ||
+      ftruncate(t->input_fd, len) != 0 ||
+      lseek(t->input_fd, 0, SEEK_SET) < 0) {
+    set_err("staging input: %s", strerror(errno));
+    return -1;
+  }
+  return 0;
+}
+
+static int classify_wstatus(int wstatus) {
+  if (WIFSIGNALED(wstatus)) return 512 + WTERMSIG(wstatus);
+  if (WIFEXITED(wstatus)) {
+    int code = WEXITSTATUS(wstatus);
+    if (code == 86) return 512 + SIGSEGV; /* MSAN magic exit */
+    return code;
+  }
+  return 0;
+}
+
+static void kill_forkserver(kb_target *t) {
+  if (t->child_pid > 0) kill(t->child_pid, SIGKILL);
+  if (t->forksrv_pid > 0) {
+    kill(t->forksrv_pid, SIGKILL);
+    waitpid(t->forksrv_pid, nullptr, 0);
+  }
+  if (t->ctl_fd >= 0) close(t->ctl_fd);
+  if (t->st_fd >= 0) close(t->st_fd);
+  t->ctl_fd = t->st_fd = -1;
+  t->forksrv_pid = t->child_pid = -1;
+  t->child_stopped = 0;
+}
+
+/* One exec through the forkserver.  Assumes input already staged. */
+static int forkserver_exec(kb_target *t, double timeout_s) {
+  unsigned char cmd;
+  if (t->child_stopped) {
+    cmd = KB_CMD_RUN; /* resume the persistent child */
+  } else {
+    cmd = KB_CMD_FORK_RUN;
+  }
+  if (write(t->ctl_fd, &cmd, 1) != 1) {
+    set_err("forkserver write failed: %s", strerror(errno));
+    return -2;
+  }
+  if (cmd == KB_CMD_FORK_RUN) {
+    int32_t pid = 0;
+    if (read_timed(t->st_fd, &pid, 4, timeout_s) != 0 || pid <= 0) {
+      set_err("forkserver did not return a child pid");
+      return -2;
+    }
+    t->child_pid = pid;
+  }
+  t->child_stopped = 0;
+
+  cmd = KB_CMD_GET_STATUS;
+  if (write(t->ctl_fd, &cmd, 1) != 1) {
+    set_err("forkserver write failed: %s", strerror(errno));
+    return -2;
+  }
+  int32_t wstatus = 0;
+  int r = read_timed(t->st_fd, &wstatus, 4, timeout_s);
+  if (r == -1) {
+    /* Hang: kill the run; the forkserver's pending waitpid completes
+     * and sends the (now SIGKILL) status, which we must drain. */
+    if (t->child_pid > 0) kill(t->child_pid, SIGKILL);
+    if (read_timed(t->st_fd, &wstatus, 4, 2.0) != 0) {
+      kill_forkserver(t); /* wedged beyond recovery */
+      return -1;
+    }
+    t->child_pid = -1;
+    return -1;
+  }
+  if (r != 0) {
+    set_err("forkserver status read failed");
+    return -2;
+  }
+  if (WIFSTOPPED(wstatus)) {
+    /* Persistent iteration boundary: child alive, input consumed. */
+    t->child_stopped = 1;
+    return 0;
+  }
+  t->child_pid = -1;
+  return classify_wstatus(wstatus);
+}
+
+/* One plain fork+execve exec. */
+static int plain_exec(kb_target *t, double timeout_s) {
+  pid_t pid = fork();
+  if (pid < 0) {
+    set_err("fork: %s", strerror(errno));
+    return -2;
+  }
+  if (pid == 0) child_setup(t, -1, -1);
+  t->child_pid = pid;
+
+  double deadline = now_s() + timeout_s;
+  int wstatus = 0;
+  for (;;) {
+    pid_t r = waitpid(pid, &wstatus, WNOHANG);
+    if (r == pid) break;
+    if (r < 0) {
+      set_err("waitpid: %s", strerror(errno));
+      return -2;
+    }
+    if (now_s() > deadline) {
+      kill(pid, SIGKILL);
+      waitpid(pid, &wstatus, 0);
+      t->child_pid = -1;
+      return -1;
+    }
+    usleep(200);
+  }
+  t->child_pid = -1;
+  return classify_wstatus(wstatus);
+}
+
+int kb_target_run(kb_target *t, const uint8_t *input, int32_t len,
+                  double timeout_s) {
+  if (stage_input(t, input, len) != 0) return -2;
+  t->total_execs++;
+  if (!t->use_forkserver) return plain_exec(t, timeout_s);
+  if (t->forksrv_pid < 0) {
+    /* (Re)start a dead forkserver transparently. */
+    if (kb_target_start(t, timeout_s > 10 ? timeout_s : 10) != 0) return -2;
+  }
+  int st = forkserver_exec(t, timeout_s);
+  if (st == -2) {
+    /* One restart attempt per exec: a crashed forkserver (e.g. the
+     * persistent child wrecked shared state) should not end the
+     * campaign. */
+    kill_forkserver(t);
+    if (kb_target_start(t, 10) != 0) return -2;
+    st = forkserver_exec(t, timeout_s);
+  }
+  return st;
+}
+
+int kb_target_run_batch(kb_target *t, const uint8_t *inputs,
+                        const int32_t *lens, int n, int stride,
+                        double timeout_s, int32_t *statuses_out,
+                        uint8_t *bitmaps_out) {
+  for (int i = 0; i < n; i++) {
+    if (t->trace_bits) memset(t->trace_bits, 0, KB_MAP_SIZE);
+    int st = kb_target_run(t, inputs + static_cast<size_t>(i) * stride,
+                           lens[i], timeout_s);
+    statuses_out[i] = st;
+    if (bitmaps_out && t->trace_bits)
+      memcpy(bitmaps_out + static_cast<size_t>(i) * KB_MAP_SIZE,
+             t->trace_bits, KB_MAP_SIZE);
+    if (st == -2) return i; /* backend error: report execs completed */
+  }
+  return n;
+}
+
+/* Async pair for drivers that interact with a RUNNING target (network
+ * servers/clients): launch starts one exec and returns the pid without
+ * waiting; wait_done collects the verdict afterwards (reference
+ * pattern: enable starts the process, the driver talks to it, then
+ * generic_wait_for_process_completion polls — SURVEY §2.2). */
+int kb_target_launch(kb_target *t, double timeout_s) {
+  t->total_execs++;
+  if (!t->use_forkserver) {
+    pid_t pid = fork();
+    if (pid < 0) {
+      set_err("fork: %s", strerror(errno));
+      return -2;
+    }
+    if (pid == 0) child_setup(t, -1, -1);
+    t->child_pid = pid;
+    return pid;
+  }
+  if (t->forksrv_pid < 0 && kb_target_start(t, 10) != 0) return -2;
+  unsigned char cmd = KB_CMD_FORK_RUN;
+  if (write(t->ctl_fd, &cmd, 1) != 1) {
+    set_err("forkserver write failed: %s", strerror(errno));
+    return -2;
+  }
+  int32_t pid = 0;
+  if (read_timed(t->st_fd, &pid, 4, timeout_s) != 0 || pid <= 0) {
+    set_err("forkserver did not return a child pid");
+    kill_forkserver(t);
+    return -2;
+  }
+  t->child_pid = pid;
+  t->child_stopped = 0;
+  return pid;
+}
+
+/* 1 = the launched child is still running, 0 = done/absent. */
+int kb_target_alive(kb_target *t) {
+  if (t->child_pid <= 0) return 0;
+  if (!t->use_forkserver) {
+    int st;
+    pid_t r = waitpid(t->child_pid, &st, WNOHANG);
+    if (r == t->child_pid) {
+      /* Done: remember the status for kb_target_wait_done. */
+      t->child_stopped = 0;
+      t->child_pid = -1;
+      t->pending_status = st;
+      t->pending_valid = 1;
+      return 0;
+    }
+    return r == 0;
+  }
+  /* Forkserver child is not our direct child (the forkserver reaps it
+   * on GET_STATUS), so a crashed-at-startup target lingers as a
+   * zombie that kill(pid, 0) still sees.  Read the state field of
+   * /proc/<pid>/stat instead: 'Z'/'X' = done. */
+  char path[64], buf[256];
+  snprintf(path, sizeof(path), "/proc/%d/stat", (int)t->child_pid);
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return 0; /* gone entirely */
+  ssize_t n = read(fd, buf, sizeof(buf) - 1);
+  close(fd);
+  if (n <= 0) return 0;
+  buf[n] = 0;
+  /* state is the first non-space char after the ")" that closes comm */
+  const char *p = strrchr(buf, ')');
+  if (!p) return 0;
+  p++;
+  while (*p == ' ') p++;
+  return *p != 'Z' && *p != 'X' && *p != 0;
+}
+
+int kb_target_wait_done(kb_target *t, double timeout_s) {
+  if (!t->use_forkserver) {
+    if (t->pending_valid) {
+      t->pending_valid = 0;
+      return classify_wstatus(t->pending_status);
+    }
+    if (t->child_pid <= 0) {
+      set_err("no launched child to wait for");
+      return -2;
+    }
+    double deadline = now_s() + timeout_s;
+    int wstatus = 0;
+    for (;;) {
+      pid_t r = waitpid(t->child_pid, &wstatus, WNOHANG);
+      if (r == t->child_pid) break;
+      if (r < 0) {
+        set_err("waitpid: %s", strerror(errno));
+        return -2;
+      }
+      if (now_s() > deadline) {
+        kill(t->child_pid, SIGKILL);
+        waitpid(t->child_pid, &wstatus, 0);
+        t->child_pid = -1;
+        return -1;
+      }
+      usleep(500);
+    }
+    t->child_pid = -1;
+    return classify_wstatus(wstatus);
+  }
+  unsigned char cmd = KB_CMD_GET_STATUS;
+  if (write(t->ctl_fd, &cmd, 1) != 1) {
+    set_err("forkserver write failed: %s", strerror(errno));
+    return -2;
+  }
+  int32_t wstatus = 0;
+  int r = read_timed(t->st_fd, &wstatus, 4, timeout_s);
+  if (r == -1) {
+    if (t->child_pid > 0) kill(t->child_pid, SIGKILL);
+    if (read_timed(t->st_fd, &wstatus, 4, 2.0) != 0) {
+      kill_forkserver(t);
+      return -1;
+    }
+    t->child_pid = -1;
+    return -1;
+  }
+  if (r != 0) {
+    set_err("forkserver status read failed");
+    return -2;
+  }
+  t->child_pid = -1;
+  return classify_wstatus(wstatus);
+}
+
+/* FORK (stopped child) + RUN split — the attach window an external
+ * tracer (perf, ptrace) needs between fork and first instruction
+ * (reference fork_server_fork / fork_server_run pair). */
+int kb_target_fork(kb_target *t, double timeout_s) {
+  if (!t->use_forkserver || t->forksrv_pid < 0) {
+    set_err("fork command requires a running forkserver");
+    return -2;
+  }
+  unsigned char cmd = KB_CMD_FORK;
+  if (write(t->ctl_fd, &cmd, 1) != 1) return -2;
+  int32_t pid = 0;
+  if (read_timed(t->st_fd, &pid, 4, timeout_s) != 0 || pid <= 0) {
+    set_err("fork: no child pid");
+    return -2;
+  }
+  t->child_pid = pid;
+  t->child_stopped = 1;
+  return pid;
+}
+
+int kb_target_resume(kb_target *t, double timeout_s) {
+  if (t->child_pid <= 0) {
+    set_err("no forked child to resume");
+    return -2;
+  }
+  unsigned char cmd = KB_CMD_RUN;
+  if (write(t->ctl_fd, &cmd, 1) != 1) return -2;
+  t->child_stopped = 0;
+  cmd = KB_CMD_GET_STATUS;
+  if (write(t->ctl_fd, &cmd, 1) != 1) return -2;
+  int32_t wstatus = 0;
+  int r = read_timed(t->st_fd, &wstatus, 4, timeout_s);
+  if (r == -1) {
+    if (t->child_pid > 0) kill(t->child_pid, SIGKILL);
+    if (read_timed(t->st_fd, &wstatus, 4, 2.0) != 0) {
+      kill_forkserver(t);
+      return -1;
+    }
+    t->child_pid = -1;
+    return -1;
+  }
+  if (r != 0) return -2;
+  if (WIFSTOPPED(wstatus)) {
+    t->child_stopped = 1;
+    return 0;
+  }
+  t->child_pid = -1;
+  return classify_wstatus(wstatus);
+}
+
+/* ------------------------------------------------------------------ */
+/* Introspection / teardown                                            */
+/* ------------------------------------------------------------------ */
+
+const uint8_t *kb_target_trace_bits(kb_target *t) { return t->trace_bits; }
+
+void kb_target_clear_trace(kb_target *t) {
+  if (t->trace_bits) memset(t->trace_bits, 0, KB_MAP_SIZE);
+}
+
+int kb_target_pid(kb_target *t) { return static_cast<int>(t->child_pid); }
+
+long kb_target_total_execs(kb_target *t) { return t->total_execs; }
+
+void kb_target_stop(kb_target *t) {
+  if (t->use_forkserver && t->forksrv_pid > 0 && t->ctl_fd >= 0) {
+    unsigned char cmd = KB_CMD_EXIT;
+    if (write(t->ctl_fd, &cmd, 1) == 1) {
+      /* Give it a moment to exit cleanly, then force. */
+      double deadline = now_s() + 1.0;
+      int status;
+      while (now_s() < deadline &&
+             waitpid(t->forksrv_pid, &status, WNOHANG) == 0)
+        usleep(1000);
+    }
+  }
+  kill_forkserver(t);
+}
+
+void kb_target_free(kb_target *t) {
+  if (!t) return;
+  kb_target_stop(t);
+  if (t->input_fd >= 0) close(t->input_fd);
+  if (t->trace_bits) shmdt(t->trace_bits);
+  delete t;
+}
+
+int kb_map_size(void) { return KB_MAP_SIZE; }
+
+}  // extern "C"

@@ -1,0 +1,91 @@
+/* kb_preload — LD_PRELOAD forkserver for targets that were NOT built
+ * with kb-cc (no compiled-in runtime).  Interposes glibc's
+ * __libc_start_main so the forkserver starts exactly at the main()
+ * entry point, after dynamic linking is finished — the same hook point
+ * the reference's hooking library uses (SURVEY.md §2.3, reference
+ * instrumentation/forkserver_hooking.c behavior; fresh implementation).
+ *
+ * No coverage: this library only removes execve cost.  Pair it with
+ * return_code instrumentation, or with targets whose coverage comes
+ * from elsewhere.
+ *
+ * Env knobs:
+ *   KB_NO_FORKSERVER=1  — disable entirely (run normally)
+ *   KB_DEFER_FORKSRV=1  — not supported here (no target cooperation);
+ *                         use the compiled-in runtime for deferral.
+ */
+#define _GNU_SOURCE
+#include <dlfcn.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include "kb_protocol.h"
+
+typedef int (*kb_main_fn)(int, char **, char **);
+static kb_main_fn kb_real_main;
+
+static void kb_forkserver(void) {
+  uint32_t hello = KB_HELLO;
+  if (write(KB_STATUS_FD, &hello, 4) != 4) return; /* no fuzzer */
+
+  pid_t child_pid = -1;
+  for (;;) {
+    unsigned char cmd;
+    if (read(KB_FORKSRV_FD, &cmd, 1) != 1) _exit(0);
+    switch (cmd) {
+      case KB_CMD_EXIT:
+        if (child_pid > 0) kill(child_pid, SIGKILL);
+        _exit(0);
+      case KB_CMD_FORK:
+      case KB_CMD_FORK_RUN: {
+        child_pid = fork();
+        if (child_pid < 0) _exit(1);
+        if (child_pid == 0) {
+          close(KB_FORKSRV_FD);
+          close(KB_STATUS_FD);
+          if (cmd == KB_CMD_FORK) raise(SIGSTOP);
+          return; /* fall through into the real main() */
+        }
+        int32_t pid32 = (int32_t)child_pid;
+        if (write(KB_STATUS_FD, &pid32, 4) != 4) _exit(1);
+        break;
+      }
+      case KB_CMD_RUN:
+        if (child_pid > 0) kill(child_pid, SIGCONT);
+        break;
+      case KB_CMD_GET_STATUS: {
+        int status = -1;
+        if (child_pid > 0) {
+          if (waitpid(child_pid, &status, WUNTRACED) < 0) status = -1;
+          if (!WIFSTOPPED(status)) child_pid = -1;
+        }
+        int32_t st32 = (int32_t)status;
+        if (write(KB_STATUS_FD, &st32, 4) != 4) _exit(1);
+        break;
+      }
+      default:
+        _exit(2);
+    }
+  }
+}
+
+static int kb_wrapped_main(int argc, char **argv, char **envp) {
+  if (!getenv("KB_NO_FORKSERVER")) kb_forkserver();
+  return kb_real_main(argc, argv, envp);
+}
+
+int __libc_start_main(kb_main_fn main_fn, int argc, char **argv,
+                      void (*init)(void), void (*fini)(void),
+                      void (*rtld_fini)(void), void *stack_end) {
+  typedef int (*start_fn)(kb_main_fn, int, char **, void (*)(void),
+                          void (*)(void), void (*)(void), void *);
+  start_fn real_start =
+      (start_fn)dlsym(RTLD_NEXT, "__libc_start_main");
+  kb_real_main = main_fn;
+  return real_start(kb_wrapped_main, argc, argv, init, fini, rtld_fini,
+                    stack_end);
+}

@@ -1,0 +1,32 @@
+/* Killerbeez-TPU native protocol constants.
+ *
+ * Wire-compatible with the reference forkserver contract described in
+ * SURVEY.md §2.3 (reference instrumentation/forkserver_internal.h:8-20,
+ * docs/AFL.md:28-43): 1-byte commands on fd 198, 4-byte little-endian
+ * int responses on fd 199, coverage in a SysV SHM region advertised by
+ * the __AFL_SHM_ID env var (reference afl_progs/config.h:267,308).
+ * Implementation here is from scratch against that documented contract.
+ */
+#ifndef KB_PROTOCOL_H
+#define KB_PROTOCOL_H
+
+#define KB_FORKSRV_FD 198   /* fuzzer -> forkserver commands */
+#define KB_STATUS_FD  199   /* forkserver -> fuzzer responses */
+
+#define KB_CMD_EXIT       0
+#define KB_CMD_FORK       1   /* fork a child but leave it SIGSTOPped  */
+#define KB_CMD_RUN        2   /* SIGCONT the forked child              */
+#define KB_CMD_FORK_RUN   3   /* fork and run immediately              */
+#define KB_CMD_GET_STATUS 4   /* waitpid the child, return its status  */
+
+#define KB_SHM_ENV       "__AFL_SHM_ID"
+#define KB_PERSIST_ENV   "PERSISTENCE_MAX_CNT"
+#define KB_DEFER_ENV     "KB_DEFER_FORKSRV"
+#define KB_MAP_SIZE_POW2 16
+#define KB_MAP_SIZE      (1 << KB_MAP_SIZE_POW2)
+
+/* Handshake: the forkserver announces itself with this 4-byte magic on
+ * KB_STATUS_FD as soon as it is ready for commands. */
+#define KB_HELLO 0x4b42465aU /* "KBFZ" */
+
+#endif /* KB_PROTOCOL_H */

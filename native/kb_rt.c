@@ -1,0 +1,167 @@
+/* kb_rt — target-side instrumentation runtime (compiled into targets
+ * by the kb-cc wrapper together with -fsanitize-coverage=trace-pc).
+ *
+ * Three jobs, mirroring the behavior of the reference's compiled-in
+ * runtime (SURVEY.md §2.5, reference afl_progs/llvm_mode/afl-llvm-rt.o.c
+ * semantics — implementation here is fresh, built on GCC sancov):
+ *
+ *   1. Edge coverage: __sanitizer_cov_trace_pc() is invoked by the
+ *      compiler at every edge; we hash the call site PC into a 64KB
+ *      bitmap slot and do trace_bits[cur ^ prev]++, prev = cur >> 1 —
+ *      the classic AFL edge transition encoding.
+ *   2. Forkserver: before main (ELF constructor), speak the protocol in
+ *      kb_protocol.h over fds 198/199 so the fuzzer pays fork+COW per
+ *      exec instead of fork+execve.  Deferred mode (KB_DEFER_FORKSRV=1)
+ *      skips the constructor; the target calls __kb_manual_init() at a
+ *      point of its choosing.
+ *   3. Persistence: __kb_persistent_loop(n) lets one process run n
+ *      inputs, signalling iteration boundaries with SIGSTOP and being
+ *      resumed with SIGCONT (reference forkserver.c persistence
+ *      contract per SURVEY.md §2.3).
+ */
+#include <signal.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/shm.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include "kb_protocol.h"
+
+static unsigned char kb_dummy_map[KB_MAP_SIZE];
+unsigned char *__kb_trace_bits = kb_dummy_map;
+
+static __thread uintptr_t kb_prev_loc;
+static int kb_forkserver_up;
+static int kb_persist_active = -1; /* -1 = not yet checked */
+
+/* ------------------------------------------------------------------ */
+/* Coverage                                                            */
+/* ------------------------------------------------------------------ */
+
+/* Mix the return address into a bitmap slot.  The shift folds out the
+ * low alignment bits; the xor-shift spreads nearby PCs across the map
+ * (same role as afl-as's per-block random ids, but derived from the PC
+ * because sancov gives us no compile-time id). */
+/* kb_rt.o is compiled WITHOUT -fsanitize-coverage, so this hook is
+ * never itself instrumented (no recursion risk). */
+void __sanitizer_cov_trace_pc(void) {
+  uintptr_t pc = (uintptr_t)__builtin_return_address(0);
+  uintptr_t h = pc;
+  h ^= h >> 33;
+  h *= 0xff51afd7ed558ccdULL;
+  h ^= h >> 29;
+  uintptr_t cur = h & (KB_MAP_SIZE - 1);
+  __kb_trace_bits[cur ^ kb_prev_loc]++;
+  kb_prev_loc = cur >> 1;
+}
+
+static void kb_map_shm(void) {
+  const char *id_str = getenv(KB_SHM_ENV);
+  if (!id_str) return;
+  void *addr = shmat(atoi(id_str), NULL, 0);
+  if (addr != (void *)-1) __kb_trace_bits = (unsigned char *)addr;
+}
+
+/* ------------------------------------------------------------------ */
+/* Forkserver                                                          */
+/* ------------------------------------------------------------------ */
+
+static void kb_forkserver(void) {
+  uint32_t hello = KB_HELLO;
+  /* If fd 199 isn't wired up there is no fuzzer: run normally. */
+  if (write(KB_STATUS_FD, &hello, 4) != 4) return;
+  kb_forkserver_up = 1;
+
+  pid_t child_pid = -1;
+  for (;;) {
+    unsigned char cmd;
+    if (read(KB_FORKSRV_FD, &cmd, 1) != 1) _exit(0);
+    switch (cmd) {
+      case KB_CMD_EXIT:
+        if (child_pid > 0) kill(child_pid, SIGKILL);
+        _exit(0);
+
+      case KB_CMD_FORK:
+      case KB_CMD_FORK_RUN: {
+        child_pid = fork();
+        if (child_pid < 0) _exit(1);
+        if (child_pid == 0) {
+          close(KB_FORKSRV_FD);
+          close(KB_STATUS_FD);
+          if (cmd == KB_CMD_FORK) raise(SIGSTOP); /* let fuzzer attach */
+          kb_prev_loc = 0;
+          return; /* continue into main() */
+        }
+        int32_t pid32 = (int32_t)child_pid;
+        if (write(KB_STATUS_FD, &pid32, 4) != 4) _exit(1);
+        break;
+      }
+
+      case KB_CMD_RUN:
+        if (child_pid > 0) kill(child_pid, SIGCONT);
+        break;
+
+      case KB_CMD_GET_STATUS: {
+        int status = -1;
+        if (child_pid > 0) {
+          if (waitpid(child_pid, &status, WUNTRACED) < 0) status = -1;
+          if (!WIFSTOPPED(status)) child_pid = -1;
+        }
+        int32_t st32 = (int32_t)status;
+        if (write(KB_STATUS_FD, &st32, 4) != 4) _exit(1);
+        break;
+      }
+
+      default:
+        _exit(2);
+    }
+  }
+}
+
+void __kb_manual_init(void) {
+  static int done;
+  if (done) return;
+  done = 1;
+  kb_map_shm();
+  kb_forkserver();
+}
+
+__attribute__((constructor))
+static void kb_auto_init(void) {
+  if (getenv(KB_DEFER_ENV)) {
+    kb_map_shm(); /* coverage from process start even when deferred */
+    return;
+  }
+  __kb_manual_init();
+}
+
+/* ------------------------------------------------------------------ */
+/* Persistence                                                         */
+/* ------------------------------------------------------------------ */
+
+/* while (__kb_persistent_loop(1000)) { one_input(); }
+ *
+ * Without PERSISTENCE_MAX_CNT in the environment the body runs exactly
+ * once (plain fork-per-exec).  With it, each completed iteration
+ * SIGSTOPs so the fuzzer can harvest the bitmap and stage the next
+ * input before SIGCONTing us. */
+int __kb_persistent_loop(unsigned max_cnt) {
+  static unsigned iter, env_cap;
+  if (kb_persist_active < 0) {
+    const char *env = getenv(KB_PERSIST_ENV);
+    kb_persist_active = env != NULL;
+    if (env && atoi(env) > 0) env_cap = (unsigned)atoi(env);
+  }
+  if (!kb_persist_active) return iter++ == 0;
+  if (env_cap && (!max_cnt || env_cap < max_cnt)) max_cnt = env_cap;
+  if (iter) {
+    raise(SIGSTOP); /* iteration boundary; resumed by SIGCONT */
+  }
+  if (max_cnt && iter >= max_cnt) return 0; /* exit -> fuzzer re-forks */
+  iter++;
+  kb_prev_loc = 0;
+  return 1;
+}

@@ -19,10 +19,33 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import subprocess  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS_BUILD = os.path.join(REPO_ROOT, "corpus", "build")
 
 
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+@pytest.fixture(scope="session")
+def corpus_bin():
+    """Build native/ + corpus/ fixtures once; returns a path resolver.
+    Skips dependent tests when the host toolchain can't build them."""
+    from killerbeez_tpu.native.build import build_error, build_native
+    if not build_native():
+        pytest.skip(f"native build unavailable: {build_error()}")
+    proc = subprocess.run(["make", "-C", os.path.join(REPO_ROOT, "corpus")],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        pytest.skip(f"corpus build failed: {proc.stderr[-500:]}")
+
+    def path(name: str) -> str:
+        return os.path.join(CORPUS_BUILD, name)
+
+    return path

@@ -1,0 +1,161 @@
+"""afl instrumentation tests: forkserver + SHM bitmap + virgin-map
+novelty on real host binaries, through the instrumentation vtable and
+the full fuzzer loop (reference smoke_test.sh behavioral gates,
+SURVEY §4: exact new-path counts on the fixture, crash found from the
+one-bit-away seed, state round-trip and merge).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from killerbeez_tpu import FUZZ_CRASH, FUZZ_NONE
+from killerbeez_tpu.drivers.factory import driver_factory
+from killerbeez_tpu.fuzzer.loop import Fuzzer
+from killerbeez_tpu.instrumentation.factory import instrumentation_factory
+from killerbeez_tpu.mutators.factory import mutator_factory
+
+
+def make_stack(corpus_bin, mutator="bit_flip", seed=b"ABC@",
+               instr_opts=None, driver="stdin", mut_opts=None):
+    instr = instrumentation_factory("afl", json.dumps(instr_opts or {}))
+    mut = mutator_factory(mutator, mut_opts, seed)
+    dopts = {"path": corpus_bin("test")}
+    if driver == "file":
+        dopts["arguments"] = "@@"
+    drv = driver_factory(driver, json.dumps(dopts), instr, mut)
+    return drv, instr, mut
+
+
+def test_single_exec_crash_and_novelty(corpus_bin):
+    drv, instr, _ = make_stack(corpus_bin)
+    # first exec of any input is a new path on a fresh virgin map
+    assert drv.test_input(b"zzzz") == FUZZ_NONE
+    assert instr.is_new_path() > 0
+    assert drv.test_input(b"zzzz") == FUZZ_NONE
+    assert instr.is_new_path() == 0  # same path twice
+    assert drv.test_input(b"ABCD") == FUZZ_CRASH
+    assert instr.last_unique_crash()
+    drv.cleanup()
+    instr.cleanup()
+
+
+def test_new_path_counts_exact(corpus_bin):
+    """Prefix-matching inputs produce exactly one new path each, in
+    any order of discovery depth (reference smoke-test's exact
+    new-path-count assertions)."""
+    drv, instr, _ = make_stack(corpus_bin)
+    inputs = [b"zzzz", b"Azzz", b"ABzz", b"ABCz"]
+    new_paths = 0
+    for s in inputs:
+        drv.test_input(s)
+        new_paths += int(instr.is_new_path() > 0)
+    assert new_paths == 4
+    # replays add nothing
+    for s in inputs:
+        drv.test_input(s)
+        assert instr.is_new_path() == 0
+    drv.cleanup()
+    instr.cleanup()
+
+
+def test_bit_flip_finds_crash_from_close_seed(corpus_bin):
+    """Seed 'ABC@' is one bit from 'ABCD': deterministic bit_flip must
+    find the crash within its 32 flips (reference README scenario)."""
+    drv, instr, _ = make_stack(corpus_bin, mutator="bit_flip")
+    fz = Fuzzer(drv, write_findings=False, batch_size=8)
+    stats = fz.run(32)
+    assert stats.crashes >= 1
+    assert stats.unique_crashes >= 1
+    assert stats.new_paths >= 2
+    drv.cleanup()
+    instr.cleanup()
+
+
+def test_batched_matches_single_exec_counts(corpus_bin):
+    """The batched TPU-triage path reports the same unique new-path
+    set as the single-exec loop on the same candidate stream."""
+    drv1, instr1, _ = make_stack(corpus_bin, mutator="bit_flip")
+    fz1 = Fuzzer(drv1, write_findings=False, batch_size=8)
+    s1 = fz1.run(32)
+
+    drv2, instr2, _ = make_stack(corpus_bin, mutator="bit_flip")
+    # batch_size=1 forces one-lane batches through the same machinery
+    fz2 = Fuzzer(drv2, write_findings=False, batch_size=1)
+    s2 = fz2.run(32)
+    assert s1.crashes == s2.crashes
+    assert s1.new_paths == s2.new_paths
+    for d, i in ((drv1, instr1), (drv2, instr2)):
+        d.cleanup()
+        i.cleanup()
+
+
+def test_file_driver_batched(corpus_bin):
+    drv, instr, _ = make_stack(corpus_bin, mutator="bit_flip",
+                               driver="file")
+    fz = Fuzzer(drv, write_findings=False, batch_size=16)
+    stats = fz.run(32)
+    assert stats.crashes >= 1
+    drv.cleanup()
+    instr.cleanup()
+
+
+def test_state_roundtrip_and_merge(corpus_bin):
+    drv, instr, _ = make_stack(corpus_bin)
+    drv.test_input(b"zzzz")
+    drv.test_input(b"Azzz")
+    state = instr.get_state()
+    d = json.loads(state)
+    assert d["instrumentation"] == "afl"
+    assert d["total_execs"] == 2
+
+    fresh = instrumentation_factory("afl", None)
+    fresh.set_state(state)
+    assert fresh.total_execs == 2
+    assert np.array_equal(fresh.virgin_bits, instr.virgin_bits)
+
+    # merge: disjoint coverage ANDs together
+    other = instrumentation_factory("afl", None)
+    drv2, instr2, _ = make_stack(corpus_bin)
+    drv2.test_input(b"ABzz")
+    other.merge(instr2.get_state())
+    other.merge(state)
+    both = (np.asarray(other.virgin_bits) != 0xFF).sum()
+    assert both >= (np.asarray(instr.virgin_bits) != 0xFF).sum()
+    for d_, i_ in ((drv, instr), (drv2, instr2)):
+        d_.cleanup()
+        i_.cleanup()
+
+
+def test_persistence_option(corpus_bin):
+    instr = instrumentation_factory(
+        "afl", json.dumps({"persistence_max_cnt": 8}))
+    mut = mutator_factory("havoc", '{"seed": 7}', b"ABC@")
+    drv = driver_factory(
+        "stdin", json.dumps({"path": corpus_bin("test-persist")}),
+        instr, mut)
+    fz = Fuzzer(drv, write_findings=False, batch_size=64)
+    stats = fz.run(256)
+    assert stats.iterations == 256
+    assert stats.errors == 0
+    drv.cleanup()
+    instr.cleanup()
+
+
+def test_no_forkserver_mode(corpus_bin):
+    instr = instrumentation_factory(
+        "afl", json.dumps({"use_fork_server": 0}))
+    mut = mutator_factory("bit_flip", None, b"ABC@")
+    drv = driver_factory(
+        "stdin", json.dumps({"path": corpus_bin("test")}), instr, mut)
+    fz = Fuzzer(drv, write_findings=False, batch_size=8)
+    stats = fz.run(32)
+    assert stats.crashes >= 1
+    drv.cleanup()
+    instr.cleanup()
+
+
+def test_qemu_mode_gated():
+    with pytest.raises(ValueError, match="qemu"):
+        instrumentation_factory("afl", json.dumps({"qemu_mode": 1}))

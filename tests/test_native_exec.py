@@ -1,0 +1,122 @@
+"""Native exec backend + forkserver protocol tests (native/kb_exec.cpp,
+kb_rt.c, kb_preload.c) against the corpus fixture binaries.
+
+Mirrors the reference's smoke-test style behavioral assertions
+(SURVEY §4): crash on the full magic, no crash one byte short, hang
+detection by timeout, forkserver vs plain spawn equivalence,
+persistence, preload forkserver, and coverage monotonicity as the
+input homes in on the magic.
+"""
+
+import numpy as np
+import pytest
+
+from killerbeez_tpu import FUZZ_CRASH, FUZZ_HANG, FUZZ_NONE
+from killerbeez_tpu.native.exec_backend import (
+    ExecTarget, KB_MAP_SIZE, classify,
+)
+
+
+def test_classify_codes():
+    assert classify(0) == (FUZZ_NONE, 0)
+    assert classify(7) == (FUZZ_NONE, 7)
+    assert classify(512 + 11) == (FUZZ_CRASH, 11)
+    assert classify(-1) == (FUZZ_HANG, -1)
+
+
+@pytest.mark.parametrize("use_forkserver", [False, True])
+def test_crash_verdicts(corpus_bin, use_forkserver):
+    with ExecTarget([corpus_bin("test")], use_stdin=True,
+                    use_forkserver=use_forkserver, coverage=True,
+                    timeout=2.0) as t:
+        assert classify(t.run(b"ABC@"))[0] == FUZZ_NONE
+        assert classify(t.run(b"ABCD"))[0] == FUZZ_CRASH
+        assert classify(t.run(b"zzzz"))[0] == FUZZ_NONE
+
+
+def test_hang_detection(corpus_bin):
+    with ExecTarget([corpus_bin("hang")], use_stdin=True,
+                    use_forkserver=True, timeout=0.3) as t:
+        assert classify(t.run(b"Hang"))[0] == FUZZ_HANG
+        # the forkserver survives the killed hang
+        assert classify(t.run(b"okay"))[0] == FUZZ_NONE
+
+
+def test_coverage_deepens_with_prefix(corpus_bin):
+    """Each matched magic byte enters a new block: strictly more edges."""
+    with ExecTarget([corpus_bin("test")], use_stdin=True,
+                    use_forkserver=True, coverage=True) as t:
+        counts = []
+        for s in (b"zzzz", b"Azzz", b"ABzz", b"ABCz"):
+            t.clear_trace()
+            t.run(s)
+            counts.append(int((t.trace_bits() != 0).sum()))
+        assert counts == sorted(counts)
+        assert counts[0] < counts[-1]
+
+
+def test_coverage_deterministic(corpus_bin):
+    with ExecTarget([corpus_bin("test")], use_stdin=True,
+                    use_forkserver=True, coverage=True) as t:
+        t.clear_trace()
+        t.run(b"ABzz")
+        a = t.trace_bits().copy()
+        t.clear_trace()
+        t.run(b"ABzz")
+        assert np.array_equal(a, t.trace_bits())
+
+
+def test_file_mode(corpus_bin, tmp_path):
+    f = str(tmp_path / "input")
+    with ExecTarget([corpus_bin("test"), f], input_file=f,
+                    use_forkserver=True, coverage=True) as t:
+        assert classify(t.run(b"ABCD"))[0] == FUZZ_CRASH
+        assert classify(t.run(b"ABC@"))[0] == FUZZ_NONE
+
+
+def test_run_batch_statuses_and_bitmaps(corpus_bin):
+    with ExecTarget([corpus_bin("test")], use_stdin=True,
+                    use_forkserver=True, coverage=True) as t:
+        seeds = [b"AAAA", b"ABAA", b"ABCA", b"ABCD"]
+        inputs = np.zeros((4, 8), dtype=np.uint8)
+        for i, s in enumerate(seeds):
+            inputs[i, :4] = np.frombuffer(s, dtype=np.uint8)
+        lens = np.full(4, 4, dtype=np.int32)
+        sts, bms = t.run_batch(inputs, lens)
+        assert bms.shape == (4, KB_MAP_SIZE)
+        verdicts = [classify(int(s))[0] for s in sts]
+        assert verdicts == [FUZZ_NONE, FUZZ_NONE, FUZZ_NONE, FUZZ_CRASH]
+        edge_counts = (bms != 0).sum(axis=1)
+        assert edge_counts[0] < edge_counts[2]
+
+
+def test_preload_forkserver_uninstrumented(corpus_bin):
+    """LD_PRELOAD forkserver gives fork-per-exec on a plain binary."""
+    with ExecTarget([corpus_bin("test-plain")], use_stdin=True,
+                    use_forkserver=True,
+                    use_preload_forkserver=True) as t:
+        assert classify(t.run(b"ABCD"))[0] == FUZZ_CRASH
+        assert classify(t.run(b"ABC@"))[0] == FUZZ_NONE
+        assert classify(t.run(b"ABCD"))[0] == FUZZ_CRASH
+
+
+def test_persistence_mode(corpus_bin):
+    """One process serves many inputs; crashes still detected and the
+    process is recycled after max_cnt iterations."""
+    with ExecTarget([corpus_bin("test-persist")], use_stdin=True,
+                    use_forkserver=True, coverage=True,
+                    persistent=4) as t:
+        verdicts = [classify(t.run(s))[0]
+                    for s in [b"AAAA"] * 6 + [b"ABCD", b"AAAA"]]
+        assert verdicts[:6] == [FUZZ_NONE] * 6
+        assert verdicts[6] == FUZZ_CRASH
+        assert verdicts[7] == FUZZ_NONE  # re-forked after the crash
+
+
+def test_forkserver_restarts_after_exit(corpus_bin):
+    with ExecTarget([corpus_bin("test")], use_stdin=True,
+                    use_forkserver=True, coverage=True) as t:
+        t.run(b"AAAA")
+        t.stop()
+        # next run transparently restarts the forkserver
+        assert classify(t.run(b"ABCD"))[0] == FUZZ_CRASH

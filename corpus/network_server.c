@@ -34,14 +34,23 @@ int main(int argc, char **argv) {
     if (c < 0) return 5;
   }
 
-  unsigned char buf[256];
+  /* Length-framed protocol: each message is exactly 4 bytes, so TCP
+   * segment coalescing of back-to-back sends cannot merge messages
+   * (keeps the crash sequence deterministic for the test suite). */
+  unsigned char buf[4];
   int got_hello = 0;
   for (;;) {
-    ssize_t n = recv(c, buf, sizeof(buf), 0);
-    if (n <= 0) break;
+    size_t have = 0;
+    while (have < sizeof(buf)) {
+      ssize_t n = recv(c, buf + have, sizeof(buf) - have, 0);
+      if (n <= 0) return 0;
+      have += (size_t)n;
+      if (udp) break; /* one datagram per message in udp mode */
+    }
+    if (have < 4) return 0;
     if (!got_hello) {
-      if (n >= 4 && memcmp(buf, "HELO", 4) == 0) got_hello = 1;
-    } else if (n >= 4 && memcmp(buf, "BOOM", 4) == 0) {
+      if (memcmp(buf, "HELO", 4) == 0) got_hello = 1;
+    } else if (memcmp(buf, "BOOM", 4) == 0) {
       *(volatile int *)0 = 1; /* crash on the 2-packet sequence */
     }
     if (udp) break; /* one datagram per run in udp mode */

@@ -12,6 +12,7 @@
 #include <unistd.h>
 
 int __kb_persistent_loop(unsigned max_cnt) __attribute__((weak));
+void __kb_manual_init(void) __attribute__((weak));
 
 static int check(const unsigned char *buf, size_t n) {
   if (n < 1 || buf[0] != 'A') return 0;
@@ -44,6 +45,11 @@ static int run_once(const char *path) {
 
 int main(int argc, char **argv) {
   const char *path = argc > 1 ? argv[1] : NULL;
+  /* Deferred-startup init point: under KB_DEFER_FORKSRV=1 the runtime
+   * constructor skipped the forkserver; starting it here puts the fork
+   * point after main()'s entry ("expensive setup done").  Idempotent
+   * no-op when the forkserver already ran pre-main. */
+  if (__kb_manual_init) __kb_manual_init();
   if (__kb_persistent_loop) {
     while (__kb_persistent_loop(1000)) {
       if (run_once(path)) return 1;

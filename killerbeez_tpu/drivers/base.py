@@ -41,7 +41,6 @@ class Driver:
         self.instrumentation = instrumentation
         self.mutator = mutator
         self.last_input: Optional[bytes] = None
-        self._host_prepared = False
         self._check_input_info()
 
     def _check_input_info(self) -> None:
@@ -91,23 +90,29 @@ class Driver:
 
     def test_batch(self, n: int, pad_to: Optional[int] = None
                    ) -> BatchOutcome:
-        """Mutate + execute ``n`` candidates. ``pad_to`` pads the lane
-        dimension with copies of lane 0 (shape-stable jit across tail
-        batches; duplicate lanes are coverage no-ops and callers triage
-        only the first ``n``)."""
+        """Mutate + execute ``n`` candidates. ``pad_to`` keeps the lane
+        dimension shape-stable across tail batches (no XLA recompile):
+        device backends get the input tensor padded with copies of
+        lane 0 (on-device duplicates are coverage no-ops and nearly
+        free), host backends execute only the ``n`` real lanes and pad
+        the result arrays instead (a padded lane would cost a real
+        fork+exec). Callers triage only the first ``n`` lanes."""
         if not self.supports_batch:
             raise RuntimeError(f"{self.name}: batch path unavailable")
-        if not self.instrumentation.device_backed and \
-                not self._host_prepared:
-            self.instrumentation.prepare_host(**self._host_exec_spec())
-            self._host_prepared = True
         bufs, lens = self.mutator.mutate_batch(n)
-        if pad_to is not None and pad_to > n:
-            pad = pad_to - n
-            bufs = np.concatenate(
-                [bufs, np.repeat(bufs[:1], pad, axis=0)], axis=0)
-            lens = np.concatenate([lens, np.repeat(lens[:1], pad)])
-        result = self.instrumentation.run_batch(bufs, lens)
+        if self.instrumentation.device_backed:
+            if pad_to is not None and pad_to > n:
+                pad = pad_to - n
+                bufs = np.concatenate(
+                    [bufs, np.repeat(bufs[:1], pad, axis=0)], axis=0)
+                lens = np.concatenate([lens, np.repeat(lens[:1], pad)])
+            result = self.instrumentation.run_batch(bufs, lens)
+        else:
+            # idempotent per target key; re-binds if a single exec
+            # rebuilt the instrumentation's target in between
+            self.instrumentation.prepare_host(**self._host_exec_spec())
+            result = self.instrumentation.run_batch(bufs, lens,
+                                                    pad_to=pad_to)
         if n > 0:
             self.last_input = bufs[n - 1, :int(lens[n - 1])].tobytes()
         return BatchOutcome(result=result, inputs=bufs, lengths=lens)

@@ -10,18 +10,16 @@ from __future__ import annotations
 
 import socket
 import time
-from typing import List, Optional
+from typing import List
 
-from ..mutators.base import MUTATE_MULTIPLE_INPUTS
 from ..utils.logging import DEBUG_MSG
-from ..utils.serialization import decode_mem_array, encode_mem_array
 from .. import FUZZ_ERROR, FUZZ_NONE
-from .base import Driver
 from .factory import register_driver
+from .packet_driver import PacketDriver
 
 
 @register_driver
-class NetworkClientDriver(Driver):
+class NetworkClientDriver(PacketDriver):
     """Fuzzes a client target that connects to the fuzzer's listener."""
     name = "network_client"
     OPTION_SCHEMA = {"path": str, "arguments": str, "port": int,
@@ -41,50 +39,27 @@ class NetworkClientDriver(Driver):
     DEFAULTS = {"arguments": "", "ip": "127.0.0.1", "udp": 0,
                 "timeout": 2.0, "accept_timeout": 5.0}
 
-    def __init__(self, options, instrumentation, mutator=None):
-        super().__init__(options, instrumentation, mutator)
-        if "path" not in self.options or "port" not in self.options:
-            raise ValueError(
-                'network_client needs {"path": ..., "port": ...}')
-        self.port = int(self.options["port"])
-        self.udp = bool(self.options["udp"])
-        self.num_inputs = 1
-        if self.mutator is not None:
-            self.num_inputs, _ = self.mutator.get_input_info()
-        self._listener: Optional[socket.socket] = None
-
-    def _check_input_info(self) -> None:
-        pass  # multi-input allowed
-
-    @property
-    def supports_batch(self) -> bool:
-        return False
-
-    def _cmd_line(self) -> str:
-        return (f'{self.options["path"]} '
-                f'{self.options["arguments"]}').strip()
-
     # -- listener (reference start_listener) ----------------------------
 
-    def _ensure_listener(self) -> socket.socket:
-        if self._listener is not None:
-            return self._listener
+    def _make_listener(self) -> socket.socket:
+        """Fresh socket per exec: a reused listener can hold stale
+        state from the PREVIOUS (now dead) target — a leftover datagram
+        would teach the UDP path a stale peer address, and a leftover
+        backlog connection would be accepted as this exec's target."""
         kind = socket.SOCK_DGRAM if self.udp else socket.SOCK_STREAM
         s = socket.socket(socket.AF_INET, kind)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind((self.options["ip"], self.port))
         if not self.udp:
             s.listen(1)
-        self._listener = s
         return s
 
     def _run(self, parts: List[bytes]) -> int:
-        listener = self._ensure_listener()
+        listener = self._make_listener()
         self.instrumentation.start_process(self._cmd_line())
         sleeps = self.options.get("sleeps") or []
         try:
             if self.udp:
-                conn, peer = None, None
                 listener.settimeout(float(self.options["accept_timeout"]))
                 # learn the client's address from its first datagram
                 _, peer = listener.recvfrom(65536)
@@ -108,39 +83,7 @@ class NetworkClientDriver(Driver):
             DEBUG_MSG("network_client send failed: %s", e)
             verdict = self.instrumentation.wait_done(0.1)
             return verdict if verdict != FUZZ_NONE else FUZZ_ERROR
+        finally:
+            listener.close()
         return self.instrumentation.wait_done(
             float(self.options["timeout"]))
-
-    # -- vtable ---------------------------------------------------------
-
-    def test_input(self, buf: bytes) -> int:
-        try:
-            parts = decode_mem_array(buf.decode())
-        except Exception:
-            parts = [buf]
-        self.last_input = encode_mem_array(parts).encode()
-        return self._run(parts)
-
-    def test_next_input(self) -> Optional[int]:
-        if self.mutator is None:
-            raise RuntimeError("network_client: no mutator attached")
-        parts: List[bytes] = []
-        if self.num_inputs > 1:
-            for i in range(self.num_inputs):
-                part = self.mutator.mutate_extended(
-                    MUTATE_MULTIPLE_INPUTS | i)
-                if part is None:
-                    return None
-                parts.append(part)
-        else:
-            buf = self.mutator.mutate()
-            if buf is None:
-                return None
-            parts = [buf]
-        self.last_input = encode_mem_array(parts).encode()
-        return self._run(parts)
-
-    def cleanup(self) -> None:
-        if self._listener is not None:
-            self._listener.close()
-            self._listener = None

@@ -15,14 +15,12 @@ from __future__ import annotations
 
 import socket
 import time
-from typing import List, Optional
+from typing import List
 
-from ..mutators.base import MUTATE_MULTIPLE_INPUTS
 from ..utils.logging import DEBUG_MSG, WARNING_MSG
-from ..utils.serialization import decode_mem_array, encode_mem_array
 from .. import FUZZ_ERROR, FUZZ_NONE
-from .base import Driver
 from .factory import register_driver
+from .packet_driver import PacketDriver
 
 
 _TCP_LISTEN = 0x0A
@@ -77,12 +75,12 @@ def is_port_listening(port: int, udp: bool = False,
 
 
 @register_driver
-class NetworkServerDriver(Driver):
+class NetworkServerDriver(PacketDriver):
     """Fuzzes a server target over TCP/UDP packet sequences."""
     name = "network_server"
     OPTION_SCHEMA = {"path": str, "arguments": str, "port": int,
                      "ip": str, "udp": int, "sleeps": list,
-                     "timeout": float, "ratio": float,
+                     "timeout": float,
                      "skip_network_check": int, "listen_timeout": float}
     OPTION_DESCS = {
         "path": "target server executable",
@@ -93,39 +91,12 @@ class NetworkServerDriver(Driver):
         "sleeps": "per-packet pre-send sleeps in ms",
         "timeout": "seconds to wait for target exit after sending "
                    "(then FUZZ_HANG; default 2.0)",
-        "ratio": "mutate-buffer size ratio (default 2.0)",
         "skip_network_check": "1 = don't wait for the port to listen",
         "listen_timeout": "max seconds to wait for the port (default 5)",
     }
     DEFAULTS = {"arguments": "", "ip": "127.0.0.1", "udp": 0,
-                "timeout": 2.0, "ratio": 2.0, "skip_network_check": 0,
+                "timeout": 2.0, "skip_network_check": 0,
                 "listen_timeout": 5.0}
-
-    def __init__(self, options, instrumentation, mutator=None):
-        super().__init__(options, instrumentation, mutator)
-        if "path" not in self.options or "port" not in self.options:
-            raise ValueError(
-                'network_server needs {"path": ..., "port": ...}')
-        self.port = int(self.options["port"])
-        self.udp = bool(self.options["udp"])
-        self.num_inputs = 1
-        self.input_sizes: List[int] = []
-        if self.mutator is not None:
-            self.num_inputs, self.input_sizes = \
-                self.mutator.get_input_info()
-        self._last_parts: Optional[List[bytes]] = None
-
-    def _check_input_info(self) -> None:
-        # Multi-input is this driver's point; accept any part count.
-        pass
-
-    @property
-    def supports_batch(self) -> bool:
-        return False  # live-socket interaction is inherently per-exec
-
-    def _cmd_line(self) -> str:
-        return (f'{self.options["path"]} '
-                f'{self.options["arguments"]}').strip()
 
     # -- packet delivery ------------------------------------------------
 
@@ -167,9 +138,16 @@ class NetworkServerDriver(Driver):
     def _run(self, parts: List[bytes]) -> int:
         self.instrumentation.start_process(self._cmd_line())
         if not self._wait_listening():
-            # died or never listened: collect the verdict (a crash
-            # before listen is still a crash)
-            return self.instrumentation.wait_done(0.1)
+            if self.instrumentation.is_process_done():
+                # died before listening: collect the verdict (a crash
+                # before listen is still a crash)
+                return self.instrumentation.wait_done(0.1)
+            # alive but never opened the port: a config/startup problem,
+            # not a hang — don't let it pollute the hang virgin map
+            WARNING_MSG("network_server: target never listened on port "
+                        "%d within %.1fs", self.port,
+                        float(self.options["listen_timeout"]))
+            return self.instrumentation.abort_process()
         if not self._send_packets(parts):
             # a mid-sequence crash resets the connection and fails the
             # send — the target's verdict is the real signal
@@ -177,42 +155,6 @@ class NetworkServerDriver(Driver):
             return verdict if verdict != FUZZ_NONE else FUZZ_ERROR
         return self.instrumentation.wait_done(
             float(self.options["timeout"]))
-
-    # -- vtable ---------------------------------------------------------
-
-    def test_input(self, buf: bytes) -> int:
-        """Input is an encoded mem array of packets (reference
-        decode_mem_array contract)."""
-        try:
-            parts = decode_mem_array(buf.decode())
-        except Exception:
-            parts = [buf]  # raw bytes: single packet
-        self._last_parts = parts
-        self.last_input = encode_mem_array(parts).encode()
-        return self._run(parts)
-
-    def test_next_input(self) -> Optional[int]:
-        if self.mutator is None:
-            raise RuntimeError("network_server: no mutator attached")
-        parts: List[bytes] = []
-        if self.num_inputs > 1:
-            for i in range(self.num_inputs):
-                part = self.mutator.mutate_extended(
-                    MUTATE_MULTIPLE_INPUTS | i)
-                if part is None:
-                    return None
-                parts.append(part)
-        else:
-            buf = self.mutator.mutate()
-            if buf is None:
-                return None
-            parts = [buf]
-        self._last_parts = parts
-        self.last_input = encode_mem_array(parts).encode()
-        return self._run(parts)
-
-    def get_last_input(self) -> Optional[bytes]:
-        return self.last_input
 
     def cleanup(self) -> None:
         try:

@@ -1,0 +1,81 @@
+"""Shared base for packet-sequence drivers (network_server /
+network_client): both deliver the input as an ordered sequence of
+network packets, mutate multi-part inputs via
+``mutate_extended(MUTATE_MULTIPLE_INPUTS|i)`` and serialize the last
+input with ``encode_mem_array`` (reference driver/network_*_driver.c
+share the same glue through driver.c helpers — SURVEY §2.2)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..mutators.base import MUTATE_MULTIPLE_INPUTS
+from ..utils.serialization import decode_mem_array, encode_mem_array
+from .base import Driver
+
+
+class PacketDriver(Driver):
+    """Delivers inputs as packet sequences; subclasses implement
+    ``_run(parts)`` for their connection direction."""
+
+    def __init__(self, options, instrumentation, mutator=None):
+        super().__init__(options, instrumentation, mutator)
+        if "path" not in self.options or "port" not in self.options:
+            raise ValueError(
+                f'{self.name} needs {{"path": ..., "port": ...}}')
+        self.port = int(self.options["port"])
+        self.udp = bool(self.options["udp"])
+        self.num_inputs = 1
+        self.input_sizes: List[int] = []
+        if self.mutator is not None:
+            self.num_inputs, self.input_sizes = \
+                self.mutator.get_input_info()
+
+    def _check_input_info(self) -> None:
+        # Multi-input is the point of packet drivers; any part count.
+        pass
+
+    @property
+    def supports_batch(self) -> bool:
+        return False  # live-socket interaction is inherently per-exec
+
+    def _cmd_line(self) -> str:
+        return (f'{self.options["path"]} '
+                f'{self.options["arguments"]}').strip()
+
+    def _run(self, parts: List[bytes]) -> int:
+        raise NotImplementedError
+
+    # -- vtable ---------------------------------------------------------
+
+    def test_input(self, buf: bytes) -> int:
+        """Input is an encoded mem array of packets (reference
+        decode_mem_array contract); raw bytes = one packet."""
+        try:
+            parts = decode_mem_array(buf.decode())
+        except Exception:
+            parts = [buf]
+        self.last_input = encode_mem_array(parts).encode()
+        return self._run(parts)
+
+    def test_next_input(self) -> Optional[int]:
+        if self.mutator is None:
+            raise RuntimeError(f"{self.name}: no mutator attached")
+        parts: List[bytes] = []
+        if self.num_inputs > 1:
+            for i in range(self.num_inputs):
+                part = self.mutator.mutate_extended(
+                    MUTATE_MULTIPLE_INPUTS | i)
+                if part is None:
+                    return None
+                parts.append(part)
+        else:
+            buf = self.mutator.mutate()
+            if buf is None:
+                return None
+            parts = [buf]
+        self.last_input = encode_mem_array(parts).encode()
+        return self._run(parts)
+
+    def get_last_input(self) -> Optional[bytes]:
+        return self.last_input

@@ -13,7 +13,7 @@ protocol is the reference's (fds 198/199, __AFL_SHM_ID).
 
 Options (reference afl_instrumentation.c:322-337 parity):
   use_fork_server, persistence_max_cnt, deferred_startup, qemu_mode,
-  qemu_path, timeout, mem_limit, preload_forkserver, novelty.
+  qemu_path, timeout, mem_limit, preload_forkserver, device_triage.
 """
 
 from __future__ import annotations
@@ -74,7 +74,7 @@ class AflInstrumentation(Instrumentation):
         "use_fork_server": int, "persistence_max_cnt": int,
         "deferred_startup": int, "qemu_mode": int, "qemu_path": str,
         "timeout": float, "mem_limit": int, "preload_forkserver": int,
-        "device_triage": int,
+        "device_triage": int, "ignore_bytes_file": str, "edges": int,
     }
     OPTION_DESCS = {
         "use_fork_server": "1 = fork per exec via the forkserver "
@@ -93,11 +93,16 @@ class AflInstrumentation(Instrumentation):
                               "uninstrumented target",
         "device_triage": "1 = batched novelty scan on the TPU "
                          "(default), 0 = numpy on host",
+        "ignore_bytes_file": "picker-produced JSON mask of "
+                             "nondeterministic bitmap bytes to exclude "
+                             "from novelty",
+        "edges": "1 = keep the last exec's nonzero bitmap slots for "
+                 "get_edges() (tracer mode)",
     }
     DEFAULTS = {"use_fork_server": 1, "persistence_max_cnt": 0,
                 "deferred_startup": 0, "qemu_mode": 0, "timeout": 2.0,
                 "mem_limit": 0, "preload_forkserver": 0,
-                "device_triage": 1}
+                "device_triage": 1, "edges": 0}
 
     def __init__(self, options: Optional[str] = None):
         super().__init__(options)
@@ -116,6 +121,22 @@ class AflInstrumentation(Instrumentation):
         self._last_unique_crash = False
         self._last_unique_hang = False
         self._last_trace: Optional[np.ndarray] = None
+        self._ignore: Optional[np.ndarray] = None
+        if self.options.get("ignore_bytes_file"):
+            with open(self.options["ignore_bytes_file"]) as f:
+                d = json.load(f)
+            self._ignore = decode_array(d["ignore_bytes"]) != 0
+            if self._ignore.shape != (MAP_SIZE,):
+                raise ValueError("ignore_bytes mask must cover the "
+                                 f"{MAP_SIZE}-byte map")
+
+    def _mask_ignored(self, trace: np.ndarray) -> np.ndarray:
+        """Zero out picker-flagged nondeterministic bytes before
+        novelty (reference has_new_bits_with_ignore semantics,
+        dynamorio_instrumentation.c:197)."""
+        if self._ignore is None:
+            return trace
+        return np.where(self._ignore, 0, trace)
 
     # -- target lifecycle ----------------------------------------------
 
@@ -159,12 +180,13 @@ class AflInstrumentation(Instrumentation):
         trace = self._target.trace_bits().copy()
         self.total_execs += 1
         self._last_trace = trace
-        cls = _np_classify(trace)
+        masked = self._mask_ignored(trace)
+        cls = _np_classify(masked)
         ret, self.virgin_bits = _np_has_new_bits(self.virgin_bits, cls)
         self._last_unique_crash = False
         self._last_unique_hang = False
         if verdict in (FUZZ_CRASH, FUZZ_HANG):
-            simp = np.where(trace == 0, 1, 128).astype(np.uint8)
+            simp = np.where(masked == 0, 1, 128).astype(np.uint8)
             if verdict == FUZZ_CRASH:
                 cret, self.virgin_crash = _np_has_new_bits(
                     self.virgin_crash, simp)
@@ -205,6 +227,15 @@ class AflInstrumentation(Instrumentation):
         self._finish_exec(verdict)
         return verdict
 
+    def abort_process(self) -> int:
+        if self._target is not None and not self.is_process_done():
+            self._target.wait_done(0.0)  # kills + reaps immediately
+        self._last_unique_crash = False
+        self._last_unique_hang = False
+        self.last_status = FUZZ_ERROR
+        self.last_new_path = 0
+        return FUZZ_ERROR
+
     def last_unique_crash(self) -> bool:
         return self._last_unique_crash
 
@@ -213,14 +244,28 @@ class AflInstrumentation(Instrumentation):
 
     # -- batched --------------------------------------------------------
 
-    def run_batch(self, inputs: np.ndarray, lengths: np.ndarray
-                  ) -> BatchResult:
+    def run_batch(self, inputs: np.ndarray, lengths: np.ndarray,
+                  pad_to: Optional[int] = None) -> BatchResult:
         if self._target is None:
             raise RuntimeError("afl: prepare_host() not called (the "
                                "driver binds the target command first)")
         statuses_raw, bitmaps = self._target.run_batch(inputs, lengths)
+        real = len(statuses_raw)
+        self.total_execs += real
+        if bitmaps is not None and self._ignore is not None:
+            bitmaps = np.where(self._ignore[None, :], 0, bitmaps)
+        if pad_to is not None and pad_to > real:
+            # pad only the RESULT arrays to the stable triage shape:
+            # zero bitmaps + exit-0 statuses are novelty/verdict no-ops
+            # and cost no target executions
+            pad = pad_to - real
+            statuses_raw = np.concatenate(
+                [statuses_raw, np.zeros(pad, dtype=statuses_raw.dtype)])
+            if bitmaps is not None:
+                bitmaps = np.concatenate(
+                    [bitmaps,
+                     np.zeros((pad, bitmaps.shape[1]), dtype=np.uint8)])
         n = len(statuses_raw)
-        self.total_execs += n
         verdicts = np.full(n, FUZZ_NONE, dtype=np.int32)
         verdicts[statuses_raw >= 512] = FUZZ_CRASH
         verdicts[statuses_raw == -1] = FUZZ_HANG
@@ -256,7 +301,7 @@ class AflInstrumentation(Instrumentation):
                     r, self.virgin_tmout = _np_has_new_bits(
                         self.virgin_tmout, simp)
                     uh[i] = r > 0
-        self._last_trace = bitmaps[-1] if n else None
+        self._last_trace = bitmaps[real - 1] if real else None
         return BatchResult(statuses=verdicts, new_paths=new_paths,
                            unique_crashes=uc, unique_hangs=uh,
                            exit_codes=exit_codes)
@@ -294,6 +339,18 @@ class AflInstrumentation(Instrumentation):
 
     def coverage_bytes(self) -> int:
         return int(count_non_255_bytes(self.virgin_bits))
+
+    def last_trace(self) -> Optional[np.ndarray]:
+        """Raw (unmasked) 64KB bitmap of the last exec — picker input."""
+        return self._last_trace
+
+    def get_edges(self):
+        """Nonzero bitmap slots of the last exec as (slot, hit_count)
+        pairs; tracer consumes these (requires {"edges": 1})."""
+        if not self.options.get("edges") or self._last_trace is None:
+            return None
+        idx = np.flatnonzero(self._last_trace)
+        return [(int(i), int(self._last_trace[i])) for i in idx]
 
     def get_module_info(self) -> List[str]:
         return ["target"]

@@ -78,6 +78,13 @@ class Instrumentation:
         Returns the FUZZ_* verdict and updates novelty state."""
         raise NotImplementedError
 
+    def abort_process(self) -> int:
+        """Kill and reap a start_process() target WITHOUT triaging the
+        run (no virgin-map updates, no hang/crash attribution) — for
+        driver-level failures (e.g. the target never opened its port)
+        that say nothing about the input. Returns FUZZ_ERROR."""
+        raise NotImplementedError
+
     def get_fuzz_result(self) -> int:
         return self.last_status
 
@@ -100,8 +107,13 @@ class Instrumentation:
         """Host backends: bind the target command before batch runs
         (drivers call this once; device backends ignore it)."""
 
-    def run_batch(self, inputs: np.ndarray, lengths: np.ndarray
-                  ) -> BatchResult:
+    def run_batch(self, inputs: np.ndarray, lengths: np.ndarray,
+                  pad_to: Optional[int] = None) -> BatchResult:
+        """Execute a [B, L] candidate batch. ``pad_to`` asks host
+        backends to pad the RESULT arrays (status FUZZ_NONE, zero
+        bitmaps) up to a stable lane count for the jitted triage —
+        padding must never cost real target executions. Device
+        backends receive already-padded inputs and may ignore it."""
         raise NotImplementedError(f"{self.name} has no batch path")
 
     # -- coverage plumbing ---------------------------------------------

@@ -91,6 +91,17 @@ class ReturnCodeInstrumentation(Instrumentation):
         self.last_new_path = 0
         return self.last_status
 
+    def abort_process(self) -> int:
+        proc = getattr(self, "_proc", None)
+        if proc is not None:
+            proc.kill()
+            proc.wait()
+            self._proc = None
+        self.last_status = FUZZ_ERROR
+        self.last_exit_code = -1
+        self.last_new_path = 0
+        return FUZZ_ERROR
+
     # merge: the reference returns NULL state and no merge for
     # return_code; keep get_state minimal for -isd parity
     def get_state(self) -> str:

@@ -16,65 +16,17 @@
  */
 #define _GNU_SOURCE
 #include <dlfcn.h>
-#include <signal.h>
-#include <stdint.h>
 #include <stdlib.h>
-#include <sys/types.h>
-#include <sys/wait.h>
 #include <unistd.h>
 
+#define KB_FORKSERVER_IMPL /* pull in the shared command loop */
 #include "kb_protocol.h"
 
 typedef int (*kb_main_fn)(int, char **, char **);
 static kb_main_fn kb_real_main;
 
-static void kb_forkserver(void) {
-  uint32_t hello = KB_HELLO;
-  if (write(KB_STATUS_FD, &hello, 4) != 4) return; /* no fuzzer */
-
-  pid_t child_pid = -1;
-  for (;;) {
-    unsigned char cmd;
-    if (read(KB_FORKSRV_FD, &cmd, 1) != 1) _exit(0);
-    switch (cmd) {
-      case KB_CMD_EXIT:
-        if (child_pid > 0) kill(child_pid, SIGKILL);
-        _exit(0);
-      case KB_CMD_FORK:
-      case KB_CMD_FORK_RUN: {
-        child_pid = fork();
-        if (child_pid < 0) _exit(1);
-        if (child_pid == 0) {
-          close(KB_FORKSRV_FD);
-          close(KB_STATUS_FD);
-          if (cmd == KB_CMD_FORK) raise(SIGSTOP);
-          return; /* fall through into the real main() */
-        }
-        int32_t pid32 = (int32_t)child_pid;
-        if (write(KB_STATUS_FD, &pid32, 4) != 4) _exit(1);
-        break;
-      }
-      case KB_CMD_RUN:
-        if (child_pid > 0) kill(child_pid, SIGCONT);
-        break;
-      case KB_CMD_GET_STATUS: {
-        int status = -1;
-        if (child_pid > 0) {
-          if (waitpid(child_pid, &status, WUNTRACED) < 0) status = -1;
-          if (!WIFSTOPPED(status)) child_pid = -1;
-        }
-        int32_t st32 = (int32_t)status;
-        if (write(KB_STATUS_FD, &st32, 4) != 4) _exit(1);
-        break;
-      }
-      default:
-        _exit(2);
-    }
-  }
-}
-
 static int kb_wrapped_main(int argc, char **argv, char **envp) {
-  if (!getenv("KB_NO_FORKSERVER")) kb_forkserver();
+  if (!getenv("KB_NO_FORKSERVER")) kb_serve_forkserver(NULL);
   return kb_real_main(argc, argv, envp);
 }
 

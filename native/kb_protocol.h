@@ -29,4 +29,69 @@
  * KB_STATUS_FD as soon as it is ready for commands. */
 #define KB_HELLO 0x4b42465aU /* "KBFZ" */
 
+#ifdef KB_FORKSERVER_IMPL
+/* Shared target-side forkserver command loop, used by both the
+ * compiled-in runtime (kb_rt.c) and the LD_PRELOAD library
+ * (kb_preload.c).  Returns only in the CHILD (which then continues
+ * into main); the serving parent never returns.  `child_reset` runs in
+ * the child right before it proceeds (coverage state reset; may be
+ * NULL).  If fd 199 is not wired up there is no fuzzer attached and
+ * the function returns immediately so the target runs normally. */
+#include <signal.h>
+#include <stdint.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static void kb_serve_forkserver(void (*child_reset)(void)) {
+  uint32_t hello = KB_HELLO;
+  if (write(KB_STATUS_FD, &hello, 4) != 4) return; /* no fuzzer */
+
+  pid_t child_pid = -1;
+  for (;;) {
+    unsigned char cmd;
+    if (read(KB_FORKSRV_FD, &cmd, 1) != 1) _exit(0);
+    switch (cmd) {
+      case KB_CMD_EXIT:
+        if (child_pid > 0) kill(child_pid, SIGKILL);
+        _exit(0);
+
+      case KB_CMD_FORK:
+      case KB_CMD_FORK_RUN: {
+        child_pid = fork();
+        if (child_pid < 0) _exit(1);
+        if (child_pid == 0) {
+          close(KB_FORKSRV_FD);
+          close(KB_STATUS_FD);
+          if (cmd == KB_CMD_FORK) raise(SIGSTOP); /* tracer attach */
+          if (child_reset) child_reset();
+          return; /* continue into main() */
+        }
+        int32_t pid32 = (int32_t)child_pid;
+        if (write(KB_STATUS_FD, &pid32, 4) != 4) _exit(1);
+        break;
+      }
+
+      case KB_CMD_RUN:
+        if (child_pid > 0) kill(child_pid, SIGCONT);
+        break;
+
+      case KB_CMD_GET_STATUS: {
+        int status = -1;
+        if (child_pid > 0) {
+          if (waitpid(child_pid, &status, WUNTRACED) < 0) status = -1;
+          if (!WIFSTOPPED(status)) child_pid = -1;
+        }
+        int32_t st32 = (int32_t)status;
+        if (write(KB_STATUS_FD, &st32, 4) != 4) _exit(1);
+        break;
+      }
+
+      default:
+        _exit(2);
+    }
+  }
+}
+#endif /* KB_FORKSERVER_IMPL */
+
 #endif /* KB_PROTOCOL_H */

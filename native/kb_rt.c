@@ -28,13 +28,13 @@
 #include <sys/wait.h>
 #include <unistd.h>
 
+#define KB_FORKSERVER_IMPL /* pull in the shared command loop */
 #include "kb_protocol.h"
 
 static unsigned char kb_dummy_map[KB_MAP_SIZE];
 unsigned char *__kb_trace_bits = kb_dummy_map;
 
 static __thread uintptr_t kb_prev_loc;
-static int kb_forkserver_up;
 static int kb_persist_active = -1; /* -1 = not yet checked */
 
 /* ------------------------------------------------------------------ */
@@ -59,8 +59,10 @@ void __sanitizer_cov_trace_pc(void) {
 }
 
 static void kb_map_shm(void) {
+  static int mapped;
   const char *id_str = getenv(KB_SHM_ENV);
-  if (!id_str) return;
+  if (mapped || !id_str) return;
+  mapped = 1;
   void *addr = shmat(atoi(id_str), NULL, 0);
   if (addr != (void *)-1) __kb_trace_bits = (unsigned char *)addr;
 }
@@ -69,57 +71,9 @@ static void kb_map_shm(void) {
 /* Forkserver                                                          */
 /* ------------------------------------------------------------------ */
 
-static void kb_forkserver(void) {
-  uint32_t hello = KB_HELLO;
-  /* If fd 199 isn't wired up there is no fuzzer: run normally. */
-  if (write(KB_STATUS_FD, &hello, 4) != 4) return;
-  kb_forkserver_up = 1;
+static void kb_child_reset(void) { kb_prev_loc = 0; }
 
-  pid_t child_pid = -1;
-  for (;;) {
-    unsigned char cmd;
-    if (read(KB_FORKSRV_FD, &cmd, 1) != 1) _exit(0);
-    switch (cmd) {
-      case KB_CMD_EXIT:
-        if (child_pid > 0) kill(child_pid, SIGKILL);
-        _exit(0);
-
-      case KB_CMD_FORK:
-      case KB_CMD_FORK_RUN: {
-        child_pid = fork();
-        if (child_pid < 0) _exit(1);
-        if (child_pid == 0) {
-          close(KB_FORKSRV_FD);
-          close(KB_STATUS_FD);
-          if (cmd == KB_CMD_FORK) raise(SIGSTOP); /* let fuzzer attach */
-          kb_prev_loc = 0;
-          return; /* continue into main() */
-        }
-        int32_t pid32 = (int32_t)child_pid;
-        if (write(KB_STATUS_FD, &pid32, 4) != 4) _exit(1);
-        break;
-      }
-
-      case KB_CMD_RUN:
-        if (child_pid > 0) kill(child_pid, SIGCONT);
-        break;
-
-      case KB_CMD_GET_STATUS: {
-        int status = -1;
-        if (child_pid > 0) {
-          if (waitpid(child_pid, &status, WUNTRACED) < 0) status = -1;
-          if (!WIFSTOPPED(status)) child_pid = -1;
-        }
-        int32_t st32 = (int32_t)status;
-        if (write(KB_STATUS_FD, &st32, 4) != 4) _exit(1);
-        break;
-      }
-
-      default:
-        _exit(2);
-    }
-  }
-}
+static void kb_forkserver(void) { kb_serve_forkserver(kb_child_reset); }
 
 void __kb_manual_init(void) {
   static int done;
@@ -157,10 +111,15 @@ int __kb_persistent_loop(unsigned max_cnt) {
   }
   if (!kb_persist_active) return iter++ == 0;
   if (env_cap && (!max_cnt || env_cap < max_cnt)) max_cnt = env_cap;
+  /* Cap check must come BEFORE the stop: once the cap is reached the
+   * process exits at the boundary, so the fuzzer sees an exit instead
+   * of a stop and re-forks — the input it staged for the next exec
+   * then runs in the fresh child rather than being swallowed by a
+   * child that only woke up to die. */
+  if (max_cnt && iter >= max_cnt) return 0; /* exit -> fuzzer re-forks */
   if (iter) {
     raise(SIGSTOP); /* iteration boundary; resumed by SIGCONT */
   }
-  if (max_cnt && iter >= max_cnt) return 0; /* exit -> fuzzer re-forks */
   iter++;
   kb_prev_loc = 0;
   return 1;

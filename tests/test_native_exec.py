@@ -113,6 +113,33 @@ def test_persistence_mode(corpus_bin):
         assert verdicts[7] == FUZZ_NONE  # re-forked after the crash
 
 
+def test_persistence_runs_input_staged_at_recycle_boundary(corpus_bin):
+    """Regression: the exec that triggers process recycling must still
+    run its staged input. kb_rt checks the iteration cap BEFORE the
+    SIGSTOP boundary, so a capped child exits without consuming the
+    next staged input — if the cap were checked after the stop, the
+    crasher staged for exec 3 here would be swallowed by a child that
+    only woke up to die (and reported as a clean exit)."""
+    with ExecTarget([corpus_bin("test-persist")], use_stdin=True,
+                    use_forkserver=True, coverage=True,
+                    persistent=2) as t:
+        assert classify(t.run(b"AAAA"))[0] == FUZZ_NONE
+        assert classify(t.run(b"AAAA"))[0] == FUZZ_NONE  # cap reached
+        assert classify(t.run(b"ABCD"))[0] == FUZZ_CRASH
+
+
+def test_deferred_startup(corpus_bin):
+    """KB_DEFER_FORKSRV=1: the runtime constructor skips the
+    forkserver; test.c's __kb_manual_init() call at the top of main
+    starts it there instead."""
+    with ExecTarget([corpus_bin("test-deferred")], use_stdin=True,
+                    use_forkserver=True, coverage=True,
+                    deferred=True) as t:
+        assert classify(t.run(b"ABC@"))[0] == FUZZ_NONE
+        assert classify(t.run(b"ABCD"))[0] == FUZZ_CRASH
+        assert classify(t.run(b"ABC@"))[0] == FUZZ_NONE
+
+
 def test_forkserver_restarts_after_exit(corpus_bin):
     with ExecTarget([corpus_bin("test")], use_stdin=True,
                     use_forkserver=True, coverage=True) as t:

@@ -34,7 +34,7 @@ import numpy as np
 
 from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_NONE, FUZZ_RUNNING, MAP_SIZE
 from ..models import targets as targets_mod
-from ..models.vm import Program, run_batch as vm_run_batch
+from ..models.vm import run_batch as vm_run_batch
 from ..ops.coverage import (
     build_bitmap, classify_counts, count_non_255_bytes, has_new_bits,
     merge_virgin, simplify_trace,
@@ -112,14 +112,10 @@ class JitHarnessInstrumentation(Instrumentation):
 
     def __init__(self, options: Optional[str] = None):
         super().__init__(options)
-        prog = self._load_program()
-        if "max_steps" in self.options:
-            prog = Program(instrs=prog.instrs, name=prog.name,
-                           mem_size=prog.mem_size,
-                           max_steps=int(self.options["max_steps"]),
-                           n_blocks=prog.n_blocks,
-                           block_ids=prog.block_ids)
-        self.program = prog
+        self.program = prog = targets_mod.load_program_from_options(
+            self.options,
+            'jit_harness needs {"target": name} or '
+            '{"program_file": path}')
         if self.options["novelty"] not in ("exact", "throughput"):
             raise ValueError('novelty must be "exact" or "throughput"')
         self.exact = self.options["novelty"] == "exact"
@@ -131,20 +127,6 @@ class JitHarnessInstrumentation(Instrumentation):
         self._last_edges: Optional[np.ndarray] = None
         self._last_unique_crash = False
         self._last_unique_hang = False
-
-    def _load_program(self) -> Program:
-        if "program_file" in self.options:
-            d = np.load(self.options["program_file"], allow_pickle=False)
-            return Program(
-                instrs=d["instrs"].astype(np.int32),
-                name=str(d["name"]) if "name" in d else "file",
-                mem_size=int(d["mem_size"]), max_steps=int(d["max_steps"]),
-                n_blocks=int(d.get("n_blocks", 0)))
-        target = self.options.get("target")
-        if not target:
-            raise ValueError(
-                'jit_harness needs {"target": name} or {"program_file": path}')
-        return targets_mod.get_target(target)
 
     # -- batched --------------------------------------------------------
 
@@ -192,12 +174,14 @@ class JitHarnessInstrumentation(Instrumentation):
         return self._last_unique_hang
 
     def get_edges(self) -> Optional[List[Tuple[int, int]]]:
-        """Edge list of the last exec (lane 0) as (prev, cur)-hashed
-        ids; tracer consumes these (requires {"edges": 1})."""
+        """Edge slots of the last exec (lane 0) as (slot, hit_count)
+        pairs; tracer consumes these (requires {"edges": 1})."""
         if self._last_edges is None:
             return None
         ids = self._last_edges[0]
-        return [(int(e), 1) for e in ids if e >= 0]
+        ids = ids[ids >= 0]
+        slots, counts = np.unique(ids, return_counts=True)
+        return [(int(s), int(c)) for s, c in zip(slots, counts)]
 
     def get_module_info(self) -> List[str]:
         return [self.program.name]

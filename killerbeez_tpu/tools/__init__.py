@@ -1,0 +1,6 @@
+"""Side tools (reference SURVEY §2.7): merger, tracer, minimize,
+picker, showmap — each a small CLI over the same driver /
+instrumentation factories the fuzzer uses.
+
+Run as ``python -m killerbeez_tpu.tools.<tool> ...``.
+"""

@@ -1,0 +1,134 @@
+"""picker — target determinism analysis + ignore-byte mask derivation.
+
+Parity with the reference picker tool (picker/main.c:163-282,
+SURVEY §2.7): run each seed ``-n`` times, classify the target's
+coverage behavior (no-path / single-path / path-per-file /
+multi-path-same-file) and emit a mask of bitmap bytes that vary across
+repeated runs of the SAME input — the nondeterministic bytes an afl
+instrumentation should exclude from novelty
+(``{"ignore_bytes_file": ...}``).
+
+The mask derivation is a pure array reduction (byte-wise variance
+across [seeds, runs, MAP_SIZE]) — the reference's per-byte comparison
+loops collapse into one vectorized pass.
+
+Usage:
+    python -m killerbeez_tpu.tools.picker file afl \
+        -d '{"path": "corpus/build/test", "arguments": "@@"}' \
+        -o mask.json seeds/a.bin seeds/b.bin
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..ops.coverage import COUNT_CLASS_LOOKUP
+from ..drivers.factory import driver_factory
+from ..instrumentation.factory import instrumentation_factory
+from ..utils.fileio import read_file, write_buffer_to_file
+from ..utils.logging import INFO_MSG, setup_logging
+from ..utils.serialization import encode_array
+
+CLASS_NO_PATH = "no_path"
+CLASS_SINGLE_PATH = "single_path"
+CLASS_PATH_PER_FILE = "path_per_file"
+CLASS_MULTI_PATH_SAME_FILE = "multi_path_same_file"
+
+
+def collect_traces(driver, instrumentation, seeds: List[bytes],
+                   num_iterations: int = 5) -> np.ndarray:
+    """uint8[n_seeds, n_runs, MAP_SIZE] of classified bitmaps."""
+    if not hasattr(instrumentation, "last_trace"):
+        raise ValueError(
+            f"{instrumentation.name} does not expose raw bitmaps "
+            "(picker needs an afl-style instrumentation)")
+    rows = []
+    for seed in seeds:
+        runs = []
+        for _ in range(num_iterations):
+            driver.test_input(seed)
+            trace = instrumentation.last_trace()
+            if trace is None:
+                raise ValueError("target produced no bitmap")
+            runs.append(COUNT_CLASS_LOOKUP[trace])
+        rows.append(np.stack(runs))
+    return np.stack(rows)
+
+
+def derive_ignore_mask(traces: np.ndarray) -> np.ndarray:
+    """Bytes that differ across repeated runs of the same seed
+    (uint8[MAP_SIZE], 1 = nondeterministic -> ignore)."""
+    varies = (traces != traces[:, :1, :]).any(axis=(0, 1))
+    return varies.astype(np.uint8)
+
+
+def classify_target(traces: np.ndarray) -> str:
+    """Reference picker's 4-way module classification
+    (picker/main.c:163-227), applied to the whole target."""
+    if not traces.any():
+        return CLASS_NO_PATH
+    stable = not (traces != traces[:, :1, :]).any()
+    per_seed = traces[:, 0, :]
+    all_same_across_seeds = bool(
+        (per_seed == per_seed[:1]).all()) if len(per_seed) > 1 else True
+    if not stable:
+        return CLASS_MULTI_PATH_SAME_FILE
+    if all_same_across_seeds:
+        return CLASS_SINGLE_PATH
+    return CLASS_PATH_PER_FILE
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="killerbeez-tpu-picker",
+        description="classify target determinism and derive novelty "
+                    "ignore masks")
+    p.add_argument("driver", help="driver name (file, stdin, ...)")
+    p.add_argument("instrumentation",
+                   help="instrumentation name (afl, ...)")
+    p.add_argument("seeds", nargs="+", help="seed input files")
+    p.add_argument("-n", "--iterations", type=int, default=5,
+                   help="runs per seed (default 5)")
+    p.add_argument("-d", "--driver-options", help="driver JSON options")
+    p.add_argument("-i", "--instrumentation-options",
+                   help="instrumentation JSON options")
+    p.add_argument("-o", "--output", required=True,
+                   help="JSON report path ({classification, "
+                        "ignore_bytes, nondeterministic_bytes})")
+    p.add_argument("-l", "--logging-options", help="logging JSON options")
+    args = p.parse_args(argv)
+    try:
+        setup_logging(args.logging_options)
+        instrumentation = instrumentation_factory(
+            args.instrumentation, args.instrumentation_options)
+        driver = driver_factory(args.driver, args.driver_options,
+                                instrumentation, None)
+        seeds = [read_file(s) for s in args.seeds]
+        traces = collect_traces(driver, instrumentation, seeds,
+                                args.iterations)
+        mask = derive_ignore_mask(traces)
+        report: Dict[str, object] = {
+            "classification": classify_target(traces),
+            "nondeterministic_bytes": int(mask.sum()),
+            "ignore_bytes": encode_array(mask),
+        }
+        write_buffer_to_file(args.output,
+                             json.dumps(report).encode())
+        INFO_MSG("target is %s; %d nondeterministic bitmap bytes -> %s",
+                 report["classification"],
+                 report["nondeterministic_bytes"], args.output)
+        driver.cleanup()
+        instrumentation.cleanup()
+        return 0
+    except (ValueError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

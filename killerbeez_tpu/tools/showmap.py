@@ -1,0 +1,74 @@
+"""showmap — run one input, print its coverage bitmap.
+
+Parity with the reference's afl-showmap (afl_progs/afl-showmap.c,
+SURVEY §2.5): execute the target once on the given input and print the
+nonzero bitmap slots as ``slot:count`` lines — the debugging /
+toolchain-self-test primitive (the reference's Makefile self-test
+asserts two different inputs produce different maps).
+
+Usage:
+    python -m killerbeez_tpu.tools.showmap file afl -sf input.bin \
+        -d '{"path": "corpus/build/test", "arguments": "@@"}'
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from ..drivers.factory import driver_factory
+from ..instrumentation.factory import instrumentation_factory
+from ..utils.fileio import read_file, write_buffer_to_file
+from ..utils.logging import setup_logging
+from .tracer import force_edges_option
+
+
+def show_map(driver, instrumentation, input_bytes: bytes) -> List[str]:
+    driver.test_input(input_bytes)
+    edges = instrumentation.get_edges()
+    if edges is None:
+        raise ValueError(
+            f"{instrumentation.name} cannot report coverage slots")
+    return [f"{e}:{c}" for e, c in sorted(edges)]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="killerbeez-tpu-showmap",
+        description="run one input and print its coverage map")
+    p.add_argument("driver", help="driver name (file, stdin, ...)")
+    p.add_argument("instrumentation",
+                   help="instrumentation name (afl, jit_harness, ...)")
+    p.add_argument("-sf", "--seed-file", required=True, help="the input")
+    p.add_argument("-d", "--driver-options", help="driver JSON options")
+    p.add_argument("-i", "--instrumentation-options",
+                   help="instrumentation JSON options (edges forced on)")
+    p.add_argument("-o", "--output",
+                   help="write slot:count lines here (default stdout)")
+    p.add_argument("-l", "--logging-options", help="logging JSON options")
+    args = p.parse_args(argv)
+    try:
+        setup_logging(args.logging_options)
+        instrumentation = instrumentation_factory(
+            args.instrumentation,
+            force_edges_option(args.instrumentation_options))
+        driver = driver_factory(args.driver, args.driver_options,
+                                instrumentation, None)
+        lines = show_map(driver, instrumentation,
+                         read_file(args.seed_file))
+        text = "".join(f"{ln}\n" for ln in lines)
+        if args.output:
+            write_buffer_to_file(args.output, text.encode())
+        else:
+            sys.stdout.write(text)
+        driver.cleanup()
+        instrumentation.cleanup()
+        return 0
+    except (ValueError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,116 @@
+"""tracer — harvest the deterministic edge set of one input.
+
+Parity with the reference tracer tool (tracer/main.c:109-270,
+SURVEY §3.4): run a single input ``-n`` times (default 5) with the
+instrumentation forced into edges mode, keep only edges observed in
+EVERY run (the deterministic set), and write them as ``edge:count``
+text lines. The manager's corpus minimization consumes these files
+(greedy edge cover, tools/minimize.py).
+
+Usage:
+    python -m killerbeez_tpu.tools.tracer file afl -sf input.bin \
+        -d '{"path": "corpus/build/test", "arguments": "@@"}' \
+        -o edges.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+from ..drivers.factory import driver_factory
+from ..instrumentation.factory import instrumentation_factory
+from ..utils.fileio import read_file, write_buffer_to_file
+from ..utils.logging import INFO_MSG, setup_logging
+
+
+def force_edges_option(options: Optional[str]) -> str:
+    """Merge {"edges": 1} into an instrumentation option string
+    (reference tracer/main.c:182-185 forces the same)."""
+    opts = json.loads(options) if options else {}
+    opts["edges"] = 1
+    return json.dumps(opts)
+
+
+def trace_deterministic_edges(driver, instrumentation,
+                              input_bytes: bytes,
+                              num_iterations: int = 5
+                              ) -> Dict[int, int]:
+    """Run the input ``num_iterations`` times; return {edge_id:
+    min hit count} for edges present in every run."""
+    counts: Optional[Dict[int, int]] = None
+    for _ in range(num_iterations):
+        driver.test_input(input_bytes)
+        edges = instrumentation.get_edges()
+        if edges is None:
+            raise ValueError(
+                f"{instrumentation.name} cannot report edges "
+                "(needs edges mode support)")
+        run = dict(edges)
+        if counts is None:
+            counts = run
+        else:
+            counts = {e: min(c, run[e])
+                      for e, c in counts.items() if e in run}
+    return counts or {}
+
+
+def write_edge_file(path: str, edges: Dict[int, int]) -> None:
+    text = "".join(f"{e}:{c}\n" for e, c in sorted(edges.items()))
+    write_buffer_to_file(path, text.encode())
+
+
+def read_edge_file(path: str) -> Dict[int, int]:
+    edges: Dict[int, int] = {}
+    for line in read_file(path).decode().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        e, c = line.split(":")
+        edges[int(e)] = int(c)
+    return edges
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="killerbeez-tpu-tracer",
+        description="dump the deterministic edge set of one input")
+    p.add_argument("driver", help="driver name (file, stdin, ...)")
+    p.add_argument("instrumentation",
+                   help="instrumentation name (afl, jit_harness, ...)")
+    p.add_argument("-sf", "--seed-file", required=True,
+                   help="the input to trace")
+    p.add_argument("-n", "--iterations", type=int, default=5,
+                   help="runs to intersect (default 5)")
+    p.add_argument("-d", "--driver-options", help="driver JSON options")
+    p.add_argument("-i", "--instrumentation-options",
+                   help="instrumentation JSON options (edges forced on)")
+    p.add_argument("-o", "--output", required=True,
+                   help="edge file to write (edge:count lines)")
+    p.add_argument("-l", "--logging-options", help="logging JSON options")
+    args = p.parse_args(argv)
+    try:
+        setup_logging(args.logging_options)
+        instrumentation = instrumentation_factory(
+            args.instrumentation,
+            force_edges_option(args.instrumentation_options))
+        driver = driver_factory(args.driver, args.driver_options,
+                                instrumentation, None)
+        edges = trace_deterministic_edges(
+            driver, instrumentation, read_file(args.seed_file),
+            args.iterations)
+        write_edge_file(args.output, edges)
+        INFO_MSG("%d deterministic edges (of %d runs) -> %s",
+                 len(edges), args.iterations, args.output)
+        driver.cleanup()
+        instrumentation.cleanup()
+        return 0
+    except (ValueError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,8 +47,20 @@ static int kb_persist_active = -1; /* -1 = not yet checked */
  * because sancov gives us no compile-time id). */
 /* kb_rt.o is compiled WITHOUT -fsanitize-coverage, so this hook is
  * never itself instrumented (no recursion risk). */
+
+/* ASLR normalization: PIE executables load at a random base, so raw
+ * PCs — and therefore bitmap slots — would differ between fuzzer
+ * instances, breaking cross-process state merge (the merger tool's
+ * whole point).  kb_rt.o is linked into the target executable, so the
+ * distance from any of its own symbols to an instrumented PC is a
+ * link-time constant; subtracting it makes slots load-address
+ * invariant (same role as the reference IPT path's /proc/pid/maps
+ * normalization, linux_ipt_instrumentation.c:163-189). */
+static void kb_anchor(void) {}
+
 void __sanitizer_cov_trace_pc(void) {
-  uintptr_t pc = (uintptr_t)__builtin_return_address(0);
+  uintptr_t pc = (uintptr_t)__builtin_return_address(0) -
+                 (uintptr_t)&kb_anchor;
   uintptr_t h = pc;
   h ^= h >> 33;
   h *= 0xff51afd7ed558ccdULL;

@@ -55,6 +55,21 @@ def test_coverage_deepens_with_prefix(corpus_bin):
         assert counts[0] < counts[-1]
 
 
+def test_coverage_slots_stable_across_instances(corpus_bin):
+    """ASLR normalization (kb_rt anchor): two INDEPENDENT instances of
+    the same PIE binary must agree on bitmap slots, or cross-process
+    state merge (merger tool, ICI bitmap allreduce) is meaningless."""
+    maps = []
+    for _ in range(2):
+        with ExecTarget([corpus_bin("test")], use_stdin=True,
+                        use_forkserver=True, coverage=True) as t:
+            t.clear_trace()
+            t.run(b"ABzz")
+            maps.append(t.trace_bits().copy())
+    assert maps[0].any()
+    assert np.array_equal(maps[0], maps[1])
+
+
 def test_coverage_deterministic(corpus_bin):
     with ExecTarget([corpus_bin("test")], use_stdin=True,
                     use_forkserver=True, coverage=True) as t:

@@ -1,0 +1,56 @@
+"""Manager CLI (reference python/manager/server.py parity, including
+the ``--seed`` demo-row mode, server.py:13-44)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from ..utils.logging import INFO_MSG, setup_logging
+from .api import ManagerServer
+
+
+def seed_demo_rows(server: ManagerServer) -> None:
+    """Populate the DB with demo rows for API testing (reference
+    tests/seeds.py client_request set)."""
+    db = server.db
+    tid = db.create_target("corpus_test", path="corpus/build/test")
+    db.set_config("driver_opts_file",
+                  json.dumps({"path": "corpus/build/test",
+                              "arguments": "@@"}), tid)
+    db.set_config("mutator_opts_bit_flip",
+                  json.dumps({"num_bits": 2}))
+    db.create_job(tid, "file", "afl", "bit_flip", iterations=100,
+                  seed_file="corpus/seed.bin")
+    db.create_job(tid, "file", "jit_harness", "havoc", iterations=4096,
+                  instrumentation_opts=json.dumps({"target": "test"}))
+    INFO_MSG("seeded demo rows: 1 target, 2 configs, 2 jobs")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="killerbeez-tpu-manager",
+        description="distributed fuzzing manager (REST + work queue)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8650)
+    p.add_argument("--db", default=":memory:",
+                   help="sqlite path (default in-memory)")
+    p.add_argument("--seed", action="store_true",
+                   help="insert demo rows before serving")
+    p.add_argument("-l", "--logging-options")
+    args = p.parse_args(argv)
+    setup_logging(args.logging_options)
+    server = ManagerServer(args.host, args.port, args.db)
+    if args.seed:
+        seed_demo_rows(server)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

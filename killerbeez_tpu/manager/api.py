@@ -1,0 +1,262 @@
+"""Manager REST API — stdlib ThreadingHTTPServer.
+
+Route parity with the reference manager (python/manager/app/
+__init__.py:38-53: Job, Results, Target, Config, File, Minimize),
+plus the work-queue routes that replace BOINC's scheduler
+(SURVEY §2.8, §3.5):
+
+    POST /api/target                 {name, platform, path} -> {id}
+    GET  /api/target                 -> [targets]
+    POST /api/config                 {name, value, target_id?}
+    GET  /api/config?name=&target_id= -> {value}
+    POST /api/job                    {target_id, driver, ...} -> {id, cmdline}
+    GET  /api/job[?status=]          -> [jobs]
+    GET  /api/job/<id>               -> job
+    GET  /api/job/<id>/results       -> [results]
+    POST /api/job/<id>/results       {result_type, repro_file}
+    GET  /api/results                -> [results]
+    POST /api/file                   {name, content_b64} -> {id}
+    GET  /api/file/<id>              -> raw bytes
+    POST /api/state                  {target_id, state} -> {id}
+    GET  /api/state/<target_id>      -> [states]
+    POST /api/tracer_info            {target_id, input_file, edges}
+    POST /api/minimize               {target_id} -> {working_set}
+    POST /api/work/claim             {worker} -> job+cmdline | 204
+    POST /api/work/<id>/finish       {status, mutator_state?}
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..tools.minimize import greedy_edge_cover
+from ..utils.logging import INFO_MSG
+from .db import ManagerDB
+from .fuzzer_cmd import format_cmdline
+
+
+class _Handler(BaseHTTPRequestHandler):
+    db: ManagerDB  # set by ManagerServer
+
+    # -- plumbing -------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # quiet; manager logs itself
+        pass
+
+    def _json(self, code: int, obj: Any) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _bytes(self, code: int, data: bytes,
+               ctype: str = "application/octet-stream") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _body(self) -> Dict[str, Any]:
+        n = int(self.headers.get("Content-Length") or 0)
+        if n == 0:
+            return {}
+        return json.loads(self.rfile.read(n).decode())
+
+    def _route(self, method: str) -> None:
+        parsed = urlparse(self.path)
+        path, query = parsed.path, parse_qs(parsed.query)
+        try:
+            for pattern, methods in _ROUTES:
+                m = re.fullmatch(pattern, path)
+                if m and method in methods:
+                    methods[method](self, query, *m.groups())
+                    return
+            self._json(404, {"error": f"no route {method} {path}"})
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            self._json(400, {"error": str(e)})
+
+    def do_GET(self):
+        self._route("GET")
+
+    def do_POST(self):
+        self._route("POST")
+
+    # -- handlers -------------------------------------------------------
+
+    def h_target(self, query):
+        if self.command == "POST":
+            b = self._body()
+            tid = self.db.create_target(b["name"],
+                                        b.get("platform", "linux_x86_64"),
+                                        b.get("path", ""))
+            self._json(201, {"id": tid})
+        else:
+            self._json(200, self.db.get_targets())
+
+    def h_config(self, query):
+        if self.command == "POST":
+            b = self._body()
+            self.db.set_config(b["name"], b["value"], b.get("target_id"))
+            self._json(201, {"ok": True})
+        else:
+            name = query["name"][0]
+            tid = int(query["target_id"][0]) if "target_id" in query \
+                else None
+            self._json(200, {"value": self.db.lookup_config(name, tid)})
+
+    def h_job_collection(self, query):
+        if self.command == "POST":
+            b = self._body()
+            jid = self.db.create_job(
+                int(b["target_id"]), b["driver"], b["instrumentation"],
+                b["mutator"], int(b.get("iterations", 1000)),
+                b.get("seed_file", ""),
+                driver_opts=b.get("driver_opts"),
+                instrumentation_opts=b.get("instrumentation_opts"),
+                mutator_opts=b.get("mutator_opts"),
+                mutator_state=b.get("mutator_state"))
+            job = self.db.get_job(jid)
+            target = self.db.get_target(job["target_id"]) or {}
+            self._json(201, {
+                "id": jid,
+                "cmdline": format_cmdline(
+                    job, target.get("platform", "linux_x86_64")),
+            })
+        else:
+            status = query.get("status", [None])[0]
+            self._json(200, self.db.get_jobs(status))
+
+    def h_job(self, query, job_id):
+        job = self.db.get_job(int(job_id))
+        if job is None:
+            self._json(404, {"error": f"no job {job_id}"})
+        else:
+            self._json(200, job)
+
+    def h_job_results(self, query, job_id):
+        if self.command == "POST":
+            b = self._body()
+            rid = self.db.add_result(int(job_id), b["result_type"],
+                                     b["repro_file"])
+            self._json(201, {"id": rid})
+        else:
+            self._json(200, self.db.get_results(int(job_id)))
+
+    def h_results(self, query):
+        self._json(200, self.db.get_results())
+
+    def h_file_collection(self, query):
+        b = self._body()
+        fid = self.db.add_file(
+            b["name"], base64.b64decode(b["content_b64"]))
+        self._json(201, {"id": fid})
+
+    def h_file(self, query, file_id):
+        row = self.db.get_file(int(file_id))
+        if row is None:
+            self._json(404, {"error": f"no file {file_id}"})
+        else:
+            self._bytes(200, row["content"])
+
+    def h_state_collection(self, query):
+        b = self._body()
+        sid = self.db.add_instrumentation_state(int(b["target_id"]),
+                                                b["state"])
+        self._json(201, {"id": sid})
+
+    def h_state(self, query, target_id):
+        self._json(200, self.db.get_instrumentation_states(
+            int(target_id)))
+
+    def h_tracer_info(self, query):
+        b = self._body()
+        self.db.add_tracer_info(int(b["target_id"]), b["input_file"],
+                                list(b["edges"]))
+        self._json(201, {"ok": True})
+
+    def h_minimize(self, query):
+        """Greedy edge-cover working set from tracer_info rows
+        (reference controller/Minimize.py:10-40)."""
+        b = self._body()
+        info = self.db.get_tracer_info(int(b["target_id"]))
+        kept = greedy_edge_cover({k: set(v) for k, v in info.items()})
+        self._json(200, {"working_set": kept,
+                         "total_inputs": len(info)})
+
+    def h_work_claim(self, query):
+        b = self._body()
+        job = self.db.claim_job(b.get("worker", "anon"))
+        if job is None:
+            self._bytes(204, b"")
+            return
+        target = self.db.get_target(job["target_id"]) or {}
+        job["cmdline"] = format_cmdline(
+            job, target.get("platform", "linux_x86_64"))
+        self._json(200, job)
+
+    def h_work_finish(self, query, job_id):
+        b = self._body()
+        self.db.finish_job(int(job_id), b.get("status", "done"),
+                           b.get("mutator_state"))
+        self._json(200, {"ok": True})
+
+
+_ROUTES: Tuple = (
+    (r"/api/target", {"GET": _Handler.h_target,
+                      "POST": _Handler.h_target}),
+    (r"/api/config", {"GET": _Handler.h_config,
+                      "POST": _Handler.h_config}),
+    (r"/api/job", {"GET": _Handler.h_job_collection,
+                   "POST": _Handler.h_job_collection}),
+    (r"/api/job/(\d+)", {"GET": _Handler.h_job}),
+    (r"/api/job/(\d+)/results", {"GET": _Handler.h_job_results,
+                                 "POST": _Handler.h_job_results}),
+    (r"/api/results", {"GET": _Handler.h_results}),
+    (r"/api/file", {"POST": _Handler.h_file_collection}),
+    (r"/api/file/(\d+)", {"GET": _Handler.h_file}),
+    (r"/api/state", {"POST": _Handler.h_state_collection}),
+    (r"/api/state/(\d+)", {"GET": _Handler.h_state}),
+    (r"/api/tracer_info", {"POST": _Handler.h_tracer_info}),
+    (r"/api/minimize", {"POST": _Handler.h_minimize}),
+    (r"/api/work/claim", {"POST": _Handler.h_work_claim}),
+    (r"/api/work/(\d+)/finish", {"POST": _Handler.h_work_finish}),
+)
+
+
+class ManagerServer:
+    """Owns the HTTP server + DB; start()/stop() for embedding in
+    tests, serve_forever() for the CLI."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8650,
+                 db_path: str = ":memory:"):
+        self.db = ManagerDB(db_path)
+        handler = type("BoundHandler", (_Handler,), {"db": self.db})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        INFO_MSG("manager listening on :%d", self.port)
+
+    def serve_forever(self) -> None:
+        INFO_MSG("manager listening on :%d", self.port)
+        self.httpd.serve_forever()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.db.close()

@@ -1,0 +1,300 @@
+"""Manager database — sqlite3 schema mirroring the reference's models
+(python/manager/model/: FuzzingJob, FuzzingResults, FuzzingTarget,
+Config, job_inputs, instrumentation_state, tracer_info — SURVEY §2.8).
+
+sqlite stands in for MySQL/Postgres exactly as in the reference's test
+config (python/manager/app/config.py:2-3). The connection is
+per-thread (the REST tier serves from a thread pool).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS targets (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT UNIQUE NOT NULL,
+    platform TEXT NOT NULL DEFAULT 'linux_x86_64',
+    path TEXT NOT NULL DEFAULT '',
+    created REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS configs (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT NOT NULL,           -- e.g. driver_opts_file
+    target_id INTEGER,            -- NULL = global default
+    value TEXT NOT NULL,
+    UNIQUE(name, target_id)
+);
+CREATE TABLE IF NOT EXISTS jobs (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    target_id INTEGER NOT NULL,
+    status TEXT NOT NULL DEFAULT 'pending',
+        -- pending -> claimed -> done | failed
+    driver TEXT NOT NULL,
+    instrumentation TEXT NOT NULL,
+    mutator TEXT NOT NULL,
+    iterations INTEGER NOT NULL DEFAULT 1000,
+    seed_file TEXT NOT NULL DEFAULT '',
+    driver_opts TEXT, instrumentation_opts TEXT, mutator_opts TEXT,
+    mutator_state TEXT,           -- resumption (model/FuzzingJob.py:14)
+    instrumentation_state_id INTEGER,
+    assigned_to TEXT, claimed REAL, finished REAL,
+    created REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS results (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    job_id INTEGER NOT NULL,
+    result_type TEXT NOT NULL,    -- crash | hang | new_path
+    repro_file TEXT NOT NULL,
+    created REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS job_inputs (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    job_id INTEGER NOT NULL,
+    file_id INTEGER NOT NULL
+);
+CREATE TABLE IF NOT EXISTS files (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT NOT NULL,
+    content BLOB NOT NULL,
+    created REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS instrumentation_state (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    target_id INTEGER NOT NULL,
+    state TEXT NOT NULL,
+    created REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS tracer_info (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    target_id INTEGER NOT NULL,
+    input_file TEXT NOT NULL,
+    edges TEXT NOT NULL,          -- JSON list of edge ids
+    UNIQUE(target_id, input_file)
+);
+"""
+
+
+class ManagerDB:
+    """Thread-safe sqlite wrapper; rows in/out as plain dicts."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self._local = threading.local()
+        # in-memory DBs are per-connection; share one with a lock
+        self._shared: Optional[sqlite3.Connection] = None
+        self._lock = threading.Lock()
+        if path == ":memory:":
+            self._shared = sqlite3.connect(":memory:",
+                                           check_same_thread=False)
+            self._shared.row_factory = sqlite3.Row
+            self._shared.executescript(_SCHEMA)
+        else:
+            with self._conn() as c:
+                c.executescript(_SCHEMA)
+
+    def _conn(self) -> sqlite3.Connection:
+        if self._shared is not None:
+            return self._shared
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path)
+            conn.row_factory = sqlite3.Row
+            self._local.conn = conn
+        return conn
+
+    def _exec(self, sql: str, params: tuple = ()) -> sqlite3.Cursor:
+        with self._lock:
+            conn = self._conn()
+            cur = conn.execute(sql, params)
+            conn.commit()
+            return cur
+
+    def _rows(self, sql: str, params: tuple = ()) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(r) for r in
+                    self._conn().execute(sql, params).fetchall()]
+
+    # -- targets --------------------------------------------------------
+
+    def create_target(self, name: str, platform: str = "linux_x86_64",
+                      path: str = "") -> int:
+        cur = self._exec(
+            "INSERT INTO targets (name, platform, path, created) "
+            "VALUES (?, ?, ?, ?)", (name, platform, path, time.time()))
+        return cur.lastrowid
+
+    def get_targets(self) -> List[Dict[str, Any]]:
+        return self._rows("SELECT * FROM targets")
+
+    def get_target(self, target_id: int) -> Optional[Dict[str, Any]]:
+        rows = self._rows("SELECT * FROM targets WHERE id = ?",
+                          (target_id,))
+        return rows[0] if rows else None
+
+    # -- configs (reference lookup_config, model/FuzzingJob.py:52-74) ---
+
+    def set_config(self, name: str, value: str,
+                   target_id: Optional[int] = None) -> None:
+        self._exec(
+            "INSERT INTO configs (name, target_id, value) VALUES (?,?,?) "
+            "ON CONFLICT(name, target_id) DO UPDATE SET value=excluded.value",
+            (name, target_id, value))
+
+    def lookup_config(self, name: str,
+                      target_id: Optional[int] = None) -> Optional[str]:
+        """Per-target value wins over the global default (reference
+        job->target config resolution)."""
+        if target_id is not None:
+            rows = self._rows(
+                "SELECT value FROM configs WHERE name=? AND target_id=?",
+                (name, target_id))
+            if rows:
+                return rows[0]["value"]
+        rows = self._rows(
+            "SELECT value FROM configs WHERE name=? AND target_id IS NULL",
+            (name,))
+        return rows[0]["value"] if rows else None
+
+    # -- jobs -----------------------------------------------------------
+
+    def create_job(self, target_id: int, driver: str,
+                   instrumentation: str, mutator: str,
+                   iterations: int = 1000, seed_file: str = "",
+                   **opts) -> int:
+        """Option strings not given explicitly resolve through the
+        config table as ``{type}_opts_{name}`` rows."""
+        resolved = {}
+        for kind, name in (("driver", driver),
+                           ("instrumentation", instrumentation),
+                           ("mutator", mutator)):
+            key = f"{kind}_opts"
+            resolved[key] = opts.get(key) or self.lookup_config(
+                f"{kind}_opts_{name}", target_id)
+        cur = self._exec(
+            "INSERT INTO jobs (target_id, driver, instrumentation, "
+            "mutator, iterations, seed_file, driver_opts, "
+            "instrumentation_opts, mutator_opts, mutator_state, created) "
+            "VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+            (target_id, driver, instrumentation, mutator, iterations,
+             seed_file, resolved["driver_opts"],
+             resolved["instrumentation_opts"], resolved["mutator_opts"],
+             opts.get("mutator_state"), time.time()))
+        return cur.lastrowid
+
+    def get_job(self, job_id: int) -> Optional[Dict[str, Any]]:
+        rows = self._rows("SELECT * FROM jobs WHERE id = ?", (job_id,))
+        return rows[0] if rows else None
+
+    def get_jobs(self, status: Optional[str] = None
+                 ) -> List[Dict[str, Any]]:
+        if status:
+            return self._rows("SELECT * FROM jobs WHERE status = ?",
+                              (status,))
+        return self._rows("SELECT * FROM jobs")
+
+    def claim_job(self, worker: str) -> Optional[Dict[str, Any]]:
+        """Atomically hand the oldest pending job to ``worker`` (the
+        BOINC scheduler-request replacement)."""
+        with self._lock:
+            conn = self._conn()
+            row = conn.execute(
+                "SELECT id FROM jobs WHERE status='pending' "
+                "ORDER BY id LIMIT 1").fetchone()
+            if row is None:
+                return None
+            conn.execute(
+                "UPDATE jobs SET status='claimed', assigned_to=?, "
+                "claimed=? WHERE id=?",
+                (worker, time.time(), row["id"]))
+            conn.commit()
+            job = conn.execute("SELECT * FROM jobs WHERE id=?",
+                               (row["id"],)).fetchone()
+            return dict(job)
+
+    def finish_job(self, job_id: int, status: str = "done",
+                   mutator_state: Optional[str] = None) -> None:
+        self._exec(
+            "UPDATE jobs SET status=?, finished=?, "
+            "mutator_state=COALESCE(?, mutator_state) WHERE id=?",
+            (status, time.time(), mutator_state, job_id))
+
+    def requeue_stale_jobs(self, older_than_s: float) -> int:
+        """Claimed-but-never-finished jobs go back to pending (BOINC
+        workunit retry semantics — fleet-level failure recovery)."""
+        cutoff = time.time() - older_than_s
+        cur = self._exec(
+            "UPDATE jobs SET status='pending', assigned_to=NULL "
+            "WHERE status='claimed' AND claimed < ?", (cutoff,))
+        return cur.rowcount
+
+    # -- results --------------------------------------------------------
+
+    def add_result(self, job_id: int, result_type: str,
+                   repro_file: str) -> int:
+        if result_type not in ("crash", "hang", "new_path"):
+            raise ValueError(f"bad result_type {result_type!r}")
+        cur = self._exec(
+            "INSERT INTO results (job_id, result_type, repro_file, "
+            "created) VALUES (?,?,?,?)",
+            (job_id, result_type, repro_file, time.time()))
+        return cur.lastrowid
+
+    def get_results(self, job_id: Optional[int] = None
+                    ) -> List[Dict[str, Any]]:
+        if job_id is not None:
+            return self._rows("SELECT * FROM results WHERE job_id = ?",
+                              (job_id,))
+        return self._rows("SELECT * FROM results")
+
+    # -- files ----------------------------------------------------------
+
+    def add_file(self, name: str, content: bytes) -> int:
+        cur = self._exec(
+            "INSERT INTO files (name, content, created) VALUES (?,?,?)",
+            (name, content, time.time()))
+        return cur.lastrowid
+
+    def get_file(self, file_id: int) -> Optional[Dict[str, Any]]:
+        rows = self._rows("SELECT * FROM files WHERE id = ?", (file_id,))
+        return rows[0] if rows else None
+
+    # -- instrumentation state -----------------------------------------
+
+    def add_instrumentation_state(self, target_id: int,
+                                  state: str) -> int:
+        cur = self._exec(
+            "INSERT INTO instrumentation_state (target_id, state, "
+            "created) VALUES (?,?,?)", (target_id, state, time.time()))
+        return cur.lastrowid
+
+    def get_instrumentation_states(self, target_id: int
+                                   ) -> List[Dict[str, Any]]:
+        return self._rows(
+            "SELECT * FROM instrumentation_state WHERE target_id = ?",
+            (target_id,))
+
+    # -- tracer info / minimization ------------------------------------
+
+    def add_tracer_info(self, target_id: int, input_file: str,
+                        edges: List[int]) -> None:
+        self._exec(
+            "INSERT INTO tracer_info (target_id, input_file, edges) "
+            "VALUES (?,?,?) ON CONFLICT(target_id, input_file) "
+            "DO UPDATE SET edges=excluded.edges",
+            (target_id, input_file, json.dumps(sorted(set(edges)))))
+
+    def get_tracer_info(self, target_id: int) -> Dict[str, List[int]]:
+        rows = self._rows(
+            "SELECT input_file, edges FROM tracer_info WHERE target_id=?",
+            (target_id,))
+        return {r["input_file"]: json.loads(r["edges"]) for r in rows}
+
+    def close(self) -> None:
+        if self._shared is not None:
+            self._shared.close()

@@ -1,0 +1,128 @@
+"""Fleet worker + assimilator — the BOINC-client replacement.
+
+Lifecycle parity with the reference's BOINC path (SURVEY §3.5):
+claim a workunit from the manager, run the fuzzer on it, then
+assimilate — stage each finding file to the manager and POST a result
+row per finding (crash | hang | new_path, the same result-type mapping
+as server/killerbeez_assimilator.py:36-39) — and mark the job done
+(with the mutator state for resumption).
+
+    python -m killerbeez_tpu.manager.worker http://mgr:8650 --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import INFO_MSG, WARNING_MSG, setup_logging
+
+RESULT_DIRS = {"crashes": "crash", "hangs": "hang",
+               "new_paths": "new_path"}
+
+
+def _request(url: str, payload: Optional[Dict[str, Any]] = None,
+             method: str = "POST") -> Any:
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        if resp.status == 204:
+            return None
+        body = resp.read()
+        return json.loads(body) if body else None
+
+
+def assimilate(manager_url: str, job_id: int, output_dir: str) -> int:
+    """Upload findings and create result rows; returns count."""
+    n = 0
+    for sub, result_type in RESULT_DIRS.items():
+        d = os.path.join(output_dir, sub)
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            with open(os.path.join(d, name), "rb") as f:
+                content = f.read()
+            up = _request(f"{manager_url}/api/file", {
+                "name": f"job{job_id}_{sub}_{name}",
+                "content_b64": base64.b64encode(content).decode()})
+            _request(f"{manager_url}/api/job/{job_id}/results", {
+                "result_type": result_type,
+                "repro_file": f"/api/file/{up['id']}"})
+            n += 1
+    return n
+
+
+def run_job(manager_url: str, job: Dict[str, Any],
+            in_process: bool = False) -> str:
+    """Execute one claimed job; returns 'done' or 'failed'."""
+    with tempfile.TemporaryDirectory(prefix="kb_work_") as workdir:
+        out_dir = os.path.join(workdir, "output")
+        argv = shlex.split(job["cmdline"]) + ["-o", out_dir]
+        if in_process:
+            from ..fuzzer.cli import main as fuzzer_main
+            # strip the "python -m killerbeez_tpu.fuzzer" prefix
+            tail = argv[argv.index("killerbeez_tpu.fuzzer") + 1:] \
+                if "killerbeez_tpu.fuzzer" in argv else argv
+            rc = fuzzer_main(tail)
+        else:
+            rc = subprocess.run(argv).returncode
+        status = "done" if rc == 0 else "failed"
+        found = assimilate(manager_url, job["id"], out_dir)
+        INFO_MSG("job %d %s: %d findings", job["id"], status, found)
+        return status
+
+
+def work_loop(manager_url: str, worker_name: str, once: bool = False,
+              poll_s: float = 2.0, in_process: bool = False) -> int:
+    """Claim-run-report until the queue drains (once) or forever."""
+    done = 0
+    while True:
+        job = _request(f"{manager_url}/api/work/claim",
+                       {"worker": worker_name})
+        if job is None:
+            if once:
+                return done
+            time.sleep(poll_s)
+            continue
+        try:
+            status = run_job(manager_url, job, in_process=in_process)
+        except Exception as e:  # job must not wedge the worker
+            WARNING_MSG("job %s failed: %s", job.get("id"), e)
+            status = "failed"
+        _request(f"{manager_url}/api/work/{job['id']}/finish",
+                 {"status": status})
+        done += 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="killerbeez-tpu-worker",
+        description="claim and run fuzzing jobs from a manager")
+    p.add_argument("manager_url", help="e.g. http://127.0.0.1:8650")
+    p.add_argument("--name", default=f"worker-{os.getpid()}")
+    p.add_argument("--once", action="store_true",
+                   help="drain the queue then exit")
+    p.add_argument("--in-process", action="store_true",
+                   help="run jobs in this interpreter (no subprocess)")
+    p.add_argument("-l", "--logging-options")
+    args = p.parse_args(argv)
+    setup_logging(args.logging_options)
+    n = work_loop(args.manager_url, args.name, once=args.once,
+                  in_process=args.in_process)
+    INFO_MSG("worker finished: %d jobs", n)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

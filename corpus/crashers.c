@@ -1,0 +1,39 @@
+/* Crash-variety fixture for the debugger instrumentation: the first
+ * 4 input bytes select HOW to die, so tests can assert per-signal
+ * triage (fresh code; exercises SURVEY §2.3 debug-instrumentation
+ * behaviors: exception kind + faulting location).
+ *
+ *   "TRAP" -> int3 breakpoint (SIGTRAP)
+ *   "LIBC" -> NULL memset, faulting inside libc (shared library PC)
+ *   "ABRT" -> abort() (SIGABRT)
+ *   "SEGV" -> NULL write in our own code (SIGSEGV, main-exe PC)
+ *   else   -> exit 0
+ */
+#include <signal.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+/* opaque pointer the optimizer can't see through, so the NULL memset
+ * really reaches libc */
+void *kb_sink;
+
+int main(void) {
+  unsigned char buf[8];
+  ssize_t n = read(0, buf, sizeof(buf));
+  if (n < 4) return 0;
+  if (memcmp(buf, "TRAP", 4) == 0) {
+#if defined(__x86_64__) || defined(__i386__)
+    __asm__ volatile("int3");
+#else
+    raise(SIGTRAP);
+#endif
+  } else if (memcmp(buf, "LIBC", 4) == 0) {
+    memset(kb_sink, 0xee, 64); /* kb_sink is NULL */
+  } else if (memcmp(buf, "ABRT", 4) == 0) {
+    abort();
+  } else if (memcmp(buf, "SEGV", 4) == 0) {
+    *(volatile int *)0 = 7;
+  }
+  return 0;
+}

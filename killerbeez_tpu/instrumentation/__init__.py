@@ -12,6 +12,8 @@ from .factory import (
     register_instrumentation,
 )
 from .afl import AflInstrumentation
+from .debug import DebugInstrumentation
+from .ipt import IptInstrumentation
 from .jit_harness import JitHarnessInstrumentation
 from .return_code import ReturnCodeInstrumentation
 
@@ -19,6 +21,6 @@ __all__ = [
     "Instrumentation", "BatchResult",
     "instrumentation_factory", "instrumentation_help",
     "instrumentation_names", "register_instrumentation",
-    "AflInstrumentation", "JitHarnessInstrumentation",
-    "ReturnCodeInstrumentation",
+    "AflInstrumentation", "DebugInstrumentation", "IptInstrumentation",
+    "JitHarnessInstrumentation", "ReturnCodeInstrumentation",
 ]

@@ -44,6 +44,36 @@ def get_target(name: str) -> Program:
     return _REGISTRY[name]()
 
 
+def load_program_from_options(options: Dict, missing_hint: str
+                              ) -> Program:
+    """Resolve an instrumentation option dict to a Program: either a
+    compiled ``program_file`` (.npz) or a built-in ``target`` name,
+    with an optional ``max_steps`` override. Shared by the device
+    instrumentations (jit_harness, ipt)."""
+    import numpy as np
+
+    if "program_file" in options:
+        d = np.load(options["program_file"], allow_pickle=False)
+        prog = Program(
+            instrs=d["instrs"].astype(np.int32),
+            name=str(d["name"]) if "name" in d else "file",
+            mem_size=int(d["mem_size"]), max_steps=int(d["max_steps"]),
+            n_blocks=int(d.get("n_blocks", 0)),
+            block_ids=tuple(int(b) for b in d.get("block_ids", ())))
+    else:
+        target = options.get("target")
+        if not target:
+            raise ValueError(missing_hint)
+        prog = get_target(target)
+    if "max_steps" in options:
+        prog = Program(instrs=prog.instrs, name=prog.name,
+                       mem_size=prog.mem_size,
+                       max_steps=int(options["max_steps"]),
+                       n_blocks=prog.n_blocks,
+                       block_ids=prog.block_ids)
+    return prog
+
+
 @register_target("test")
 def test_target() -> Program:
     """'ABCD' crasher: nested per-byte checks, crash = store through a

@@ -24,6 +24,15 @@ from .build import exec_lib_path, preload_path
 
 KB_MAP_SIZE = 1 << 16
 
+
+class CrashInfo(ct.Structure):
+    """Mirror of kb_crash_info (kb_exec.cpp debugger mode)."""
+    _fields_ = [("signal_no", ct.c_int32),
+                ("si_code", ct.c_int32),
+                ("fault_addr", ct.c_uint64),
+                ("pc", ct.c_uint64)]
+
+
 _lib = None
 
 
@@ -55,6 +64,10 @@ def _load() -> ct.CDLL:
     lib.kb_target_fork.argtypes = [ct.c_void_p, ct.c_double]
     lib.kb_target_resume.restype = ct.c_int
     lib.kb_target_resume.argtypes = [ct.c_void_p, ct.c_double]
+    lib.kb_target_run_debug.restype = ct.c_int
+    lib.kb_target_run_debug.argtypes = [
+        ct.c_void_p, ct.c_char_p, ct.c_int32, ct.c_double,
+        ct.POINTER(CrashInfo)]
     lib.kb_target_trace_bits.restype = ct.POINTER(ct.c_uint8)
     lib.kb_target_trace_bits.argtypes = [ct.c_void_p]
     lib.kb_target_clear_trace.argtypes = [ct.c_void_p]
@@ -167,6 +180,22 @@ class ExecTarget:
                 # never triage uninitialized rows: zero = no coverage
                 bitmaps[done:] = 0
         return statuses, bitmaps
+
+    def run_debug(self, data: bytes, timeout: Optional[float] = None
+                  ) -> Tuple[int, dict]:
+        """Execute one input under ptrace (debugger mode, no
+        forkserver); returns (status, crash_info dict). crash_info
+        carries signal/si_code/fault_addr/pc when the run faulted."""
+        self._ensure_started()
+        info = CrashInfo()
+        st = self._lib.kb_target_run_debug(
+            self._h, data, len(data),
+            self.timeout if timeout is None else timeout,
+            ct.byref(info))
+        return st, {"signal": int(info.signal_no),
+                    "si_code": int(info.si_code),
+                    "fault_addr": int(info.fault_addr),
+                    "pc": int(info.pc)}
 
     def launch(self, timeout: float = 10.0) -> int:
         """Start one exec WITHOUT waiting (network-driver pattern:

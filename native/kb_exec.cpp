@@ -33,15 +33,19 @@
 #include <string>
 #include <vector>
 
+#include <elf.h>
 #include <fcntl.h>
 #include <poll.h>
 #include <signal.h>
 #include <sys/ipc.h>
+#include <sys/ptrace.h>
 #include <sys/resource.h>
 #include <sys/shm.h>
 #include <sys/stat.h>
 #include <sys/time.h>
 #include <sys/types.h>
+#include <sys/uio.h>
+#include <sys/user.h>
 #include <sys/wait.h>
 #include <unistd.h>
 
@@ -618,6 +622,165 @@ int kb_target_resume(kb_target *t, double timeout_s) {
   if (WIFSTOPPED(wstatus)) {
     t->child_stopped = 1;
     return 0;
+  }
+  t->child_pid = -1;
+  return classify_wstatus(wstatus);
+}
+
+/* ------------------------------------------------------------------ */
+/* Debugger-mode execution (ptrace)                                    */
+/* ------------------------------------------------------------------ */
+
+/* The reference's Windows debug instrumentation attaches a debugger
+ * and classifies EXCEPTION_DEBUG_EVENTs (debug_instrumentation.c:
+ * 19-88).  The Linux equivalent: run the child under ptrace and, on a
+ * fatal-signal stop, harvest siginfo (fault address) and the PC
+ * before letting the signal kill it — crash *details*, not just a
+ * waitpid status. */
+
+struct kb_crash_info {
+  int32_t signal_no;   /* 0 = no crash */
+  int32_t si_code;
+  uint64_t fault_addr; /* siginfo si_addr */
+  uint64_t pc;         /* instruction pointer at the fault */
+};
+
+/* Base address of the module CONTAINING the fault PC — subtracting it
+ * makes the PC load-address invariant under ASLR (same normalization
+ * as the reference IPT path's /proc/pid/maps pass,
+ * linux_ipt_instrumentation.c:163-189); without it every re-exec of
+ * the same crash looks like a new crash site.  Two passes: find the
+ * mapping that contains pc and its backing path, then the lowest
+ * mapping of that same path (= the module base; a module maps several
+ * segments).  Anonymous regions return 0 (PC stays raw). */
+static uint64_t module_base_for_pc(pid_t pid, uint64_t pc) {
+  char path[64];
+  snprintf(path, sizeof(path), "/proc/%d/maps", (int)pid);
+  FILE *f = fopen(path, "r");
+  if (!f) return 0;
+  char containing[256] = "";
+  char line[512];
+  while (fgets(line, sizeof(line), f)) {
+    unsigned long start = 0, end = 0;
+    int name_off = 0;
+    if (sscanf(line, "%lx-%lx %*4s %*x %*x:%*x %*u %n",
+               &start, &end, &name_off) < 2)
+      continue;
+    if (pc >= start && pc < end) {
+      if (name_off > 0 && line[name_off] == '/')
+        sscanf(line + name_off, "%255[^\n]", containing);
+      break;
+    }
+  }
+  uint64_t base = 0;
+  if (containing[0]) {
+    rewind(f);
+    while (fgets(line, sizeof(line), f)) {
+      unsigned long start = 0, end = 0;
+      int name_off = 0;
+      if (sscanf(line, "%lx-%lx %*4s %*x %*x:%*x %*u %n",
+                 &start, &end, &name_off) < 2)
+        continue;
+      char name[256] = "";
+      if (name_off > 0 && line[name_off] == '/')
+        sscanf(line + name_off, "%255[^\n]", name);
+      if (strcmp(name, containing) == 0) {
+        base = start; /* maps are sorted: first hit is the base */
+        break;
+      }
+    }
+  }
+  fclose(f);
+  return base;
+}
+
+static int is_fatal_signal(int sig) {
+  switch (sig) {
+    case SIGSEGV: case SIGBUS: case SIGILL: case SIGFPE:
+    case SIGABRT: case SIGSYS: case SIGTRAP:
+      return 1;
+    default:
+      return 0;
+  }
+}
+
+int kb_target_run_debug(kb_target *t, const uint8_t *input, int32_t len,
+                        double timeout_s, struct kb_crash_info *info) {
+  memset(info, 0, sizeof(*info));
+  if (stage_input(t, input, len) != 0) return -2;
+  t->total_execs++;
+
+  pid_t pid = fork();
+  if (pid < 0) {
+    set_err("fork: %s", strerror(errno));
+    return -2;
+  }
+  if (pid == 0) {
+    ptrace(PTRACE_TRACEME, 0, nullptr, nullptr);
+    child_setup(t, -1, -1); /* never returns */
+  }
+  t->child_pid = pid;
+
+  double deadline = now_s() + timeout_s;
+  int wstatus = 0;
+  int seen_exec_trap = 0;
+  for (;;) {
+    pid_t r = waitpid(pid, &wstatus, WNOHANG);
+    if (r < 0) {
+      set_err("waitpid: %s", strerror(errno));
+      kill(pid, SIGKILL);
+      waitpid(pid, nullptr, 0);
+      t->child_pid = -1;
+      return -2;
+    }
+    if (r == 0) {
+      if (now_s() > deadline) {
+        kill(pid, SIGKILL);
+        ptrace(PTRACE_DETACH, pid, nullptr, nullptr);
+        waitpid(pid, &wstatus, 0);
+        t->child_pid = -1;
+        return -1; /* hang */
+      }
+      usleep(200);
+      continue;
+    }
+    if (WIFEXITED(wstatus) || WIFSIGNALED(wstatus)) break;
+    if (WIFSTOPPED(wstatus)) {
+      int sig = WSTOPSIG(wstatus);
+      if (!seen_exec_trap && sig == SIGTRAP) {
+        /* the post-execve trap, not a fault */
+        seen_exec_trap = 1;
+        ptrace(PTRACE_CONT, pid, nullptr, nullptr);
+        continue;
+      }
+      if (is_fatal_signal(sig) && info->signal_no == 0) {
+        siginfo_t si;
+        if (ptrace(PTRACE_GETSIGINFO, pid, nullptr, &si) == 0) {
+          info->signal_no = sig;
+          info->si_code = si.si_code;
+          info->fault_addr = (uint64_t)(uintptr_t)si.si_addr;
+        }
+#if defined(__x86_64__)
+        struct user_regs_struct regs;
+        if (ptrace(PTRACE_GETREGS, pid, nullptr, &regs) == 0)
+          info->pc = (uint64_t)regs.rip;
+#elif defined(__aarch64__)
+        struct user_regs_struct regs;
+        struct iovec iov = {&regs, sizeof(regs)};
+        if (ptrace(PTRACE_GETREGSET, pid, (void *)NT_PRSTATUS, &iov) == 0)
+          info->pc = (uint64_t)regs.pc;
+#endif
+        /* module-relative PC: stable across ASLR re-execs even when
+         * the fault is inside a shared library */
+        uint64_t base = module_base_for_pc(pid, info->pc);
+        if (base && info->pc >= base) info->pc -= base;
+      }
+      /* deliver the signal untouched — fatal ones kill the child,
+       * others pass through.  (Only the single post-execve SIGTRAP is
+       * suppressed above; a later SIGTRAP is a real int3/breakpoint
+       * crash and must land.) */
+      ptrace(PTRACE_CONT, pid, nullptr, (void *)(uintptr_t)sig);
+    }
   }
   t->child_pid = -1;
   return classify_wstatus(wstatus);

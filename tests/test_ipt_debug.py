@@ -1,0 +1,157 @@
+"""ipt (trace-hash novelty) and debug (ptrace crash details)
+instrumentation tests — reference SURVEY §2.3 behaviors: hash-pair
+novelty with set-union merge (linux_ipt semantics) and debugger-grade
+crash triage (debug_instrumentation semantics).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from killerbeez_tpu import FUZZ_CRASH, FUZZ_NONE
+from killerbeez_tpu.drivers.factory import driver_factory
+from killerbeez_tpu.instrumentation.factory import instrumentation_factory
+
+
+def make_ipt(**opts):
+    return instrumentation_factory(
+        "ipt", json.dumps({"target": "test", **opts}))
+
+
+def batch(instr, seeds):
+    L = 8
+    buf = np.zeros((len(seeds), L), dtype=np.uint8)
+    lens = np.zeros(len(seeds), dtype=np.int32)
+    for i, s in enumerate(seeds):
+        buf[i, :len(s)] = np.frombuffer(s, dtype=np.uint8)
+        lens[i] = len(s)
+    return instr.run_batch(buf, lens)
+
+
+def test_ipt_novelty_is_path_sensitive():
+    instr = make_ipt()
+    res = batch(instr, [b"zzzz", b"zzzz", b"Azzz", b"ABzz", b"ABzz"])
+    assert list(res.new_paths) == [1, 0, 1, 1, 0]
+    assert list(res.statuses) == [FUZZ_NONE] * 5
+
+
+def test_ipt_crash_detection_and_uniqueness():
+    instr = make_ipt()
+    res = batch(instr, [b"ABCD", b"ABCD", b"ABC@"])
+    assert list(res.statuses) == [FUZZ_CRASH, FUZZ_CRASH, FUZZ_NONE]
+    assert list(res.unique_crashes) == [True, False, False]
+
+
+def test_ipt_single_exec_shim():
+    instr = make_ipt()
+    instr.enable(b"ABCD")
+    assert instr.get_fuzz_result() == FUZZ_CRASH
+    assert instr.is_new_path() == 1
+    assert instr.last_unique_crash()
+    instr.enable(b"ABCD")
+    assert instr.is_new_path() == 0
+
+
+def test_ipt_state_merge_is_set_union():
+    a, b = make_ipt(), make_ipt()
+    batch(a, [b"zzzz", b"Azzz"])
+    batch(b, [b"Azzz", b"ABzz"])
+    before = a.coverage_bytes()
+    a.merge(b.get_state())
+    assert a.coverage_bytes() == 3  # union of {z, A} and {A, AB}
+    assert a.coverage_bytes() > before
+    # merged state dedups: replaying b's paths yields nothing new
+    res = batch(a, [b"Azzz", b"ABzz"])
+    assert not res.new_paths.any()
+
+
+def test_ipt_state_roundtrip():
+    a = make_ipt()
+    batch(a, [b"zzzz", b"ABCD"])
+    b = make_ipt()
+    b.set_state(a.get_state())
+    assert b.coverage_bytes() == a.coverage_bytes()
+    res = batch(b, [b"zzzz"])
+    assert not res.new_paths.any()
+
+
+def test_ipt_filters_restrict_tracing():
+    """With every block id filtered out, all paths hash identically:
+    only the first exec is 'new' (reference address-filter behavior:
+    untraced regions contribute nothing)."""
+    instr = make_ipt(filters=[[0, 1]])
+    res = batch(instr, [b"zzzz", b"Azzz", b"ABzz"])
+    assert list(res.new_paths) == [1, 0, 0]
+
+
+def test_ipt_rejects_host_targets():
+    with pytest.raises(ValueError, match="PMU|afl"):
+        instrumentation_factory("ipt", None)
+
+
+def test_debug_crash_details(corpus_bin):
+    instr = instrumentation_factory("debug", None)
+    drv = driver_factory("stdin", json.dumps(
+        {"path": corpus_bin("test-plain")}), instr, None)
+    assert drv.test_input(b"ABCD") == FUZZ_CRASH
+    info = instr.last_crash_info
+    assert info["signal"] == 11          # SIGSEGV
+    assert info["fault_addr"] == 0       # the NULL write
+    assert info["pc"] > 0
+    assert "SIGSEGV" in instr.crash_description()
+    assert instr.last_unique_crash()
+    # same site again: crash but not unique
+    assert drv.test_input(b"ABCD") == FUZZ_CRASH
+    assert not instr.last_unique_crash()
+    assert drv.test_input(b"ABC@") == FUZZ_NONE
+    assert instr.is_new_path() == 0      # no coverage, like reference
+    drv.cleanup()
+    instr.cleanup()
+
+
+def test_debug_sigtrap_is_a_crash(corpus_bin):
+    """Regression: only the single post-execve SIGTRAP may be
+    suppressed — a later int3 is a real breakpoint crash."""
+    instr = instrumentation_factory("debug", None)
+    drv = driver_factory("stdin", json.dumps(
+        {"path": corpus_bin("crashers")}), instr, None)
+    assert drv.test_input(b"TRAP") == FUZZ_CRASH
+    assert instr.last_crash_info["signal"] == 5  # SIGTRAP
+    drv.cleanup()
+    instr.cleanup()
+
+
+def test_debug_library_crash_pc_stable(corpus_bin):
+    """Regression: the PC normalizes against the base of the module
+    CONTAINING the fault (libc here), so re-running the same
+    library crash dedups instead of minting a new site per ASLR
+    layout."""
+    instr = instrumentation_factory("debug", None)
+    drv = driver_factory("stdin", json.dumps(
+        {"path": corpus_bin("crashers")}), instr, None)
+    pcs = []
+    for _ in range(3):
+        assert drv.test_input(b"LIBC") == FUZZ_CRASH
+        pcs.append(instr.last_crash_info["pc"])
+    assert pcs[0] == pcs[1] == pcs[2]
+    assert len(instr.crash_sites) == 1
+    # abort() is a distinct signal/site
+    assert drv.test_input(b"ABRT") == FUZZ_CRASH
+    assert instr.last_crash_info["signal"] == 6  # SIGABRT
+    assert len(instr.crash_sites) == 2
+    drv.cleanup()
+    instr.cleanup()
+
+
+def test_debug_state_merge(corpus_bin):
+    a = instrumentation_factory("debug", None)
+    drv = driver_factory("stdin", json.dumps(
+        {"path": corpus_bin("test-plain")}), a, None)
+    drv.test_input(b"ABCD")
+    b = instrumentation_factory("debug", None)
+    b.merge(a.get_state())
+    assert b.crash_sites == a.crash_sites and b.crash_sites
+    drv.cleanup()
+    a.cleanup()
+    b.cleanup()

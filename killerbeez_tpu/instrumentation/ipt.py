@@ -35,7 +35,7 @@ import numpy as np
 
 from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING
 from ..models import targets as targets_mod
-from ..models.vm import _run_one
+from ..models.vm import _run_batch_impl
 from ..ops.hashing import murmur3_32
 from .base import BatchResult, Instrumentation
 from .factory import register_instrumentation
@@ -48,8 +48,7 @@ TNT_SEED = np.uint32(0x7E57ED01)  # branch-outcome stream hash
 def _ipt_step(instrs, inputs, lengths, filt_lo, filt_hi, mem_size,
               max_steps):
     """VM exec + per-lane (tip, tnt) trace hashes, one XLA program."""
-    f = partial(_run_one, instrs, mem_size, max_steps)
-    res = jax.vmap(f)(inputs, lengths)
+    res = _run_batch_impl(instrs, inputs, lengths, mem_size, max_steps)
     statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
                          res.status)
     ids = res.edge_ids  # int32[B, T], -1 padding

@@ -76,9 +76,8 @@ def _triage_exact(vb, vc, vh, cls, simp, statuses):
 def _fused_step(instrs, inputs, lengths, vb, vc, vh, mem_size, max_steps,
                 exact):
     """mutated batch -> VM exec -> bitmaps -> triage, one XLA program."""
-    from ..models.vm import _run_one  # shared step machine
-    f = partial(_run_one, instrs, mem_size, max_steps)
-    res = jax.vmap(f)(inputs, lengths)
+    from ..models.vm import _run_batch_impl  # batched one-hot engine
+    res = _run_batch_impl(instrs, inputs, lengths, mem_size, max_steps)
     statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG, res.status)
     if exact:
         bitmap = build_bitmap(res.edge_ids, res.edge_ids >= 0)

@@ -149,12 +149,52 @@ def _novelty_rets(virgin, ids, cls):
     return jnp.where(new_tuple, 2, jnp.where(new_count, 1, 0))
 
 
+def _first_occurrence_multi(hashes: jax.Array, crash: jax.Array,
+                            hang: jax.Array
+                            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(first_all, first_crash, first_hang) bool[B] in ONE argsort:
+    group lanes into hash-runs, then per run take the lowest original
+    index overall / among crash lanes / among hang lanes via
+    segment-min. Three separate first_occurrence calls cost three
+    lexsorts; the sort is the expensive part and it's shared here."""
+    b = hashes.shape[0]
+    order = jnp.argsort(hashes)  # stable: ties keep index order
+    sh = hashes[order]
+    head = jnp.concatenate([jnp.ones((1,), bool), sh[1:] != sh[:-1]])
+    run = jnp.cumsum(head.astype(jnp.int32)) - 1  # segment id, sorted dom.
+
+    def firsts(pred_orig):
+        vals = jnp.where(pred_orig[order], order, b)
+        m = jax.ops.segment_min(vals, run, num_segments=b)
+        hit_sorted = pred_orig[order] & (order == m[run])
+        return jnp.zeros((b,), bool).at[order].set(hit_sorted)
+
+    return (firsts(jnp.ones((b,), bool)), firsts(crash), firsts(hang))
+
+
+def _presence_mask(ids: jax.Array, is_new: jax.Array) -> jax.Array:
+    """uint8[MAP_SIZE] with bit 7 set on every edge touched by a new
+    lane — the sparse ``simplify_trace`` contribution (presence-only,
+    see sparse_simplify). One scatter plane instead of eight."""
+    live = ids < MAP_SIZE
+    flat = jnp.where(is_new[:, None] & live, ids, MAP_SIZE).reshape(-1)
+    plane = jnp.zeros((MAP_SIZE + 1,), jnp.uint8)
+    plane = plane.at[flat].max(jnp.ones_like(flat, jnp.uint8),
+                               mode="drop")
+    return plane[:MAP_SIZE] << 7
+
+
 def sparse_triage(vb: jax.Array, vc: jax.Array, vh: jax.Array,
                   edge_ids: jax.Array, valid: jax.Array,
                   crash: jax.Array, hang: jax.Array):
     """Fused throughput triage over all three AFL maps, sharing the
     sort/classify/hash work (three separate sparse_has_new_bits_batch
     calls triple it).
+
+    The virgin scatters are the step's dominant cost at large B, and
+    most steady-state batches find nothing new — each update runs
+    under ``lax.cond`` so a batch with no new lanes skips its scatter
+    entirely (TPU executes only the taken branch of a conditional).
 
     Returns (rets, unique_crash, unique_hang, vb', vc', vh').
     """
@@ -167,15 +207,21 @@ def sparse_triage(vb: jax.Array, vc: jax.Array, vh: jax.Array,
     crash_rets = _novelty_rets(vc, ids, simp)
     hang_rets = _novelty_rets(vh, ids, simp)
 
-    all_lanes = jnp.ones(ids.shape[:1], dtype=bool)
-    rets = jnp.where(first_occurrence(hashes, all_lanes), rets,
-                     0).astype(jnp.int32)
-    uc = first_occurrence(hashes, crash) & (crash_rets > 0)
-    uh = first_occurrence(hashes, hang) & (hang_rets > 0)
+    first_all, first_crash, first_hang = _first_occurrence_multi(
+        hashes, crash, hang)
+    rets = jnp.where(first_all, rets, 0).astype(jnp.int32)
+    uc = first_crash & (crash_rets > 0)
+    uh = first_hang & (hang_rets > 0)
 
-    vb2 = vb & ~_virgin_update_mask(ids, cls, rets > 0)
-    vc2 = vc & ~_virgin_update_mask(ids, simp, uc)
-    vh2 = vh & ~_virgin_update_mask(ids, simp, uh)
+    def upd(virgin, mask_fn, any_new):
+        return jax.lax.cond(any_new,
+                            lambda v: v & ~mask_fn(),
+                            lambda v: v, virgin)
+
+    vb2 = upd(vb, lambda: _virgin_update_mask(ids, cls, rets > 0),
+              jnp.any(rets > 0))
+    vc2 = upd(vc, lambda: _presence_mask(ids, uc), jnp.any(uc))
+    vh2 = upd(vh, lambda: _presence_mask(ids, uh), jnp.any(uh))
     return rets, uc, uh, vb2, vc2, vh2
 
 

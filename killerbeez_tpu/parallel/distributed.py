@@ -32,7 +32,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING, MAP_SIZE
-from ..models.vm import Program, _run_one
+from ..models.vm import Program, _run_batch_impl
 from ..ops.coverage import classify_counts, simplify_trace
 from ..ops.hashing import hash_bitmaps
 from ..ops.mutate_core import havoc_at
@@ -120,9 +120,9 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
             lambda k: havoc_at(seed_buf, seed_len, k,
                                stack_pow2=stack_pow2))(keys)
 
-        # ---- execute ----
-        res = jax.vmap(partial(_run_one, instrs, program.mem_size,
-                               program.max_steps))(bufs, lens)
+        # ---- execute (batched one-hot engine) ----
+        res = _run_batch_impl(instrs, bufs, lens, program.mem_size,
+                              program.max_steps)
         statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
                              res.status)
 

@@ -246,9 +246,10 @@ def _onehot_pick(table, idx, axis_len):
 
 def _step_batched(instrs, bufs_t, lengths, mem_size, state):
     """One VM step for ALL lanes. state = (pc, regs, mem, prev_loc,
-    status, exit_code, edges, i); arrays are [B, ...]; bufs_t is the
-    transposed input [L, B] so byte selects run over static rows."""
-    pc, regs, mem, prev_loc, status, exit_code, edges, i = state
+    status, exit_code, edges, i, lane_steps); arrays are [B, ...];
+    bufs_t is the transposed input [L, B] so byte selects run over
+    static rows."""
+    pc, regs, mem, prev_loc, status, exit_code, edges, i, lane_steps = state
     ni = instrs.shape[0]
     L = bufs_t.shape[0]
     running = status == FUZZ_RUNNING
@@ -346,7 +347,8 @@ def _step_batched(instrs, bufs_t, lengths, mem_size, state):
             keep(new_prev, prev_loc),
             keep(new_status, status),
             keep(new_exit, exit_code),
-            new_edges, i + 1)
+            new_edges, i + 1,
+            lane_steps + running.astype(jnp.int32))
 
 
 @partial(jax.jit, static_argnames=("mem_size", "max_steps"))
@@ -359,7 +361,8 @@ def _run_batch_impl(instrs, inputs, lengths, mem_size, max_steps):
               jnp.full(b, FUZZ_RUNNING, jnp.int32),
               jnp.zeros(b, jnp.int32),
               jnp.full((b, max_steps), -1, jnp.int32),
-              jnp.int32(0))
+              jnp.int32(0),
+              jnp.zeros(b, jnp.int32))
     bufs_t = inputs.T
     lengths = lengths.astype(jnp.int32)
 
@@ -370,12 +373,8 @@ def _run_batch_impl(instrs, inputs, lengths, mem_size, max_steps):
         return _step_batched(instrs, bufs_t, lengths, mem_size, s)
 
     final = jax.lax.while_loop(cond, body, state0)
-    # per-lane executed steps: index of the lane's last live position
-    # is not tracked by the batched engine (the global i stands in);
-    # report the global iteration count for all lanes
-    steps = jnp.full(b, final[7], jnp.int32)
     return VMResult(status=final[4], exit_code=final[5],
-                    edge_ids=final[6], steps=steps)
+                    edge_ids=final[6], steps=final[8])
 
 
 def run_batch(program: Program, inputs: jax.Array, lengths: jax.Array

@@ -1,0 +1,120 @@
+"""Break down the fused fuzz step's time on the real chip.
+
+Times each stage of the pipeline (mutation / VM execution / sparse
+triage / full fused step) separately under its own jit, so BENCH
+regressions can be attributed.  Run on the TPU:
+
+    python profiling/profile_step.py [target] [B] [steps]
+
+Writes a human table to stdout and the raw numbers to
+profiling/profile_<target>.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(fn, *args, warmup=1, iters=5):
+    import jax
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from killerbeez_tpu import MAP_SIZE, FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING
+    from killerbeez_tpu.models import targets
+    from killerbeez_tpu.models.vm import _run_batch_impl
+    from killerbeez_tpu.instrumentation.jit_harness import _fused_step
+    from killerbeez_tpu.ops.sparse_coverage import sparse_triage
+    from killerbeez_tpu.ops.mutate_core import havoc_at
+
+    target = sys.argv[1] if len(sys.argv) > 1 else "test"
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 32768
+    L = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+
+    prog = targets.get_target(target)
+    instrs = jnp.asarray(prog.instrs)
+    print(f"target={target} NI={prog.instrs.shape[0]} "
+          f"mem={prog.mem_size} max_steps={prog.max_steps} B={B} L={L}",
+          file=sys.stderr)
+
+    seed = b"ABC@"
+    seed_buf = np.zeros(L, dtype=np.uint8)
+    seed_buf[:len(seed)] = np.frombuffer(seed, dtype=np.uint8)
+    seed_buf = jnp.asarray(seed_buf)
+    seed_len = jnp.int32(len(seed))
+
+    @jax.jit
+    def mutate(it):
+        base = jax.random.fold_in(jax.random.key(0), it)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(B, dtype=jnp.uint32))
+        return jax.vmap(
+            lambda k: havoc_at(seed_buf, seed_len, k, stack_pow2=4))(keys)
+
+    bufs, lens = mutate(jnp.uint32(0))
+    jax.block_until_ready(bufs)
+
+    @jax.jit
+    def vm_only(bufs, lens):
+        return _run_batch_impl(instrs, bufs, lens, prog.mem_size,
+                               prog.max_steps)
+
+    res = vm_only(bufs, lens)
+    jax.block_until_ready(res.edge_ids)
+    steps_used = int(res.steps.max())
+
+    virgin = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
+
+    @jax.jit
+    def triage_only(vb, vc, vh, edge_ids, statuses):
+        return sparse_triage(vb, vc, vh, edge_ids, edge_ids >= 0,
+                             statuses == FUZZ_CRASH,
+                             statuses == FUZZ_HANG)
+
+    statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG, res.status)
+
+    @jax.jit
+    def fused(vb, vc, vh, it):
+        bufs, lens = mutate(it)
+        return _fused_step(instrs, bufs, lens, vb, vc, vh,
+                           prog.mem_size, prog.max_steps, False)
+
+    rows = {}
+    rows["mutate"] = timeit(mutate, jnp.uint32(1))
+    rows["vm_only"] = timeit(vm_only, bufs, lens)
+    rows["triage_only"] = timeit(triage_only, virgin, virgin, virgin,
+                                 res.edge_ids, statuses)
+    rows["fused_step"] = timeit(fused, virgin, virgin, virgin,
+                                jnp.uint32(1))
+
+    print(f"max lane steps used: {steps_used}/{prog.max_steps}",
+          file=sys.stderr)
+    out = {"target": target, "B": B, "L": L,
+           "NI": int(prog.instrs.shape[0]),
+           "max_steps": prog.max_steps, "steps_used": steps_used,
+           "times_s": rows,
+           "execs_per_sec_fused": B / rows["fused_step"]}
+    for k, v in rows.items():
+        print(f"{k:14s} {v*1e3:10.2f} ms   {B/v:12.0f} execs/s")
+    out_dir = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(out_dir, f"profile_{target}.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

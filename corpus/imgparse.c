@@ -1,0 +1,157 @@
+/* imgparse — CGC-style chunked image-format parser (realistic target,
+ * VERDICT "Realistic targets": ~100+ basic blocks, layered field
+ * validation, and a reachable memory-safety bug several constraints
+ * deep; plays the role of the reference's prebuilt CGC challenge
+ * binaries (corpus/cgc/) without copying them).
+ *
+ * Format ("QIMG"):
+ *   magic   "QIMG"
+ *   chunks: [type u8][len u8][payload len bytes][cksum u8]
+ *           cksum = sum(payload) & 0xFF
+ *   types:  'H' header  — payload = width u8, height u8, depth u8
+ *           'P' palette — payload = count u8, then count*1 colors
+ *           'D' data    — payload = row u8, then pixel bytes
+ *           'C' comment — payload ignored
+ *           'E' end     — stop
+ *
+ * Planted bugs:
+ *   1. 'D' row offset is validated against height but the pixel copy
+ *      trusts `width` from a SECOND header chunk — re-sending a header
+ *      after 'D' rows with a larger width makes the next row write
+ *      past the framebuffer (wild pointer, deterministic SIGSEGV).
+ *   2. 'P' color lookup during 'D' decode indexes the palette with a
+ *      pixel value without checking it against palette count — an OOB
+ *      read amplified into a wild write.
+ *
+ * Input: argv[1] file, else stdin.  Seed: seeds/imgparse.qimg.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+int __kb_persistent_loop(unsigned max_cnt) __attribute__((weak));
+void __kb_manual_init(void) __attribute__((weak));
+
+#define FB_W 32
+#define FB_H 32
+
+typedef struct {
+  unsigned w, h, depth;
+  int have_header;
+  unsigned pal_count;
+  unsigned char palette[64];
+  unsigned char fb[FB_W * FB_H];
+  unsigned rows_done;
+} img_t;
+
+static int chunk_cksum_ok(const unsigned char *p, unsigned len,
+                          unsigned char want) {
+  unsigned s = 0;
+  for (unsigned i = 0; i < len; i++) s += p[i];
+  return (unsigned char)s == want;
+}
+
+static int do_header(img_t *im, const unsigned char *p, unsigned len) {
+  if (len != 3) return -1;
+  unsigned w = p[0], h = p[1], d = p[2];
+  if (w == 0 || h == 0) return -1;
+  if (w > 200 || h > 200) return -1;       /* "sanity" check, not fb bound */
+  if (d != 1 && d != 2 && d != 4 && d != 8) return -1;
+  /* BUG 1 half: only the FIRST header is checked against the
+   * framebuffer; later headers just overwrite the fields. */
+  if (!im->have_header && (w > FB_W || h > FB_H)) return -1;
+  im->w = w; im->h = h; im->depth = d;
+  im->have_header = 1;
+  return 0;
+}
+
+static int do_palette(img_t *im, const unsigned char *p, unsigned len) {
+  if (len < 1) return -1;
+  unsigned count = p[0];
+  if (count == 0 || count > 64) return -1;
+  if (len != 1 + count) return -1;
+  for (unsigned i = 0; i < count; i++) im->palette[i] = p[1 + i];
+  im->pal_count = count;
+  return 0;
+}
+
+static int do_data(img_t *im, const unsigned char *p, unsigned len) {
+  if (!im->have_header) return -1;
+  if (len < 1) return -1;
+  unsigned row = p[0];
+  if (row >= im->h) return -1;             /* row IS validated */
+  if (len - 1 < im->w) return -1;          /* need a full row of pixels */
+  unsigned char *dst = im->fb + (size_t)row * im->w;  /* BUG 1: w unchecked
+                                                         vs FB_W on refresh */
+  for (unsigned i = 0; i < im->w; i++) {
+    unsigned char px = p[1 + i];
+    if (im->pal_count) {
+      /* BUG 2: px not checked against pal_count (OOB palette read) */
+      px = im->palette[px];
+    }
+    dst[i] = px;                           /* wild write when row*w spills */
+  }
+  im->rows_done++;
+  return 0;
+}
+
+static int parse(const unsigned char *buf, size_t n) {
+  img_t im;
+  memset(&im, 0, sizeof im);
+  if (n < 4) return 1;
+  if (buf[0] != 'Q' || buf[1] != 'I' || buf[2] != 'M' || buf[3] != 'G')
+    return 1;
+  size_t off = 4;
+  int chunks = 0;
+  while (off + 2 <= n) {
+    unsigned char type = buf[off];
+    unsigned len = buf[off + 1];
+    off += 2;
+    if (off + len + 1 > n) return 2;       /* truncated chunk */
+    const unsigned char *payload = buf + off;
+    unsigned char ck = buf[off + len];
+    off += len + 1;
+    if (!chunk_cksum_ok(payload, len, ck)) return 3;
+    if (++chunks > 64) return 4;
+    int rc;
+    switch (type) {
+      case 'H': rc = do_header(&im, payload, len); break;
+      case 'P': rc = do_palette(&im, payload, len); break;
+      case 'D': rc = do_data(&im, payload, len); break;
+      case 'C': rc = 0; break;
+      case 'E': printf("ok: %u rows\n", im.rows_done); return 0;
+      default: rc = -1;
+    }
+    if (rc) return 5;
+  }
+  return 6;
+}
+
+static int run_once(const char *path) {
+  static unsigned char buf[4096];
+  size_t n;
+  if (path) {
+    FILE *f = fopen(path, "rb");
+    if (!f) return 1;
+    n = fread(buf, 1, sizeof(buf), f);
+    fclose(f);
+  } else {
+    ssize_t r = read(0, buf, sizeof(buf));
+    n = r > 0 ? (size_t)r : 0;
+  }
+  printf("parse rc=%d\n", parse(buf, n));
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  const char *path = argc > 1 ? argv[1] : NULL;
+  if (__kb_manual_init) __kb_manual_init();
+  if (__kb_persistent_loop) {
+    while (__kb_persistent_loop(1000)) {
+      if (run_once(path)) return 1;
+    }
+    return 0;
+  }
+  return run_once(path);
+}

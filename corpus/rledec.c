@@ -1,0 +1,112 @@
+/* rledec — CGC-style run-length + back-reference decompressor
+ * (realistic target: a decode loop whose output cursor is guarded by
+ * an overflowable accounting variable — the classic decompressor CVE
+ * shape, written from scratch).
+ *
+ * Format: "RLE2" [out_len u16le] then tokens:
+ *   0x00 <n> <byte>      — emit byte n times
+ *   0x01 <n>             — emit n bytes copied verbatim from input
+ *   0x02 <n> <dist u8>   — back-reference: copy n bytes from
+ *                          out_cursor - dist (dist validated > 0)
+ *   0x03                 — end of stream
+ *
+ * The decode loop accounts output space with a signed `budget`
+ * instead of checking the cursor against the buffer end, and its
+ * reject condition only fires while the cursor still LOOKS in-bounds
+ * (`op + cnt <= OUT_CAP`) — so the first token that both exhausts the
+ * budget and crosses the buffer end slips through, and every later
+ * token inherits an out-of-bounds cursor: the copy loops then walk
+ * megabytes past the static buffer into unmapped pages (SIGSEGV).
+ *
+ * Input: argv[1] file, else stdin.  Seed: seeds/rledec.rle.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+int __kb_persistent_loop(unsigned max_cnt) __attribute__((weak));
+void __kb_manual_init(void) __attribute__((weak));
+
+#define OUT_CAP 1024
+
+static int decode(const unsigned char *buf, size_t n) {
+  /* Heap output buffer: the overflow walks up through the (small) brk
+   * heap into unmapped pages — and cannot corrupt the input, which
+   * lives below in BSS. */
+  static unsigned char *out;
+  if (!out) out = malloc(OUT_CAP);
+  if (n < 6) return 1;
+  if (memcmp(buf, "RLE2", 4) != 0) return 1;
+  unsigned out_len = buf[4] | (buf[5] << 8);
+  if (out_len > OUT_CAP) return 2;
+  short budget = (short)out_len;             /* BUG: signed 16-bit */
+  size_t ip = 6, op = 0;
+  while (ip < n) {
+    unsigned char tok = buf[ip++];
+    if (tok == 0x03) { printf("decoded %zu bytes\n", op); return 0; }
+    if (ip >= n) return 3;
+    unsigned cnt = buf[ip++];
+    if (cnt == 0) return 4;
+    switch (tok) {
+      case 0x00: {                           /* run */
+        if (ip >= n) return 3;
+        unsigned char b = buf[ip++];
+        budget -= (short)cnt;
+        if (budget < 0 && op + cnt <= OUT_CAP) return 5;  /* BUG: only
+             rejects when the cursor ALSO looks in-bounds — the wrap
+             case (op past cap) sails through */
+        for (unsigned i = 0; i < cnt; i++) out[op++] = b;
+        break;
+      }
+      case 0x01: {                           /* literal copy */
+        if (ip + cnt > n) return 3;
+        budget -= (short)cnt;
+        if (budget < 0 && op + cnt <= OUT_CAP) return 5;
+        for (unsigned i = 0; i < cnt; i++) out[op++] = buf[ip++];
+        break;
+      }
+      case 0x02: {                           /* back-reference */
+        if (ip >= n) return 3;
+        unsigned dist = buf[ip++];
+        if (dist == 0 || dist > op) return 6;
+        budget -= (short)cnt;
+        if (budget < 0 && op + cnt <= OUT_CAP) return 5;
+        for (unsigned i = 0; i < cnt; i++, op++)
+          out[op] = out[op - dist];
+        break;
+      }
+      default:
+        return 7;
+    }
+  }
+  return 8;
+}
+
+static int run_once(const char *path) {
+  static unsigned char buf[65536];
+  size_t n;
+  if (path) {
+    FILE *f = fopen(path, "rb");
+    if (!f) return 1;
+    n = fread(buf, 1, sizeof(buf), f);
+    fclose(f);
+  } else {
+    ssize_t r = read(0, buf, sizeof(buf));
+    n = r > 0 ? (size_t)r : 0;
+  }
+  printf("decode rc=%d\n", decode(buf, n));
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  const char *path = argc > 1 ? argv[1] : NULL;
+  if (__kb_manual_init) __kb_manual_init();
+  if (__kb_persistent_loop) {
+    while (__kb_persistent_loop(1000)) {
+      if (run_once(path)) return 1;
+    }
+    return 0;
+  }
+  return run_once(path);
+}

@@ -1,0 +1,94 @@
+"""Generate seed inputs (and known crash reproducers) for the
+CGC-style corpus targets.  Valid seeds exercise the happy path without
+crashing; the *_crash reproducers are the planted-bug proofs used by
+tests to confirm each bug is real and deterministic.
+
+Usage: python corpus/seeds.py [outdir]   (default corpus/seeds/)
+"""
+
+import os
+import sys
+
+
+def chunk(type_byte: bytes, payload: bytes) -> bytes:
+    return type_byte + bytes([len(payload)]) + payload + \
+        bytes([sum(payload) & 0xFF])
+
+
+def imgparse_seed() -> bytes:
+    out = b"QIMG"
+    out += chunk(b"H", bytes([8, 8, 1]))
+    out += chunk(b"P", bytes([2, 0x10, 0x20]))
+    out += chunk(b"D", bytes([0]) + bytes([i & 1 for i in range(8)]))
+    out += chunk(b"C", b"hi")
+    out += chunk(b"E", b"")
+    return out
+
+
+def imgparse_crash() -> bytes:
+    """Header re-send widens the image after validation: row 199 x
+    width 200 lands ~39KB past the framebuffer."""
+    out = b"QIMG"
+    out += chunk(b"H", bytes([8, 8, 1]))          # first header: sane
+    out += chunk(b"H", bytes([200, 200, 1]))      # BUG: unchecked resize
+    out += chunk(b"D", bytes([199]) + bytes(200))  # row*w >> FB size
+    return out
+
+
+def tlvstack_seed() -> bytes:
+    ops = [(0x01, 5), (0x01, 7), (0x03, 0), (0x06, 0), (0x07, 0),
+           (0x02, 0), (0x0B, 0)]
+    return b"STK1" + b"".join(bytes(p) for p in ops)
+
+
+def tlvstack_crash() -> bytes:
+    """255^4 wraps negative via MUL; SIND's signed bound check passes
+    and slots[big_negative] writes ~1GB below the data segment."""
+    ops = [(0x01, 255), (0x05, 0), (0x04, 0),     # 255*255
+           (0x05, 0), (0x04, 0),                  # ^2 -> wraps negative
+           (0x01, 1), (0x09, 0), (0x0A, 0)]       # val, swap, SIND
+    return b"STK1" + b"".join(bytes(p) for p in ops)
+
+
+def rledec_seed() -> bytes:
+    out = b"RLE2" + (16).to_bytes(2, "little")
+    out += bytes([0x00, 8, ord("A")])             # run of 8 'A'
+    out += bytes([0x01, 4]) + b"abcd"             # literal
+    out += bytes([0x02, 4, 4])                    # back-reference
+    out += bytes([0x03])
+    return out
+
+
+def rledec_crash() -> bytes:
+    """Fill the budget exactly, then emit runs forever: the reject
+    check only fires while the cursor looks in-bounds, so the cursor
+    walks megabytes past the output buffer."""
+    out = b"RLE2" + (1024).to_bytes(2, "little")
+    for _ in range(5):                            # 5*205=1025 > 1024
+        out += bytes([0x00, 205, ord("B")])
+    out += bytes([0x00, 255, ord("C")]) * 25000   # ~6.4MB of writes
+    return out
+
+
+SEEDS = {
+    "imgparse.qimg": imgparse_seed,
+    "imgparse_crash.qimg": imgparse_crash,
+    "tlvstack.stk": tlvstack_seed,
+    "tlvstack_crash.stk": tlvstack_crash,
+    "rledec.rle": rledec_seed,
+    "rledec_crash.rle": rledec_crash,
+}
+
+
+def main():
+    outdir = sys.argv[1] if len(sys.argv) > 1 else \
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "seeds")
+    os.makedirs(outdir, exist_ok=True)
+    for name, fn in SEEDS.items():
+        with open(os.path.join(outdir, name), "wb") as f:
+            f.write(fn())
+    print(f"wrote {len(SEEDS)} seeds to {outdir}")
+
+
+if __name__ == "__main__":
+    main()

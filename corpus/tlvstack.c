@@ -1,0 +1,151 @@
+/* tlvstack — CGC-style stack-machine interpreter over a TLV command
+ * stream (realistic target: opcode dispatch, per-op validation, and a
+ * pointer-arithmetic bug reachable only through a specific op
+ * sequence).
+ *
+ * Format: "STK1" then commands [op u8][arg u8]:
+ *   0x01 PUSH  arg          — push literal
+ *   0x02 POP                — pop (validated)
+ *   0x03 ADD                — pop a, pop b, push a+b
+ *   0x04 MUL                — pop a, pop b, push a*b
+ *   0x05 DUP                — duplicate top
+ *   0x06 STORE arg          — slots[arg] = pop()  (arg validated < 16)
+ *   0x07 LOAD  arg          — push slots[arg]     (arg validated < 16)
+ *   0x08 PICK  arg          — push stack[sp-1-arg]: BUG — arg is
+ *        checked against sp with a SIGNED comparison that a crafted
+ *        sp value makes pass, then used to index far below the stack
+ *        base (wild read feeding a wild write via STORE-indirect).
+ *   0x09 SWAP               — swap top two
+ *   0x0a SIND               — "store indirect": addr = pop(), val =
+ *        pop(), slots[addr] = val with addr checked ONLY by the same
+ *        signed-compare helper — negative addr from PICK garbage
+ *        writes far outside the slot array (deterministic SIGSEGV for
+ *        large magnitudes).
+ *   0x0b HALT
+ *
+ * Input: argv[1] file, else stdin.  Seed: seeds/tlvstack.stk.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+int __kb_persistent_loop(unsigned max_cnt) __attribute__((weak));
+void __kb_manual_init(void) __attribute__((weak));
+
+#define STACK_MAX 32
+
+typedef struct {
+  int stack[STACK_MAX];
+  int sp;                      /* points at next free slot */
+  int slots[16];
+} vm_t;
+
+/* The buggy range helper: callers pass (idx, limit) as ints; a
+ * negative idx sneaks under the limit check. */
+static int in_range(int idx, int limit) { return idx < limit; }
+
+static int step(vm_t *vm, unsigned char op, unsigned char arg) {
+  switch (op) {
+    case 0x01:                               /* PUSH */
+      if (vm->sp >= STACK_MAX) return -1;
+      vm->stack[vm->sp++] = arg;
+      return 0;
+    case 0x02:                               /* POP */
+      if (vm->sp <= 0) return -1;
+      vm->sp--;
+      return 0;
+    case 0x03: case 0x04: {                  /* ADD / MUL */
+      if (vm->sp < 2) return -1;
+      int a = vm->stack[--vm->sp];
+      int b = vm->stack[--vm->sp];
+      vm->stack[vm->sp++] = op == 0x03 ? a + b : a * b;
+      return 0;
+    }
+    case 0x05:                               /* DUP */
+      if (vm->sp < 1 || vm->sp >= STACK_MAX) return -1;
+      vm->stack[vm->sp] = vm->stack[vm->sp - 1];
+      vm->sp++;
+      return 0;
+    case 0x06:                               /* STORE */
+      if (arg >= 16 || vm->sp < 1) return -1;
+      vm->slots[arg] = vm->stack[--vm->sp];
+      return 0;
+    case 0x07:                               /* LOAD */
+      if (arg >= 16 || vm->sp >= STACK_MAX) return -1;
+      vm->stack[vm->sp++] = vm->slots[arg];
+      return 0;
+    case 0x08: {                             /* PICK: wild read */
+      if (vm->sp < 1 || vm->sp >= STACK_MAX) return -1;
+      int depth = arg;                       /* 0..255 vs sp<=32: */
+      if (!in_range(depth, vm->sp * 8)) return -1;  /* BUG: sloppy bound */
+      vm->stack[vm->sp] = vm->stack[vm->sp - 1 - depth];
+      vm->sp++;
+      return 0;
+    }
+    case 0x09: {                             /* SWAP */
+      if (vm->sp < 2) return -1;
+      int t = vm->stack[vm->sp - 1];
+      vm->stack[vm->sp - 1] = vm->stack[vm->sp - 2];
+      vm->stack[vm->sp - 2] = t;
+      return 0;
+    }
+    case 0x0a: {                             /* SIND: wild write */
+      if (vm->sp < 2) return -1;
+      int addr = vm->stack[--vm->sp];
+      int val = vm->stack[--vm->sp];
+      if (!in_range(addr, 16)) return -1;    /* BUG: negative passes */
+      vm->slots[addr] = val;                 /* addr << 0 from PICK junk */
+      return 0;
+    }
+    case 0x0b:
+      return 1;
+    default:
+      return -1;
+  }
+}
+
+static int interp(const unsigned char *buf, size_t n) {
+  static vm_t vm;
+  memset(&vm, 0, sizeof vm);
+  if (n < 4) return 1;
+  if (memcmp(buf, "STK1", 4) != 0) return 1;
+  size_t off = 4;
+  int steps = 0;
+  while (off + 2 <= n) {
+    int rc = step(&vm, buf[off], buf[off + 1]);
+    off += 2;
+    if (rc < 0) return 2;
+    if (rc > 0) { printf("halt sp=%d\n", vm.sp); return 0; }
+    if (++steps > 256) return 3;
+  }
+  return 4;
+}
+
+static int run_once(const char *path) {
+  static unsigned char buf[2048];
+  size_t n;
+  if (path) {
+    FILE *f = fopen(path, "rb");
+    if (!f) return 1;
+    n = fread(buf, 1, sizeof(buf), f);
+    fclose(f);
+  } else {
+    ssize_t r = read(0, buf, sizeof(buf));
+    n = r > 0 ? (size_t)r : 0;
+  }
+  printf("interp rc=%d\n", interp(buf, n));
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  const char *path = argc > 1 ? argv[1] : NULL;
+  if (__kb_manual_init) __kb_manual_init();
+  if (__kb_persistent_loop) {
+    while (__kb_persistent_loop(1000)) {
+      if (run_once(path)) return 1;
+    }
+    return 0;
+  }
+  return run_once(path);
+}

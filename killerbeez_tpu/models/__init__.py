@@ -13,6 +13,7 @@ afl-as puts its trampolines (reference afl_progs/afl-as.c).
 from .vm import Program, VMResult, compile_runner, run_batch
 from .compiler import Assembler, assign_block_ids
 from . import targets
+from . import targets_cgc  # registers the CGC-grade targets
 
 __all__ = ["Program", "VMResult", "compile_runner", "run_batch",
            "Assembler", "assign_block_ids", "targets"]

@@ -1,0 +1,169 @@
+"""CGC-grade realistic targets (VERDICT "Realistic targets"): the
+native parsers (corpus/{imgparse,tlvstack,rledec}.c) and their KBVM
+ports (models/targets_cgc.py).
+
+Contract per target: the seed exercises the happy path without
+crashing, the crash reproducer deterministically triggers the planted
+memory bug, and (KBVM) a havoc run from a near-miss seed finds a
+crash on-device.
+"""
+
+import numpy as np
+import pytest
+
+from killerbeez_tpu import FUZZ_CRASH, FUZZ_NONE
+from killerbeez_tpu.drivers.factory import driver_factory
+from killerbeez_tpu.fuzzer.loop import Fuzzer
+from killerbeez_tpu.instrumentation.factory import instrumentation_factory
+from killerbeez_tpu.models import targets
+from killerbeez_tpu.models import targets_cgc
+from killerbeez_tpu.models.vm import run_batch
+from killerbeez_tpu.mutators.factory import mutator_factory
+
+
+def _run_one(prog, data: bytes):
+    buf = np.zeros((1, max(len(data), 8)), np.uint8)
+    buf[0, :len(data)] = np.frombuffer(data, np.uint8)
+    return run_batch(prog, buf, np.array([len(data)], np.int32))
+
+
+# ---------------- KBVM ports ----------------
+
+@pytest.mark.parametrize("name", sorted(targets_cgc.VM_SEEDS))
+def test_vm_seed_runs_clean(name):
+    prog = targets.get_target(name)
+    seed_fn, _ = targets_cgc.VM_SEEDS[name]
+    res = _run_one(prog, seed_fn())
+    assert int(res.status[0]) == FUZZ_NONE
+    assert int(res.exit_code[0]) == 0        # happy path, not "bad"
+
+
+@pytest.mark.parametrize("name", sorted(targets_cgc.VM_SEEDS))
+def test_vm_crash_repro(name):
+    prog = targets.get_target(name)
+    _, crash_fn = targets_cgc.VM_SEEDS[name]
+    res = _run_one(prog, crash_fn())
+    assert int(res.status[0]) == FUZZ_CRASH
+
+
+def test_vm_block_scale():
+    """The flagship bench target must not be a toy: CGC-scale block
+    counts so coverage doesn't saturate in one batch."""
+    assert targets.get_target("tlvstack_vm").n_blocks >= 100
+    assert targets.get_target("imgparse_vm").n_blocks >= 30
+
+
+def test_vm_seed_covers_many_blocks():
+    """The seed input alone should walk a nontrivial block set (loops,
+    handlers), giving the fuzzer a graded landscape."""
+    prog = targets.get_target("tlvstack_vm")
+    seed_fn, _ = targets_cgc.VM_SEEDS["tlvstack_vm"]
+    res = _run_one(prog, seed_fn())
+    edges = np.asarray(res.edge_ids[0])
+    assert (edges >= 0).sum() >= 20
+
+
+def test_vm_bad_magic_distinct_exit():
+    prog = targets.get_target("tlvstack_vm")
+    res = _run_one(prog, b"NOPE")
+    assert int(res.status[0]) == FUZZ_NONE
+    assert int(res.exit_code[0]) == 1        # "bad" exit
+
+
+def test_priv_tier_needs_keyword():
+    """PRIV (0x0d) without a prior KEY unlock must take the bad exit."""
+    prog = targets.get_target("tlvstack_vm")
+    res = _run_one(prog, b"STK1" + bytes([0x0D, 3]))
+    assert int(res.exit_code[0]) == 1
+    res = _run_one(prog, b"STK1" + bytes([0x0C, 0]) +
+                   targets_cgc._KEYWORD + bytes([0x0D, 3, 0x0B, 0]))
+    assert int(res.exit_code[0]) == 0
+
+
+def test_imgparse_vm_checksum_enforced():
+    prog = targets.get_target("imgparse_vm")
+    good = targets_cgc.imgparse_vm_seed()
+    bad = bytearray(good)
+    bad[-1] ^= 0xFF                           # corrupt E-chunk cksum
+    res = _run_one(prog, bytes(bad))
+    assert int(res.exit_code[0]) == 1
+
+
+def test_havoc_finds_tlvstack_vm_bug(tmp_path):
+    """One bit from the planted SIND bug: the crash repro with its
+    final opcode turned into HALT (0x0b; the bug op is 0x0a) — havoc
+    must flip it back and surface the crash on-device.  (imgparse_vm's
+    bugs sit behind per-chunk checksums, deliberately out of reach of
+    dumb byte mutation — the realistic CGC property.)"""
+    seed = bytearray(targets_cgc.tlvstack_vm_crash())
+    assert seed[-2] == 0x0A
+    seed[-2] = 0x0B                              # SIND -> HALT
+    instr = instrumentation_factory(
+        "jit_harness", '{"target": "tlvstack_vm", '
+        '"novelty": "throughput"}')
+    mut = mutator_factory("havoc", '{"seed": 5}', bytes(seed))
+    drv = driver_factory("file", None, instr, mut)
+    fz = Fuzzer(drv, output_dir=str(tmp_path / "o"), batch_size=512)
+    stats = fz.run(8192)
+    assert stats.crashes > 0
+    assert stats.new_paths > 0
+
+
+def test_vm_and_native_crash_repros_stay_in_sync(corpus_seeds):
+    """corpus/seeds.py (native, jax-free standalone script) and
+    targets_cgc (KBVM) deliberately define the tlvstack crash bytes
+    twice; this pins them byte-identical so the 'same planted bug'
+    claim can't silently desynchronize."""
+    assert corpus_seeds.tlvstack_crash() == targets_cgc.tlvstack_vm_crash()
+    assert corpus_seeds.chunk(b"H", b"\x01\x02") == \
+        targets_cgc._chunk(b"H", b"\x01\x02")
+
+
+# ---------------- native parsers ----------------
+
+NATIVE = ["imgparse", "tlvstack", "rledec"]
+
+
+@pytest.fixture(scope="module")
+def corpus_seeds():
+    """The corpus/seeds.py module (seed + crash generators)."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "corpus_seeds", os.path.join(os.path.dirname(__file__),
+                                     "..", "corpus", "seeds.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize("name", NATIVE)
+def test_native_seed_and_crash(corpus_bin, corpus_seeds, name):
+    from killerbeez_tpu.native.exec_backend import ExecTarget, classify
+    seed = [v for k, v in corpus_seeds.SEEDS.items()
+            if k.startswith(name + ".")][0]()
+    crash = [v for k, v in corpus_seeds.SEEDS.items()
+             if k.startswith(name + "_crash")][0]()
+    with ExecTarget([corpus_bin(name)], use_stdin=True,
+                    use_forkserver=True, coverage=True,
+                    timeout=5.0) as t:
+        assert classify(t.run(seed))[0] == FUZZ_NONE
+        assert classify(t.run(crash))[0] == FUZZ_CRASH
+
+
+@pytest.mark.parametrize("name", NATIVE)
+def test_native_coverage_depth(corpus_bin, corpus_seeds, name):
+    """A valid seed must touch clearly more edges than garbage input —
+    the parsers have real depth for coverage to climb."""
+    from killerbeez_tpu.native.exec_backend import ExecTarget
+    seed = [v for k, v in corpus_seeds.SEEDS.items()
+            if k.startswith(name + ".")][0]()
+    with ExecTarget([corpus_bin(name)], use_stdin=True,
+                    use_forkserver=True, coverage=True) as t:
+        t.clear_trace()
+        t.run(b"\xff\xff")
+        garbage_edges = int((t.trace_bits() != 0).sum())
+        t.clear_trace()
+        t.run(seed)
+        seed_edges = int((t.trace_bits() != 0).sum())
+    assert seed_edges > garbage_edges + 5

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_NONE, MAP_SIZE
-from ..native.exec_backend import ExecTarget, classify
+from ..native.exec_backend import ExecPool, ExecTarget, classify
 from ..ops.coverage import (
     COUNT_CLASS_LOOKUP, classify_counts, count_non_255_bytes,
     merge_virgin, simplify_trace,
@@ -75,6 +75,7 @@ class AflInstrumentation(Instrumentation):
         "deferred_startup": int, "qemu_mode": int, "qemu_path": str,
         "timeout": float, "mem_limit": int, "preload_forkserver": int,
         "device_triage": int, "ignore_bytes_file": str, "edges": int,
+        "workers": int,
     }
     OPTION_DESCS = {
         "use_fork_server": "1 = fork per exec via the forkserver "
@@ -98,11 +99,14 @@ class AflInstrumentation(Instrumentation):
                              "from novelty",
         "edges": "1 = keep the last exec's nonzero bitmap slots for "
                  "get_edges() (tracer mode)",
+        "workers": "N>1: shard batches over N parallel forkserver "
+                   "instances (stdin delivery only; the reference's "
+                   "multi-instance fuzzer_id scaling in one process)",
     }
     DEFAULTS = {"use_fork_server": 1, "persistence_max_cnt": 0,
                 "deferred_startup": 0, "qemu_mode": 0, "timeout": 2.0,
                 "mem_limit": 0, "preload_forkserver": 0,
-                "device_triage": 1, "edges": 0}
+                "device_triage": 1, "edges": 0, "workers": 1}
 
     def __init__(self, options: Optional[str] = None):
         super().__init__(options)
@@ -153,8 +157,7 @@ class AflInstrumentation(Instrumentation):
             return self._target
         if self._target is not None:
             self._target.close()
-        self._target = ExecTarget(
-            self._build_argv(cmd_line),
+        kwargs = dict(
             use_stdin=use_stdin,
             input_file=input_file,
             use_forkserver=bool(self.options["use_fork_server"]),
@@ -165,6 +168,13 @@ class AflInstrumentation(Instrumentation):
             mem_limit_mb=int(self.options["mem_limit"]),
             coverage=True,
             timeout=float(self.options["timeout"]))
+        workers = int(self.options["workers"])
+        argv = self._build_argv(cmd_line)
+        if workers > 1 and use_stdin and input_file is None:
+            self._target = ExecPool(argv, workers, **kwargs)
+        else:
+            # file delivery shares the driver's @@ path: single instance
+            self._target = ExecTarget(argv, **kwargs)
         self._target_key = key
         return self._target
 

@@ -44,11 +44,16 @@ TIP_SEED = np.uint32(0x1994C9A5)  # control-flow-target stream hash
 TNT_SEED = np.uint32(0x7E57ED01)  # branch-outcome stream hash
 
 
-@partial(jax.jit, static_argnames=("mem_size", "max_steps"))
-def _ipt_step(instrs, inputs, lengths, filt_lo, filt_hi, mem_size,
-              max_steps):
-    """VM exec + per-lane (tip, tnt) trace hashes, one XLA program."""
-    res = _run_batch_impl(instrs, inputs, lengths, mem_size, max_steps)
+@partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges"))
+def _ipt_step(instrs, edge_table, inputs, lengths, filt_lo, filt_hi,
+              mem_size, max_steps, n_edges):
+    """VM exec + per-lane (tip, tnt) trace hashes, one XLA program.
+
+    Runs the engine in stream-recording mode: the hash pair is over
+    the ORDERED, filter-windowed edge stream, which the static count
+    table can't express."""
+    res = _run_batch_impl(instrs, edge_table, inputs, lengths, mem_size,
+                          max_steps, n_edges, True)
     statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
                          res.status)
     ids = res.edge_ids  # int32[B, T], -1 padding
@@ -95,6 +100,7 @@ class IptInstrumentation(Instrumentation):
             "PMU, absent on TPU-VM hosts; use the afl instrumentation "
             "for host targets")
         self._instrs = jnp.asarray(prog.instrs)
+        self._edge_table = jnp.asarray(prog.edge_table)
         filters = self.options.get("filters") or [[0, (1 << 31) - 1]]
         filt = np.asarray(filters, dtype=np.int32)
         if filt.ndim != 2 or filt.shape[1] != 2:
@@ -114,8 +120,10 @@ class IptInstrumentation(Instrumentation):
         inputs = jnp.asarray(inputs, dtype=jnp.uint8)
         lengths = jnp.asarray(lengths, dtype=jnp.int32)
         statuses, exit_codes, tip, tnt = _ipt_step(
-            self._instrs, inputs, lengths, self._filt_lo, self._filt_hi,
-            self.program.mem_size, self.program.max_steps)
+            self._instrs, self._edge_table,
+            inputs, lengths, self._filt_lo, self._filt_hi,
+            self.program.mem_size, self.program.max_steps,
+            self.program.n_edges)
         statuses = np.asarray(statuses)
         tip = np.asarray(tip, dtype=np.uint64)
         tnt = np.asarray(tnt, dtype=np.uint64)

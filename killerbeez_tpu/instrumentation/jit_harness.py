@@ -36,20 +36,15 @@ from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_NONE, FUZZ_RUNNING, MAP_SIZE
 from ..models import targets as targets_mod
 from ..models.vm import run_batch as vm_run_batch
 from ..ops.coverage import (
-    build_bitmap, classify_counts, count_non_255_bytes, has_new_bits,
+    classify_counts, count_non_255_bytes, has_new_bits,
     merge_virgin, simplify_trace,
 )
-from ..ops.sparse_coverage import sparse_triage
+from ..ops.static_triage import (
+    counts_by_slot, expand_to_map, make_static_maps, static_triage,
+)
 from ..utils.serialization import decode_array, encode_array
 from .base import BatchResult, Instrumentation
 from .factory import register_instrumentation
-
-
-def _triage_throughput(vb, vc, vh, edge_ids, valid, statuses):
-    """Sparse-path triage: O(B*T) instead of O(B*MAP_SIZE)."""
-    crash = statuses == FUZZ_CRASH
-    hang = statuses == FUZZ_HANG
-    return sparse_triage(vb, vc, vh, edge_ids, valid, crash, hang)
 
 
 def _triage_exact(vb, vc, vh, cls, simp, statuses):
@@ -72,24 +67,30 @@ def _triage_exact(vb, vc, vh, cls, simp, statuses):
     return new_paths, uc, uh, vb2, vc2, vh2
 
 
-@partial(jax.jit, static_argnames=("mem_size", "max_steps", "exact"))
-def _fused_step(instrs, inputs, lengths, vb, vc, vh, mem_size, max_steps,
-                exact):
-    """mutated batch -> VM exec -> bitmaps -> triage, one XLA program."""
+@partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
+                                   "exact"))
+def _fused_step(instrs, edge_table, u_slots, seg_id, inputs, lengths,
+                vb, vc, vh, mem_size, max_steps, n_edges, exact):
+    """mutated batch -> VM exec -> static-edge triage, one XLA program."""
     from ..models.vm import _run_batch_impl  # batched one-hot engine
-    res = _run_batch_impl(instrs, inputs, lengths, mem_size, max_steps)
+    res = _run_batch_impl(instrs, edge_table, inputs, lengths, mem_size,
+                          max_steps, n_edges, False)
     statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG, res.status)
     if exact:
-        bitmap = build_bitmap(res.edge_ids, res.edge_ids >= 0)
+        # dense parity path: expand the static universe back to the
+        # 64KB map shape and judge lanes sequentially
+        by_slot = counts_by_slot(res.counts, seg_id, u_slots.shape[0])
+        bitmap = expand_to_map(by_slot, u_slots)
         cls = classify_counts(bitmap)
         simp = simplify_trace(bitmap)
         new_paths, uc, uh, vb2, vc2, vh2 = _triage_exact(
             vb, vc, vh, cls, simp, statuses)
     else:
-        new_paths, uc, uh, vb2, vc2, vh2 = _triage_throughput(
-            vb, vc, vh, res.edge_ids, res.edge_ids >= 0, statuses)
+        new_paths, uc, uh, vb2, vc2, vh2 = static_triage(
+            vb, vc, vh, res.counts, u_slots, seg_id,
+            statuses == FUZZ_CRASH, statuses == FUZZ_HANG)
     return (statuses, new_paths, uc, uh, res.exit_code, vb2, vc2, vh2,
-            res.edge_ids)
+            res.counts)
 
 
 @register_instrumentation
@@ -119,11 +120,15 @@ class JitHarnessInstrumentation(Instrumentation):
             raise ValueError('novelty must be "exact" or "throughput"')
         self.exact = self.options["novelty"] == "exact"
         self._instrs = jnp.asarray(prog.instrs)
+        self._edge_table = jnp.asarray(prog.edge_table)
+        u_slots, seg_id = make_static_maps(prog.edge_slot)
+        self._u_slots = jnp.asarray(u_slots)
+        self._seg_id = jnp.asarray(seg_id)
         self.virgin_bits = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
         self.virgin_crash = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
         self.virgin_tmout = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
         self.total_execs = 0
-        self._last_edges: Optional[np.ndarray] = None
+        self._last_counts: Optional[np.ndarray] = None
         self._last_unique_crash = False
         self._last_unique_hang = False
 
@@ -133,14 +138,15 @@ class JitHarnessInstrumentation(Instrumentation):
         inputs = jnp.asarray(inputs, dtype=jnp.uint8)
         lengths = jnp.asarray(lengths, dtype=jnp.int32)
         (statuses, new_paths, uc, uh, exit_codes, vb, vc, vh,
-         edge_ids) = _fused_step(
-            self._instrs, inputs, lengths, self.virgin_bits,
+         counts) = _fused_step(
+            self._instrs, self._edge_table, self._u_slots, self._seg_id,
+            inputs, lengths, self.virgin_bits,
             self.virgin_crash, self.virgin_tmout, self.program.mem_size,
-            self.program.max_steps, self.exact)
+            self.program.max_steps, self.program.n_edges, self.exact)
         self.virgin_bits, self.virgin_crash, self.virgin_tmout = vb, vc, vh
         self.total_execs += int(inputs.shape[0])
         if self.options.get("edges"):
-            self._last_edges = np.asarray(edge_ids)
+            self._last_counts = np.asarray(counts)
         return BatchResult(
             statuses=np.asarray(statuses),
             new_paths=np.asarray(new_paths),
@@ -174,13 +180,38 @@ class JitHarnessInstrumentation(Instrumentation):
 
     def get_edges(self) -> Optional[List[Tuple[int, int]]]:
         """Edge slots of the last exec (lane 0) as (slot, hit_count)
-        pairs; tracer consumes these (requires {"edges": 1})."""
-        if self._last_edges is None:
+        pairs; tracer consumes these (requires {"edges": 1}).
+
+        Counts are mod-256, exactly like AFL's uint8 trace_bits: an
+        edge hit a multiple of 256 times wraps to 0 and drops out —
+        the same (known) blind spot the reference inherits from its
+        map format."""
+        if self._last_counts is None:
             return None
-        ids = self._last_edges[0]
-        ids = ids[ids >= 0]
-        slots, counts = np.unique(ids, return_counts=True)
-        return [(int(s), int(c)) for s, c in zip(slots, counts)]
+        c = self._last_counts[0, :-1].astype(np.int64)
+        slots = np.asarray(self.program.edge_slot)
+        agg: dict = {}
+        for s, n in zip(slots, c):
+            if n:
+                agg[int(s)] = agg.get(int(s), 0) + int(n)
+        return sorted(agg.items())
+
+    def get_edge_pairs(self) -> Optional[List[Tuple[int, int, int]]]:
+        """(from_id, to_id, hit_count) records of the last exec —
+        the reference's edge mode returns instrumentation_edge_t
+        {from, to} lists (dynamorio_instrumentation.c:1577-1606); the
+        static universe makes the pair exact (0 = program entry).
+        Counts are mod-256 (see get_edges)."""
+        if self._last_counts is None:
+            return None
+        c = self._last_counts[0, :-1]
+        ids = self.program.block_ids
+        out = []
+        for e in np.nonzero(c)[0]:
+            f = int(self.program.edge_from[e])
+            t = int(self.program.edge_to[e])
+            out.append((0 if f < 0 else ids[f], ids[t], int(c[e])))
+        return out
 
     def get_module_info(self) -> List[str]:
         return [self.program.name]

@@ -50,6 +50,14 @@ class Assembler:
 
     def _emit(self, op: int, a: Union[int, str] = 0,
               b: Union[int, str] = 0, c: Union[int, str] = 0) -> int:
+        for field in (a, b, c):
+            # the batched engine fetches instructions through an f32
+            # matmul (vm._step_batched), exact only below 2^24
+            if isinstance(field, int) and abs(field) >= (1 << 24):
+                raise ValueError(
+                    f"instruction field {field} exceeds the engine's "
+                    f"2^24 exact-integer bound; build large constants "
+                    f"with shl/or")
         self.rows.append([op, a, b, c])
         return len(self.rows) - 1
 

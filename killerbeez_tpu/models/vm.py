@@ -64,31 +64,154 @@ CMP_EQ, CMP_NE, CMP_LT, CMP_GE = range(4)
 
 @dataclass(frozen=True)
 class Program:
-    """A compiled target: shared instruction tensor + metadata."""
+    """A compiled target: shared instruction tensor + metadata.
+
+    The coverage-edge universe of a KBVM program is STATIC — every
+    dynamically possible (prev BLOCK, next BLOCK) pair is enumerable
+    from the instruction graph at build time.  ``__post_init__``
+    derives it once (`compute_edges`): the batched engine then
+    accumulates a dense uint8[B, n_edges+1] hit-count table instead of
+    materializing per-step edge streams, and triage runs over the few
+    hundred real edges instead of sorting [B, max_steps] streams or
+    scanning 64KB maps.  afl-as has no such luxury (targets are opaque
+    binaries); this is the jit-harness tier's structural advantage.
+
+    Derived fields (filled automatically):
+      edge_from  int32[E]  source block index (-1 = program entry)
+      edge_to    int32[E]  destination block index
+      edge_slot  int32[E]  AFL map slot: to_id ^ (from_id >> 1)
+      edge_table int32[n_blocks+1, n_blocks]  (from+1, to) -> edge
+                 index; impossible pairs -> E (overflow column)
+    """
     instrs: np.ndarray            # int32[NI, 4]
     name: str = "anon"
     mem_size: int = 64
     max_steps: int = 256          # hang budget (per-exec step cap)
     n_blocks: int = 0             # number of BLOCK instructions
     block_ids: Tuple[int, ...] = ()
+    edge_from: Optional[np.ndarray] = None
+    edge_to: Optional[np.ndarray] = None
+    edge_slot: Optional[np.ndarray] = None
+    edge_table: Optional[np.ndarray] = None
 
     def __post_init__(self):
         assert self.instrs.ndim == 2 and self.instrs.shape[1] == 4
         assert self.instrs.dtype == np.int32
+        if np.abs(self.instrs[:, 1:]).max(initial=0) >= (1 << 24):
+            raise ValueError(
+                "instruction field exceeds the batched engine's 2^24 "
+                "exact-integer bound (f32 matmul fetch); build large "
+                "constants with shl/or")
+        if self.edge_table is None:
+            instrs, ef, et, es, tbl, n_blocks, ids = compute_edges(
+                self.instrs)
+            object.__setattr__(self, "instrs", instrs)
+            object.__setattr__(self, "edge_from", ef)
+            object.__setattr__(self, "edge_to", et)
+            object.__setattr__(self, "edge_slot", es)
+            object.__setattr__(self, "edge_table", tbl)
+            if not self.n_blocks:
+                object.__setattr__(self, "n_blocks", n_blocks)
+            if not self.block_ids:
+                object.__setattr__(self, "block_ids", ids)
+
+    @property
+    def n_edges(self) -> int:
+        return int(self.edge_from.shape[0])
+
+
+def compute_edges(instrs: np.ndarray):
+    """Enumerate the static edge universe of an instruction tensor.
+
+    Returns ``(instrs', edge_from, edge_to, edge_slot, edge_table,
+    n_blocks, block_ids)`` where instrs' is a copy with each BLOCK
+    row's b field set to the block's ordinal index (the engine reads
+    it to key the edge table).
+
+    An edge (f, t) exists when some instruction path runs from block
+    f's body to block t's BLOCK head without crossing another BLOCK;
+    f = -1 models the entry path (prev_loc starts at 0, so the slot is
+    just t's id — matching the dynamic ``cur ^ prev`` fold).
+    """
+    ni = instrs.shape[0]
+    instrs = instrs.copy()
+    block_pcs = [pc for pc in range(ni) if instrs[pc, 0] == OP_BLOCK]
+    idx_of_pc = {pc: k for k, pc in enumerate(block_pcs)}
+    for k, pc in enumerate(block_pcs):
+        instrs[pc, 2] = k
+    nb = len(block_pcs)
+    ids = tuple(int(instrs[pc, 1]) & (MAP_SIZE - 1) for pc in block_pcs)
+
+    def succs(pc):
+        op, a, b, c = instrs[pc]
+        if op in (OP_HALT, OP_CRASH):
+            return []
+        if op == OP_JMP:
+            return [int(a)]
+        if op == OP_BR:
+            return [int(c), pc + 1]
+        return [pc + 1]
+
+    pairs = set()
+    def walk(from_idx, start_pc):
+        seen = set()
+        stack = [start_pc]
+        while stack:
+            pc = stack.pop()
+            if pc in seen or pc < 0 or pc >= ni:
+                continue           # out-of-range pc = crash, no edge
+            seen.add(pc)
+            if instrs[pc, 0] == OP_BLOCK:
+                pairs.add((from_idx, idx_of_pc[pc]))
+                continue
+            stack.extend(succs(pc))
+
+    walk(-1, 0)
+    for k, pc in enumerate(block_pcs):
+        walk(k, pc + 1)
+
+    order = sorted(pairs)
+    e = len(order)
+    edge_from = np.array([f for f, _ in order] or [], dtype=np.int32)
+    edge_to = np.array([t for _, t in order] or [], dtype=np.int32)
+    slot = []
+    for f, t in order:
+        prev_loc = 0 if f < 0 else (ids[f] >> 1)
+        slot.append(ids[t] ^ prev_loc)
+    edge_slot = np.array(slot or [], dtype=np.int32)
+    edge_table = np.full((nb + 1, max(nb, 1)), e, dtype=np.int32)
+    for k, (f, t) in enumerate(order):
+        edge_table[f + 1, t] = k
+    return (instrs, edge_from, edge_to, edge_slot, edge_table, nb, ids)
 
 
 class VMResult(NamedTuple):
-    """Per-lane execution outcome."""
+    """Per-lane execution outcome.
+
+    ``counts`` is the production coverage record: hit counts over the
+    program's static edge universe (last column = overflow for pairs
+    outside the enumerated table — never taken for well-formed
+    programs).  ``edge_ids`` is the optional time-ordered stream
+    (tracer / ipt / parity tests); fuzz steps run with it disabled.
+    ``path_hash`` is an order-aware hash of the block-id sequence,
+    folded incrementally during execution (the ipt tier's path
+    identity without materializing the stream).
+    """
     status: jax.Array      # int32[B]: FUZZ_NONE / FUZZ_CRASH / FUZZ_RUNNING
     exit_code: jax.Array   # int32[B]
-    edge_ids: jax.Array    # int32[B, T] edge stream (-1 = no edge)
+    counts: jax.Array      # uint8[B, E+1] static-edge hit counts
     steps: jax.Array       # int32[B] steps actually executed
+    path_hash: jax.Array   # uint32[B]
+    edge_ids: Optional[jax.Array] = None  # int32[B, T] (-1 = no edge)
 
 
-def _step(instrs, input_buf, input_len, mem_size, state):
-    """One VM step for one lane. state = (pc, regs, mem, prev_loc,
-    status, exit_code). Returns (state, edge_id)."""
-    pc, regs, mem, prev_loc, status, exit_code = state
+def _step(instrs, edge_table, input_buf, input_len, mem_size, state):
+    """One VM step for one lane (the readable reference engine the
+    batched one-hot engine is parity-tested against). state = (pc,
+    regs, mem, prev_loc, status, exit_code, prev_idx, counts,
+    path_hash). Returns (state, edge_id)."""
+    pc, regs, mem, prev_loc, status, exit_code, prev_idx, counts, \
+        path_hash = state
     ni = instrs.shape[0]
     row = instrs[jnp.clip(pc, 0, ni - 1)]
     op, a, b, c = row[0], row[1], row[2], row[3]
@@ -177,6 +300,16 @@ def _step(instrs, input_buf, input_len, mem_size, state):
     cur_loc = a & (MAP_SIZE - 1)
     edge_id = jnp.where(is_block, cur_loc ^ prev_loc, -1)
     new_prev = jnp.where(is_block, cur_loc >> 1, prev_loc)
+    nb = edge_table.shape[1]
+    cur_idx = jnp.clip(b, 0, nb - 1)
+    eidx = edge_table[jnp.clip(prev_idx, 0, nb), cur_idx]
+    new_counts = counts.at[jnp.where(is_block, eidx,
+                                     counts.shape[0] - 1)].add(
+        jnp.where(is_block, jnp.uint8(1), jnp.uint8(0)), mode="drop")
+    new_prev_idx = jnp.where(is_block, cur_idx + 1, prev_idx)
+    new_hash = jnp.where(
+        is_block, _mix32(path_hash ^ cur_loc.astype(jnp.uint32)),
+        path_hash)
 
     # lanes that already halted/crashed freeze in place
     def keep(new, old):
@@ -184,11 +317,14 @@ def _step(instrs, input_buf, input_len, mem_size, state):
 
     out_state = (keep(new_pc, pc), keep(new_regs, regs),
                  keep(new_mem, mem), keep(new_prev, prev_loc),
-                 keep(new_status, status), keep(new_exit, exit_code))
+                 keep(new_status, status), keep(new_exit, exit_code),
+                 keep(new_prev_idx, prev_idx), new_counts,
+                 keep(new_hash, path_hash))
     return out_state, edge_id
 
 
-def _run_one(instrs, mem_size, max_steps, input_buf, input_len):
+def _run_one(instrs, edge_table, n_edges, mem_size, max_steps,
+             input_buf, input_len):
     """Execute one lane to completion (or step budget).
 
     Uses ``while_loop`` rather than a fixed-length scan: under vmap
@@ -201,7 +337,10 @@ def _run_one(instrs, mem_size, max_steps, input_buf, input_len):
               jnp.zeros(mem_size, dtype=jnp.int32),
               jnp.int32(0),
               jnp.int32(FUZZ_RUNNING),
-              jnp.int32(0))
+              jnp.int32(0),
+              jnp.int32(0),
+              jnp.zeros(n_edges + 1, dtype=jnp.uint8),
+              jnp.uint32(0))
     edges0 = jnp.full((max_steps,), -1, dtype=jnp.int32)
 
     def cond(carry):
@@ -210,16 +349,17 @@ def _run_one(instrs, mem_size, max_steps, input_buf, input_len):
 
     def body(carry):
         state, edges, i = carry
-        new_state, edge = _step(instrs, input_buf, input_len, mem_size,
-                                state)
+        new_state, edge = _step(instrs, edge_table, input_buf,
+                                input_len, mem_size, state)
         edges = edges.at[i].set(edge, mode="drop")
         return new_state, edges, i + 1
 
     final, edges, steps = jax.lax.while_loop(cond, body,
                                              (state0, edges0,
                                               jnp.int32(0)))
-    return VMResult(status=final[4], exit_code=final[5], edge_ids=edges,
-                    steps=steps)
+    return VMResult(status=final[4], exit_code=final[5],
+                    counts=final[7], steps=steps, path_hash=final[8],
+                    edge_ids=edges)
 
 
 # --------------------------------------------------------------------
@@ -244,20 +384,38 @@ def _onehot_pick(table, idx, axis_len):
     return jnp.sum(jnp.where(lanes == idx[:, None], table, 0), axis=1)
 
 
-def _step_batched(instrs, bufs_t, lengths, mem_size, state):
+def _mix32(x):
+    """murmur3 finalizer — the per-block path-hash mixer."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _step_batched(instrs, edge_table, bufs_t, lengths, mem_size,
+                  record_stream, state):
     """One VM step for ALL lanes. state = (pc, regs, mem, prev_loc,
-    status, exit_code, edges, i, lane_steps); arrays are [B, ...];
-    bufs_t is the transposed input [L, B] so byte selects run over
-    static rows."""
-    pc, regs, mem, prev_loc, status, exit_code, edges, i, lane_steps = state
+    status, exit_code, prev_idx, counts, path_hash, edges, i,
+    lane_steps); arrays are [B, ...]; bufs_t is the transposed input
+    [L, B] so byte selects run over static rows."""
+    (pc, regs, mem, prev_loc, status, exit_code, prev_idx, counts,
+     path_hash, edges, i, lane_steps) = state
     ni = instrs.shape[0]
     L = bufs_t.shape[0]
     running = status == FUZZ_RUNNING
 
     pcc = jnp.clip(pc, 0, ni - 1)
     onehot_pc = pcc[:, None] == jnp.arange(ni, dtype=jnp.int32)[None, :]
-    row = jnp.sum(jnp.where(onehot_pc[:, :, None], instrs[None, :, :], 0),
-                  axis=1)                                    # [B, 4]
+    # instruction fetch as an MXU matmul: the one-hot row has exactly
+    # one 1, so the f32 dot is exact for any field < 2^24 (block ids
+    # are < 2^16, imms/pcs far smaller) and XLA fuses the compare into
+    # the matmul operand instead of materializing [B, NI, 4] selects
+    row = jax.lax.dot(onehot_pc.astype(jnp.float32),
+                      instrs.astype(jnp.float32),
+                      precision=jax.lax.Precision.HIGHEST)
+    row = row.astype(jnp.int32)                              # [B, 4]
     op, a, b, c = row[:, 0], row[:, 1], row[:, 2], row[:, 3]
 
     rb_idx = (c >> 3) & (N_REGS - 1)
@@ -331,12 +489,38 @@ def _step_batched(instrs, bufs_t, lengths, mem_size, state):
 
     is_block = (op == OP_BLOCK) & running
     cur_loc = a & (MAP_SIZE - 1)
-    edge = jnp.where(is_block, cur_loc ^ prev_loc, -1)
     new_prev = jnp.where(is_block, cur_loc >> 1, prev_loc)
-    t = edges.shape[1]
-    emask = (jnp.arange(t, dtype=jnp.int32)[None, :] == i) & \
-        running[:, None]
-    new_edges = jnp.where(emask, edge[:, None], edges)
+
+    # static-edge hit counts: the BLOCK row's b field is the block
+    # ordinal; (prev block, this block) keys the edge table.  The
+    # two-level lookup runs as a matmul + masked pick (no per-lane
+    # gather, same trick as the instruction fetch).
+    nb = edge_table.shape[1]
+    cur_idx = jnp.clip(b, 0, nb - 1)
+    onehot_prev = (prev_idx[:, None]
+                   == jnp.arange(edge_table.shape[0],
+                                 dtype=jnp.int32)[None, :])
+    rows_e = jax.lax.dot(onehot_prev.astype(jnp.float32),
+                         edge_table.astype(jnp.float32),
+                         precision=jax.lax.Precision.HIGHEST)  # [B, nb]
+    eidx = _onehot_pick(rows_e.astype(jnp.int32), cur_idx, nb)
+    n_e = counts.shape[1]                         # E + 1 (overflow)
+    emask_e = (jnp.arange(n_e, dtype=jnp.int32)[None, :]
+               == eidx[:, None]) & is_block[:, None]
+    new_counts = counts + emask_e.astype(jnp.uint8)
+    new_prev_idx = jnp.where(is_block, cur_idx + 1, prev_idx)
+    new_hash = jnp.where(
+        is_block, _mix32(path_hash ^ cur_loc.astype(jnp.uint32)),
+        path_hash)
+
+    if record_stream:
+        edge = jnp.where(is_block, cur_loc ^ prev_loc, -1)
+        t = edges.shape[1]
+        emask = (jnp.arange(t, dtype=jnp.int32)[None, :] == i) & \
+            running[:, None]
+        new_edges = jnp.where(emask, edge[:, None], edges)
+    else:
+        new_edges = edges
 
     def keep(new, old):
         return jnp.where(running, new, old)
@@ -347,12 +531,16 @@ def _step_batched(instrs, bufs_t, lengths, mem_size, state):
             keep(new_prev, prev_loc),
             keep(new_status, status),
             keep(new_exit, exit_code),
+            keep(new_prev_idx, prev_idx),
+            new_counts, keep(new_hash, path_hash),
             new_edges, i + 1,
             lane_steps + running.astype(jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("mem_size", "max_steps"))
-def _run_batch_impl(instrs, inputs, lengths, mem_size, max_steps):
+@partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
+                                   "record_stream"))
+def _run_batch_impl(instrs, edge_table, inputs, lengths, mem_size,
+                    max_steps, n_edges, record_stream=False):
     b = inputs.shape[0]
     state0 = (jnp.zeros(b, jnp.int32),
               jnp.zeros((b, N_REGS), jnp.int32),
@@ -360,43 +548,56 @@ def _run_batch_impl(instrs, inputs, lengths, mem_size, max_steps):
               jnp.zeros(b, jnp.int32),
               jnp.full(b, FUZZ_RUNNING, jnp.int32),
               jnp.zeros(b, jnp.int32),
-              jnp.full((b, max_steps), -1, jnp.int32),
+              jnp.zeros(b, jnp.int32),                     # prev_idx
+              jnp.zeros((b, n_edges + 1), jnp.uint8),      # counts
+              jnp.zeros(b, jnp.uint32),                    # path_hash
+              (jnp.full((b, max_steps), -1, jnp.int32)
+               if record_stream else jnp.zeros((b, 0), jnp.int32)),
               jnp.int32(0),
               jnp.zeros(b, jnp.int32))
     bufs_t = inputs.T
     lengths = lengths.astype(jnp.int32)
 
     def cond(s):
-        return jnp.any(s[4] == FUZZ_RUNNING) & (s[7] < max_steps)
+        return jnp.any(s[4] == FUZZ_RUNNING) & (s[10] < max_steps)
 
     def body(s):
-        return _step_batched(instrs, bufs_t, lengths, mem_size, s)
+        return _step_batched(instrs, edge_table, bufs_t, lengths,
+                             mem_size, record_stream, s)
 
     final = jax.lax.while_loop(cond, body, state0)
     return VMResult(status=final[4], exit_code=final[5],
-                    edge_ids=final[6], steps=final[8])
+                    counts=final[7], steps=final[11],
+                    path_hash=final[8],
+                    edge_ids=final[9] if record_stream else None)
 
 
-def run_batch(program: Program, inputs: jax.Array, lengths: jax.Array
-              ) -> VMResult:
+def run_batch(program: Program, inputs: jax.Array, lengths: jax.Array,
+              record_stream: bool = True) -> VMResult:
     """Execute a uint8[B, L] candidate batch through the program.
 
     Lanes still RUNNING after ``program.max_steps`` are hangs —
     callers map FUZZ_RUNNING -> FUZZ_HANG, mirroring the reference's
-    wait-loop timeout.
+    wait-loop timeout.  ``record_stream=False`` skips the [B, T] edge
+    stream (production fuzz steps use the static-edge counts).
     """
-    return _run_batch_impl(jnp.asarray(program.instrs), inputs, lengths,
-                           program.mem_size, program.max_steps)
+    return _run_batch_impl(jnp.asarray(program.instrs),
+                           jnp.asarray(program.edge_table),
+                           inputs, lengths,
+                           program.mem_size, program.max_steps,
+                           program.n_edges, record_stream)
 
 
-def compile_runner(program: Program):
+def compile_runner(program: Program, record_stream: bool = True):
     """Return a jitted ``(inputs, lengths) -> VMResult`` closure with
     the instruction tensor baked in (constant-folded by XLA)."""
     instrs = jnp.asarray(program.instrs)
+    edge_table = jnp.asarray(program.edge_table)
 
     @jax.jit
     def runner(inputs, lengths):
-        return _run_batch_impl(instrs, inputs, lengths,
-                               program.mem_size, program.max_steps)
+        return _run_batch_impl(instrs, edge_table, inputs, lengths,
+                               program.mem_size, program.max_steps,
+                               program.n_edges, record_stream)
 
     return runner

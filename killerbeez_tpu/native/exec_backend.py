@@ -270,3 +270,98 @@ class ExecTarget:
             self.close()
         except Exception:
             pass
+
+
+class ExecPool:
+    """N independent forkserver instances fed batch shards in parallel.
+
+    The reference scales host throughput by running N fuzzer processes
+    with distinct SHM names (dynamorio_instrumentation.c:418-431 picks
+    a random fuzzer_id per instance); here one fuzzer process shards
+    each batch across N ``ExecTarget`` instances — each with its own
+    forkserver, IPC_PRIVATE SHM segment and temp stdin file — on a
+    thread pool.  ctypes releases the GIL for the duration of
+    ``kb_target_run_batch``, so the C exec loops genuinely overlap.
+
+    Only stdin-style delivery is poolable (every worker owns a private
+    input file); file-mode targets share the driver's ``@@`` path and
+    must stay single-instance.
+
+    The single-exec surface (``run``/``trace_bits``/...) delegates to
+    worker 0, so an ExecPool drops into ExecTarget call sites.
+    """
+
+    def __init__(self, argv: Sequence[str], n_workers: int, **kwargs):
+        if kwargs.get("input_file"):
+            raise ValueError("ExecPool requires per-worker private "
+                             "input files (stdin mode)")
+        from concurrent.futures import ThreadPoolExecutor
+        self.targets = [ExecTarget(argv, **kwargs)
+                        for _ in range(max(n_workers, 1))]
+        self._tp = ThreadPoolExecutor(max_workers=len(self.targets))
+        self.coverage = self.targets[0].coverage
+        self.timeout = self.targets[0].timeout
+
+    @property
+    def n_workers(self) -> int:
+        return len(self.targets)
+
+    def run_batch(self, inputs: np.ndarray, lengths: np.ndarray,
+                  want_bitmaps: bool = True,
+                  timeout: Optional[float] = None
+                  ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        n = inputs.shape[0]
+        bounds = np.linspace(0, n, len(self.targets) + 1).astype(int)
+        shards = [(self.targets[i], bounds[i], bounds[i + 1])
+                  for i in range(len(self.targets))
+                  if bounds[i + 1] > bounds[i]]
+        futs = [self._tp.submit(t.run_batch, inputs[lo:hi],
+                                lengths[lo:hi], want_bitmaps, timeout)
+                for t, lo, hi in shards]
+        stats, maps = [], []
+        for f in futs:
+            s, m = f.result()
+            stats.append(s)
+            maps.append(m)
+        statuses = np.concatenate(stats) if stats else \
+            np.empty(0, dtype=np.int32)
+        bitmaps = (np.concatenate(maps)
+                   if want_bitmaps and self.coverage and maps else None)
+        return statuses, bitmaps
+
+    # -- single-exec surface: worker 0 ---------------------------------
+
+    def run(self, data: bytes, timeout: Optional[float] = None) -> int:
+        return self.targets[0].run(data, timeout)
+
+    def run_debug(self, data: bytes, timeout: Optional[float] = None):
+        return self.targets[0].run_debug(data, timeout)
+
+    def launch(self, timeout: float = 10.0) -> int:
+        return self.targets[0].launch(timeout)
+
+    def alive(self) -> bool:
+        return self.targets[0].alive()
+
+    def wait_done(self, timeout: Optional[float] = None) -> int:
+        return self.targets[0].wait_done(timeout)
+
+    def trace_bits(self) -> Optional[np.ndarray]:
+        return self.targets[0].trace_bits()
+
+    def clear_trace(self) -> None:
+        self.targets[0].clear_trace()
+
+    def total_execs(self) -> int:
+        return sum(t.total_execs() for t in self.targets)
+
+    def close(self) -> None:
+        self._tp.shutdown(wait=True)
+        for t in self.targets:
+            t.close()
+
+    def __enter__(self) -> "ExecPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -280,7 +280,7 @@ def interesting_at(buf: jax.Array, length: jax.Array, it: jax.Array
 N_HAVOC_OPS = 15
 
 
-def _havoc_one(buf, length, key):
+def _havoc_one(buf, length, words):
     """One stacked havoc edit, chosen uniformly from the op table.
 
     Branch-free: under vmap a 15-way ``lax.switch`` lowers to
@@ -299,16 +299,21 @@ def _havoc_one(buf, length, key):
     clone/fill block.
     """
     L = buf.shape[-1]
-    ks = jax.random.split(key, 8)
-    op = jax.random.randint(ks[0], (), 0, N_HAVOC_OPS)
-    pos = jax.random.randint(ks[1], (), 0, jnp.maximum(length, 1))
-    pos2 = jax.random.randint(ks[2], (), 0, jnp.maximum(length, 1))
-    rbyte = jax.random.randint(ks[3], (), 0, 256).astype(jnp.uint32)
-    rint = jax.random.randint(ks[4], (), 0, 2**31 - 1).astype(jnp.uint32)
-    be = jax.random.bernoulli(ks[5])
-    blk = jax.random.randint(ks[6], (), 1,
-                             jnp.maximum(length // 2, 2)).astype(jnp.int32)
-    bit = jax.random.randint(ks[7], (), 0, jnp.maximum(length * 8, 1))
+    # words: uint32[8] of pre-generated random bits (one bulk threefry
+    # call in havoc_at instead of 16 split/randint chains per edit —
+    # the PRNG was the majority of mutation time).  Ranged draws use
+    # modulo (AFL's rand() % n has the same bias).
+    op = (words[0] % N_HAVOC_OPS).astype(jnp.int32)
+    maxlen = jnp.maximum(length, 1).astype(jnp.uint32)
+    pos = (words[1] % maxlen).astype(jnp.int32)
+    pos2 = (words[2] % maxlen).astype(jnp.int32)
+    rbyte = words[3] % 256
+    rint = words[4] & 0x7FFFFFFF
+    be = (words[5] & 1) == 1
+    blk_span = jnp.maximum(length // 2, 2).astype(jnp.uint32) - 1
+    blk = (1 + words[6] % jnp.maximum(blk_span, 1)).astype(jnp.int32)
+    bit = (words[7] % jnp.maximum(length * 8, 1).astype(jnp.uint32)
+           ).astype(jnp.int32)
     delta = (rint % ARITH_MAX + 1).astype(jnp.uint32)
     use_fill = (rint % 4) == 0  # insert/overwrite: 25% fill, 75% clone
 
@@ -365,7 +370,12 @@ def _havoc_one(buf, length, key):
     src = jnp.where(is_del, src_del,
                     jnp.where(is_ins, src_ins,
                               jnp.where(is_ovw, src_ovw, idx)))
-    gathered = buf[jnp.clip(src, 0, L - 1)]
+    # one-hot shuffle instead of buf[src]: a per-lane dynamic gather
+    # is the slowest construct on the VPU (see read_bytes)
+    src_c = jnp.clip(src, 0, L - 1)
+    oh = src_c[:, None] == idx[None, :]                     # [L, L]
+    gathered = jnp.sum(jnp.where(oh, buf[None, :], 0),
+                       axis=1, dtype=jnp.int32).astype(jnp.uint8)
 
     # xor mask (bit flip / xor byte)
     xval = jnp.where(is_flip, jnp.uint32(128) >> (bit & 7).astype(
@@ -402,22 +412,23 @@ def havoc_at(buf: jax.Array, length: jax.Array, key: jax.Array,
     computed for every lane — raise ``stack_pow2`` via mutator options
     to trade throughput for per-candidate aggression.
     """
-    k0, k1 = jax.random.split(key)
     n_steps = 1 << stack_pow2
-    stack = jnp.uint32(1) << (1 + jax.random.randint(
-        k0, (), 0, stack_pow2)).astype(jnp.uint32)
+    # ALL random bits for the stacked edits in one threefry call
+    words = jax.random.bits(key, (n_steps + 1, 8), dtype=jnp.uint32)
+    stack = jnp.uint32(1) << (1 + words[0, 0] % stack_pow2)
 
-    def step(carry, i):
+    def step(carry, xs):
+        i, w = xs
         b, ln = carry
-        kk = jax.random.fold_in(k1, i)
-        nb, nln = _havoc_one(b, ln, kk)
+        nb, nln = _havoc_one(b, ln, w)
         active = i < stack
         b = jnp.where(active, nb, b)
         ln = jnp.where(active, nln, ln)
         return (b, ln), None
 
     (out, out_len), _ = jax.lax.scan(
-        step, (buf, length), jnp.arange(n_steps, dtype=jnp.uint32))
+        step, (buf, length),
+        (jnp.arange(n_steps, dtype=jnp.uint32), words[1:]))
     return out, out_len
 
 

@@ -1,0 +1,129 @@
+"""Static-edge triage — AFL-map novelty over a known edge universe.
+
+The KBVM compiler enumerates every dynamically possible coverage edge
+of a program (``Program.edge_slot``, vm.compute_edges), so triage
+never has to touch the 64KB map shape or sort per-lane streams: the
+whole pipeline runs over ``[B, U]`` where U = number of distinct AFL
+map slots the program can hit (a few hundred).
+
+Semantics are the dense AFL contract (classify_counts buckets,
+``has_new_bits`` ret codes, simplify_trace crash/hang maps including
+the absent-edge "1" class) restricted to the static universe — which
+is EXACT for jit-harness targets: slots outside the universe are
+never hit, so their dense-path contribution is the constant class-1
+pattern, reproduced here by ``_outside_mask`` on the first unique
+crash/hang.
+
+The reference's equivalents scan the full map every exec
+(afl_instrumentation.c:600-707 has_new_bits over 64KB;
+dynamorio_instrumentation.c:1428-1469 classify+hash short-circuit);
+this is the TPU-shaped replacement the one-hot KBVM engine makes
+possible.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import MAP_SIZE
+from .coverage import classify_counts
+from .sparse_coverage import _first_occurrence_multi, stream_hash
+
+
+def make_static_maps(edge_slot: np.ndarray
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """(u_slots int32[U] sorted unique AFL slots, seg_id int32[E]
+    edge-index -> slot-group index). Host-side, once per program."""
+    u_slots, seg_id = np.unique(np.asarray(edge_slot), return_inverse=True)
+    return u_slots.astype(np.int32), seg_id.astype(np.int32)
+
+
+def counts_by_slot(counts: jax.Array, seg_id: jax.Array,
+                   n_slots: int) -> jax.Array:
+    """Fold edge hit counts into AFL map cells: colliding edges (same
+    ``cur ^ prev`` slot) share a cell, wrapping at u8 exactly like the
+    dense ``trace_bits[slot]++``.
+
+    counts: uint8[B, E+1] (overflow column dropped) -> uint8[B, U].
+    """
+    c = counts[:, :-1]
+    b = c.shape[0]
+    out = jnp.zeros((b, n_slots), jnp.uint8)
+    return out.at[:, seg_id].add(c)
+
+
+def expand_to_map(by_slot: jax.Array, u_slots: jax.Array) -> jax.Array:
+    """uint8[B, U] -> uint8[B, MAP_SIZE] dense bitmaps (the parity /
+    state-export shape). u_slots are unique so .set suffices."""
+    b = by_slot.shape[0]
+    out = jnp.zeros((b, MAP_SIZE), jnp.uint8)
+    return out.at[:, u_slots].set(by_slot)
+
+
+def _outside_mask(u_slots: jax.Array) -> jax.Array:
+    """uint8[MAP_SIZE]: the constant simplify_trace contribution of
+    slots outside the universe (class 1 everywhere, 0 at u_slots)."""
+    m = jnp.full((MAP_SIZE,), 1, jnp.uint8)
+    return m.at[u_slots].set(0)
+
+
+def static_triage(vb: jax.Array, vc: jax.Array, vh: jax.Array,
+                  counts: jax.Array, u_slots: jax.Array,
+                  seg_id: jax.Array, crash: jax.Array,
+                  hang: jax.Array):
+    """Fused throughput triage over the static universe.
+
+    Args: vb/vc/vh uint8[MAP_SIZE] virgin maps, counts uint8[B, E+1],
+    u_slots int32[U], seg_id int32[E], crash/hang bool[B].
+    Returns (rets int32[B], uc bool[B], uh bool[B], vb', vc', vh') —
+    same contract as sparse_coverage.sparse_triage, exact dense
+    semantics (all lanes judged vs the incoming maps, in-batch dedup
+    by map hash, virgin updates folded over the new lanes).
+    """
+    u = u_slots.shape[0]
+    by_slot = counts_by_slot(counts, seg_id, u)       # [B, U]
+    cls = classify_counts(by_slot)
+    simp = jnp.where(by_slot != 0, jnp.uint8(128), jnp.uint8(1))
+
+    def novelty(virgin, classes):
+        v = virgin[u_slots][None, :]                  # [1, U]
+        new_count = jnp.any((classes & v) != 0, axis=1)
+        new_tuple = jnp.any((classes != 0) & (v == 0xFF), axis=1)
+        return jnp.where(new_tuple, 2, jnp.where(new_count, 1, 0))
+
+    rets = novelty(vb, cls)
+    crash_rets = novelty(vc, simp)
+    hang_rets = novelty(vh, simp)
+
+    # dedup on CLASSIFIED counts (two lanes whose hit counts fall in
+    # the same AFL buckets are the same path — hashing raw counts
+    # would double-report them; sparse_triage hashed classes too)
+    hashes = stream_hash(cls.astype(jnp.uint32))
+    first_all, first_crash, first_hang = _first_occurrence_multi(
+        hashes, crash, hang)
+    rets = jnp.where(first_all, rets, 0).astype(jnp.int32)
+    uc = first_crash & (crash_rets > 0)
+    uh = first_hang & (hang_rets > 0)
+
+    def upd(virgin, classes, active, with_outside):
+        """Clear the OR of active lanes' class bits; crash/hang maps
+        also clear the constant outside-universe class-1 pattern
+        (dense simplify_trace parity)."""
+        def do(v):
+            seen = jax.lax.reduce(
+                jnp.where(active[:, None], classes, jnp.uint8(0)),
+                jnp.uint8(0), jax.lax.bitwise_or, dimensions=(0,))
+            v = v.at[u_slots].set(v[u_slots] & ~seen)
+            if with_outside:
+                v = v & ~_outside_mask(u_slots)
+            return v
+        return jax.lax.cond(jnp.any(active), do, lambda v: v, virgin)
+
+    vb2 = upd(vb, cls, rets > 0, False)
+    vc2 = upd(vc, simp, uc, True)
+    vh2 = upd(vh, simp, uh, True)
+    return rets, uc, uh, vb2, vc2, vh2

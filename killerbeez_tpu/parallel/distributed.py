@@ -36,6 +36,7 @@ from ..models.vm import Program, _run_batch_impl
 from ..ops.coverage import classify_counts, simplify_trace
 from ..ops.hashing import hash_bitmaps
 from ..ops.mutate_core import havoc_at
+from ..ops.static_triage import counts_by_slot, make_static_maps
 
 
 def make_mesh(n_dp: int, n_mp: int = 1, devices=None) -> Mesh:
@@ -66,15 +67,16 @@ def sharded_state_init(mesh: Mesh) -> ShardedFuzzState:
     )
 
 
-def _slice_bitmap(edge_ids, valid, slice_size, slice_lo):
-    """Per-lane hit counts for this shard's [lo, lo+size) id range."""
-    b = edge_ids.shape[0]
-    local = edge_ids - slice_lo
-    ok = valid & (local >= 0) & (local < slice_size)
-    ids = jnp.where(ok, local, slice_size)
-    zeros = jnp.zeros((b, slice_size), dtype=jnp.uint8)
-    return zeros.at[jnp.arange(b)[:, None], ids].add(jnp.uint8(1),
-                                                     mode="drop")
+def _slice_bitmap(counts, u_slots, seg_id, slice_size, slice_lo):
+    """Per-lane hit counts for this shard's [lo, lo+size) slot range,
+    scattered from the program's static edge universe (u_slots are
+    unique, so in-slice scatter positions never collide)."""
+    b = counts.shape[0]
+    by_slot = counts_by_slot(counts, seg_id, u_slots.shape[0])
+    in_slice = (u_slots >= slice_lo) & (u_slots < slice_lo + slice_size)
+    idx = jnp.where(in_slice, u_slots - slice_lo, slice_size)
+    bm = jnp.zeros((b, slice_size + 1), dtype=jnp.uint8)
+    return bm.at[:, idx].set(by_slot)[:, :slice_size]
 
 
 def _gather_and_fold(v_local, axis):
@@ -101,6 +103,10 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         raise ValueError("mp must divide MAP_SIZE")
     slice_size = MAP_SIZE // n_mp
     instrs = jnp.asarray(program.instrs)
+    edge_table = jnp.asarray(program.edge_table)
+    u_slots_np, seg_id_np = make_static_maps(program.edge_slot)
+    u_slots = jnp.asarray(u_slots_np)
+    seg_id = jnp.asarray(seg_id_np)
 
     def local_step(vb, vc, vh, seed_buf, seed_len, base_it):
         # ---- which shard am I ----
@@ -121,13 +127,14 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
                                stack_pow2=stack_pow2))(keys)
 
         # ---- execute (batched one-hot engine) ----
-        res = _run_batch_impl(instrs, bufs, lens, program.mem_size,
-                              program.max_steps)
+        res = _run_batch_impl(instrs, edge_table, bufs, lens,
+                              program.mem_size, program.max_steps,
+                              program.n_edges, False)
         statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
                              res.status)
 
         # ---- coverage on my map slice ----
-        bm = _slice_bitmap(res.edge_ids, res.edge_ids >= 0, slice_size,
+        bm = _slice_bitmap(res.counts, u_slots, seg_id, slice_size,
                            slice_lo)
         cls = classify_counts(bm)
         simp = simplify_trace(bm)

@@ -1,10 +1,10 @@
 """Break down the fused fuzz step's time on the real chip.
 
-Times each stage of the pipeline (mutation / VM execution / sparse
-triage / full fused step) separately under its own jit, so BENCH
-regressions can be attributed.  Run on the TPU:
+Times each stage of the pipeline (mutation / VM execution /
+static-edge triage / full fused step) separately under its own jit,
+so BENCH regressions can be attributed.  Run on the TPU:
 
-    python profiling/profile_step.py [target] [B] [steps]
+    python profiling/profile_step.py [target] [B] [L]
 
 Writes a human table to stdout and the raw numbers to
 profiling/profile_<target>.json.
@@ -30,6 +30,13 @@ def timeit(fn, *args, warmup=1, iters=5):
     return (time.perf_counter() - t0) / iters
 
 
+def seed_for(target: str) -> bytes:
+    from killerbeez_tpu.models import targets_cgc
+    if target in targets_cgc.VM_SEEDS:
+        return targets_cgc.VM_SEEDS[target][0]()
+    return b"ABC@"
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -38,20 +45,25 @@ def main():
     from killerbeez_tpu.models import targets
     from killerbeez_tpu.models.vm import _run_batch_impl
     from killerbeez_tpu.instrumentation.jit_harness import _fused_step
-    from killerbeez_tpu.ops.sparse_coverage import sparse_triage
+    from killerbeez_tpu.ops.static_triage import (
+        make_static_maps, static_triage,
+    )
     from killerbeez_tpu.ops.mutate_core import havoc_at
 
     target = sys.argv[1] if len(sys.argv) > 1 else "test"
     B = int(sys.argv[2]) if len(sys.argv) > 2 else 32768
-    L = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+    seed = seed_for(target)
+    L = int(sys.argv[3]) if len(sys.argv) > 3 else max(8, len(seed))
 
     prog = targets.get_target(target)
     instrs = jnp.asarray(prog.instrs)
+    edge_table = jnp.asarray(prog.edge_table)
+    u_np, s_np = make_static_maps(prog.edge_slot)
+    u_slots, seg_id = jnp.asarray(u_np), jnp.asarray(s_np)
     print(f"target={target} NI={prog.instrs.shape[0]} "
-          f"mem={prog.mem_size} max_steps={prog.max_steps} B={B} L={L}",
-          file=sys.stderr)
+          f"E={prog.n_edges} U={len(u_np)} mem={prog.mem_size} "
+          f"max_steps={prog.max_steps} B={B} L={L}", file=sys.stderr)
 
-    seed = b"ABC@"
     seed_buf = np.zeros(L, dtype=np.uint8)
     seed_buf[:len(seed)] = np.frombuffer(seed, dtype=np.uint8)
     seed_buf = jnp.asarray(seed_buf)
@@ -70,41 +82,43 @@ def main():
 
     @jax.jit
     def vm_only(bufs, lens):
-        return _run_batch_impl(instrs, bufs, lens, prog.mem_size,
-                               prog.max_steps)
+        return _run_batch_impl(instrs, edge_table, bufs, lens,
+                               prog.mem_size, prog.max_steps,
+                               prog.n_edges, False)
 
     res = vm_only(bufs, lens)
-    jax.block_until_ready(res.edge_ids)
+    jax.block_until_ready(res.counts)
     steps_used = int(res.steps.max())
 
     virgin = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
+    statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG, res.status)
 
     @jax.jit
-    def triage_only(vb, vc, vh, edge_ids, statuses):
-        return sparse_triage(vb, vc, vh, edge_ids, edge_ids >= 0,
+    def triage_only(vb, vc, vh, counts, statuses):
+        return static_triage(vb, vc, vh, counts, u_slots, seg_id,
                              statuses == FUZZ_CRASH,
                              statuses == FUZZ_HANG)
-
-    statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG, res.status)
 
     @jax.jit
     def fused(vb, vc, vh, it):
         bufs, lens = mutate(it)
-        return _fused_step(instrs, bufs, lens, vb, vc, vh,
-                           prog.mem_size, prog.max_steps, False)
+        return _fused_step(instrs, edge_table, u_slots, seg_id, bufs,
+                           lens, vb, vc, vh, prog.mem_size,
+                           prog.max_steps, prog.n_edges, False)
 
     rows = {}
     rows["mutate"] = timeit(mutate, jnp.uint32(1))
     rows["vm_only"] = timeit(vm_only, bufs, lens)
     rows["triage_only"] = timeit(triage_only, virgin, virgin, virgin,
-                                 res.edge_ids, statuses)
+                                 res.counts, statuses)
     rows["fused_step"] = timeit(fused, virgin, virgin, virgin,
                                 jnp.uint32(1))
 
     print(f"max lane steps used: {steps_used}/{prog.max_steps}",
           file=sys.stderr)
     out = {"target": target, "B": B, "L": L,
-           "NI": int(prog.instrs.shape[0]),
+           "NI": int(prog.instrs.shape[0]), "E": prog.n_edges,
+           "U": int(len(u_np)),
            "max_steps": prog.max_steps, "steps_used": steps_used,
            "times_s": rows,
            "execs_per_sec_fused": B / rows["fused_step"]}

@@ -162,3 +162,46 @@ def test_forkserver_restarts_after_exit(corpus_bin):
         t.stop()
         # next run transparently restarts the forkserver
         assert classify(t.run(b"ABCD"))[0] == FUZZ_CRASH
+
+
+def test_exec_pool_matches_single_instance(corpus_bin):
+    """ExecPool shards a batch over N forkservers; statuses and
+    bitmaps must line up with the single-instance run, in order."""
+    from killerbeez_tpu.native.exec_backend import ExecPool
+    inputs = np.zeros((8, 4), dtype=np.uint8)
+    seqs = [b"ABCD", b"zzzz", b"ABC@", b"ABCD", b"aaaa", b"ABzz",
+            b"ABCD", b"Azzz"]
+    for i, s in enumerate(seqs):
+        inputs[i, :len(s)] = np.frombuffer(s, dtype=np.uint8)
+    lengths = np.full(8, 4, dtype=np.int32)
+
+    with ExecTarget([corpus_bin("test")], use_stdin=True,
+                    use_forkserver=True, coverage=True) as solo:
+        s_stat, s_maps = solo.run_batch(inputs, lengths)
+    with ExecPool([corpus_bin("test")], 4, use_stdin=True,
+                  use_forkserver=True, coverage=True) as pool:
+        p_stat, p_maps = pool.run_batch(inputs, lengths)
+    np.testing.assert_array_equal(s_stat, p_stat)
+    np.testing.assert_array_equal(s_maps, p_maps)
+    crash_rows = [classify(int(x))[0] == FUZZ_CRASH for x in p_stat]
+    assert crash_rows == [s == b"ABCD" for s in seqs]
+
+
+def test_afl_workers_option(corpus_bin):
+    """The afl instrumentation's workers option builds a pool and the
+    batched path keeps exact counts."""
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    from killerbeez_tpu.native.exec_backend import ExecPool
+    instr = instrumentation_factory("afl", '{"workers": 3}')
+    instr.prepare_host(corpus_bin("test"), use_stdin=True)
+    assert isinstance(instr._target, ExecPool)
+    inputs = np.zeros((6, 4), dtype=np.uint8)
+    for i, s in enumerate([b"ABCD", b"zzzz", b"ABC@", b"yyyy",
+                           b"ABCD", b"ABCz"]):
+        inputs[i, :len(s)] = np.frombuffer(s, dtype=np.uint8)
+    res = instr.run_batch(inputs, np.full(6, 4, dtype=np.int32))
+    assert (res.statuses == 2).sum() == 2          # both ABCD lanes
+    assert instr.total_execs == 6
+    instr.cleanup()

@@ -172,7 +172,7 @@ from killerbeez_tpu.parallel import (make_mesh, make_sharded_fuzz_step,
 mesh = make_mesh(4, 2)
 prog = targets.get_target('tlvstack_vm')
 step = make_sharded_fuzz_step(prog, mesh, batch_per_device=64, max_len=32)
-state = sharded_state_init(mesh)
+state = sharded_state_init(mesh, prog.map_size)
 seed = targets_cgc.tlvstack_vm_seed()
 buf = np.zeros(32, np.uint8); buf[:len(seed)] = np.frombuffer(seed, np.uint8)
 state, st, rets, bufs, lens = step(state, jnp.asarray(buf),

@@ -1,0 +1,16 @@
+/* libtest1 — shared-library half of the native per-module coverage
+ * fixture (reference corpus/libtest role): built with kb-cc into
+ * libtest1.so, so it carries its own kb_rt copy and, under
+ * KB_MODULES=1, claims its own map partition. */
+
+int lib_check(const unsigned char *buf, int n) {
+  int depth = 0;
+  if (n < 2) return 0;
+  if (buf[1] == 'X') {
+    depth = 2;
+    if (n > 2 && buf[2] == 'Y') depth = 3;
+  } else if (buf[1] == 'Z') {
+    depth = 1;
+  }
+  return depth;
+}

@@ -1,0 +1,47 @@
+/* libtest — main half of the native per-module fixture: input 'L...'
+ * routes into the shared library (its own coverage module under
+ * KB_MODULES=1); anything else stays in the main binary's blocks. */
+#include <stdio.h>
+#include <unistd.h>
+
+int lib_check(const unsigned char *buf, int n);
+
+int __kb_persistent_loop(unsigned max_cnt) __attribute__((weak));
+void __kb_manual_init(void) __attribute__((weak));
+
+static int run_once(const char *path) {
+  unsigned char buf[64];
+  ssize_t n;
+  if (path) {
+    FILE *f = fopen(path, "rb");
+    if (!f) return 1;
+    n = (ssize_t)fread(buf, 1, sizeof(buf), f);
+    fclose(f);
+  } else {
+    n = read(0, buf, sizeof(buf));
+  }
+  if (n < 1) {
+    printf("empty\n");
+    return 0;
+  }
+  if (buf[0] == 'L') {
+    printf("lib depth %d\n", lib_check(buf, (int)n));
+  } else if (buf[0] == 'M') {
+    printf("main deep\n");
+  } else {
+    printf("main shallow\n");
+  }
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  const char *path = argc > 1 ? argv[1] : 0;
+  if (__kb_manual_init) __kb_manual_init();
+  if (__kb_persistent_loop) {
+    while (__kb_persistent_loop(1000)) {
+      if (run_once(path)) return 1;
+    }
+    return 0;
+  }
+  return run_once(path);
+}

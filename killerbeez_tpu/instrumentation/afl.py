@@ -35,7 +35,7 @@ from ..ops.coverage import (
     merge_virgin, simplify_trace,
 )
 from ..utils.serialization import decode_array, encode_array
-from .base import BatchResult, Instrumentation
+from .base import BatchResult, Instrumentation, module_slice_edges
 from .factory import register_instrumentation
 from .jit_harness import _triage_exact
 
@@ -75,7 +75,7 @@ class AflInstrumentation(Instrumentation):
         "deferred_startup": int, "qemu_mode": int, "qemu_path": str,
         "timeout": float, "mem_limit": int, "preload_forkserver": int,
         "device_triage": int, "ignore_bytes_file": str, "edges": int,
-        "workers": int,
+        "workers": int, "modules": int,
     }
     OPTION_DESCS = {
         "use_fork_server": "1 = fork per exec via the forkserver "
@@ -102,11 +102,16 @@ class AflInstrumentation(Instrumentation):
         "workers": "N>1: shard batches over N parallel forkserver "
                    "instances (stdin delivery only; the reference's "
                    "multi-instance fuzzer_id scaling in one process)",
+        "modules": "1 = per-module coverage: each kb-cc-built object "
+                   "(main binary, shared libraries) claims its own map "
+                   "partition + virgin state (reference per-module "
+                   "maps, dynamorio_instrumentation.h:27-41)",
     }
     DEFAULTS = {"use_fork_server": 1, "persistence_max_cnt": 0,
                 "deferred_startup": 0, "qemu_mode": 0, "timeout": 2.0,
                 "mem_limit": 0, "preload_forkserver": 0,
-                "device_triage": 1, "edges": 0, "workers": 1}
+                "device_triage": 1, "edges": 0, "workers": 1,
+                "modules": 0}
 
     def __init__(self, options: Optional[str] = None):
         super().__init__(options)
@@ -168,6 +173,10 @@ class AflInstrumentation(Instrumentation):
             mem_limit_mb=int(self.options["mem_limit"]),
             coverage=True,
             timeout=float(self.options["timeout"]))
+        if self.options["modules"]:
+            # targets read KB_MODULES at constructor time; delivered
+            # as per-target child env, not the fuzzer's own environ
+            kwargs["extra_env"] = ["KB_MODULES=1"]
         workers = int(self.options["workers"])
         argv = self._build_argv(cmd_line)
         if workers > 1 and use_stdin and input_file is None:
@@ -363,7 +372,37 @@ class AflInstrumentation(Instrumentation):
         return [(int(i), int(self._last_trace[i])) for i in idx]
 
     def get_module_info(self) -> List[str]:
+        """Module names. With {"modules": 1} these come from the SHM
+        name table each kb_rt copy registered in (main binary + every
+        kb-cc-built shared library); otherwise one anonymous module."""
+        if self.options["modules"] and self._target is not None:
+            names = self._target.module_table()
+            if names:
+                return names
         return ["target"]
+
+    def _partition_size(self) -> int:
+        """Module partition width: 8KB submaps under {"modules": 1},
+        else the whole map is the single "target" module."""
+        from ..native.exec_backend import KB_MOD_SIZE
+        return KB_MOD_SIZE if self.options["modules"] else MAP_SIZE
+
+    def get_module_edges(self, module: str):
+        """get_edges restricted to one module's map partition, with
+        partition-local slot numbers (requires {"modules": 1,
+        "edges": 1})."""
+        return module_slice_edges(self.get_edges(),
+                                   self.get_module_info(), module,
+                                   self._partition_size())
+
+    def module_coverage_bytes(self) -> Dict[str, int]:
+        """Touched virgin bytes per module partition."""
+        ps = self._partition_size()
+        out = {}
+        for m, name in enumerate(self.get_module_info()):
+            sl = self.virgin_bits[m * ps:(m + 1) * ps]
+            out[name] = int((sl != 0xFF).sum())
+        return out
 
     def cleanup(self) -> None:
         if self._target is not None:

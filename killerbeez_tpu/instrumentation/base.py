@@ -132,6 +132,11 @@ class Instrumentation:
         """Names of instrumented modules (per-module coverage)."""
         return []
 
+    def get_edge_pairs(self, module: Optional[str] = None):
+        """(from, to, count) records of the last execution (reference
+        instrumentation_edge_t lists); None when unsupported."""
+        return None
+
     # -- state ----------------------------------------------------------
 
     def get_state(self) -> str:
@@ -151,3 +156,15 @@ class Instrumentation:
             head += f" — {doc[0]}"
         return head + "\n" + format_help(cls.name, cls.OPTION_SCHEMA,
                                          cls.OPTION_DESCS)
+
+
+def module_slice_edges(edges, module_names: List[str], module: str,
+                       partition_size: int):
+    """Restrict a global (slot, count) edge list to one module's map
+    partition, renumbering slots partition-locally (shared by the afl
+    and jit_harness per-module views)."""
+    if edges is None:
+        return None
+    m = module_names.index(module)
+    lo, hi = m * partition_size, (m + 1) * partition_size
+    return [(s - lo, c) for s, c in edges if lo <= s < hi]

@@ -43,7 +43,7 @@ from ..ops.static_triage import (
     counts_by_slot, expand_to_map, make_static_maps, static_triage,
 )
 from ..utils.serialization import decode_array, encode_array
-from .base import BatchResult, Instrumentation
+from .base import BatchResult, Instrumentation, module_slice_edges
 from .factory import register_instrumentation
 
 
@@ -80,7 +80,7 @@ def _fused_step(instrs, edge_table, u_slots, seg_id, inputs, lengths,
         # dense parity path: expand the static universe back to the
         # 64KB map shape and judge lanes sequentially
         by_slot = counts_by_slot(res.counts, seg_id, u_slots.shape[0])
-        bitmap = expand_to_map(by_slot, u_slots)
+        bitmap = expand_to_map(by_slot, u_slots, vb.shape[0])
         cls = classify_counts(bitmap)
         simp = simplify_trace(bitmap)
         new_paths, uc, uh, vb2, vc2, vh2 = _triage_exact(
@@ -124,9 +124,13 @@ class JitHarnessInstrumentation(Instrumentation):
         u_slots, seg_id = make_static_maps(prog.edge_slot)
         self._u_slots = jnp.asarray(u_slots)
         self._seg_id = jnp.asarray(seg_id)
-        self.virgin_bits = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
-        self.virgin_crash = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
-        self.virgin_tmout = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
+        # one 64KB map per module, laid out flat: module m owns
+        # [m*MAP_SIZE, (m+1)*MAP_SIZE) — per-module virgin state like
+        # the reference's target_module_t list
+        ms = prog.map_size
+        self.virgin_bits = jnp.full((ms,), 0xFF, dtype=jnp.uint8)
+        self.virgin_crash = jnp.full((ms,), 0xFF, dtype=jnp.uint8)
+        self.virgin_tmout = jnp.full((ms,), 0xFF, dtype=jnp.uint8)
         self.total_execs = 0
         self._last_counts: Optional[np.ndarray] = None
         self._last_unique_crash = False
@@ -196,37 +200,88 @@ class JitHarnessInstrumentation(Instrumentation):
                 agg[int(s)] = agg.get(int(s), 0) + int(n)
         return sorted(agg.items())
 
-    def get_edge_pairs(self) -> Optional[List[Tuple[int, int, int]]]:
+    def get_edge_pairs(self, module: Optional[str] = None
+                       ) -> Optional[List[Tuple[int, int, int]]]:
         """(from_id, to_id, hit_count) records of the last exec —
         the reference's edge mode returns instrumentation_edge_t
-        {from, to} lists (dynamorio_instrumentation.c:1577-1606); the
-        static universe makes the pair exact (0 = program entry).
-        Counts are mod-256 (see get_edges)."""
+        {from, to} lists per module
+        (dynamorio_instrumentation.c:1577-1606); the static universe
+        makes the pair exact (0 = program entry).  ``module`` filters
+        to edges landing in that module.  Counts are mod-256 (see
+        get_edges)."""
         if self._last_counts is None:
             return None
         c = self._last_counts[0, :-1]
         ids = self.program.block_ids
+        mod_range = None
+        if module is not None:
+            m = list(self.program.module_names).index(module)
+            mod_range = self.program.modules[m][1:]
         out = []
         for e in np.nonzero(c)[0]:
             f = int(self.program.edge_from[e])
             t = int(self.program.edge_to[e])
+            if mod_range is not None and not \
+                    (mod_range[0] <= t < mod_range[1]):
+                continue
             out.append((0 if f < 0 else ids[f], ids[t], int(c[e])))
         return out
 
     def get_module_info(self) -> List[str]:
-        return [self.program.name]
+        """Coverage module names (reference get_module_info: one entry
+        per target module / shared library)."""
+        return list(self.program.module_names)
+
+    def module_coverage_bytes(self) -> Dict[str, int]:
+        """Touched virgin bytes per module (per-module novelty
+        reporting; reference dynamorio keeps per-module virgin maps)."""
+        vb = np.asarray(self.virgin_bits)
+        out = {}
+        for m, name in enumerate(self.program.module_names):
+            sl = vb[m * MAP_SIZE:(m + 1) * MAP_SIZE]
+            out[name] = int((sl != 0xFF).sum())
+        return out
+
+    def get_module_edges(self, module: str
+                         ) -> Optional[List[Tuple[int, int]]]:
+        """get_edges restricted to one module's slot space, with
+        module-local slot numbers (the reference's per-module edge
+        lists, dynamorio_instrumentation.c:1577-1606)."""
+        edges = self.get_edges()
+        if edges is None:
+            return None
+        m = list(self.program.module_names).index(module)
+        lo, hi = m * MAP_SIZE, (m + 1) * MAP_SIZE
+        return [(s - lo, c) for s, c in edges if lo <= s < hi]
 
     # -- state / merge --------------------------------------------------
 
     def get_state(self) -> str:
-        return json.dumps({
+        d = {
             "instrumentation": self.name,
             "target": self.program.name,
             "total_execs": self.total_execs,
             "virgin_bits": encode_array(np.asarray(self.virgin_bits)),
             "virgin_crash": encode_array(np.asarray(self.virgin_crash)),
             "virgin_tmout": encode_array(np.asarray(self.virgin_tmout)),
-        })
+        }
+        if len(self.program.modules) > 1:
+            d["modules"] = list(self.program.module_names)
+        return json.dumps(d)
+
+    def _check_state_layout(self, d: Dict[str, Any], arr) -> None:
+        """States only interoperate across identical module layouts:
+        a mismatched map size would be silently clamped/dropped by the
+        jitted gathers, corrupting novelty verdicts."""
+        if arr.shape != (self.program.map_size,):
+            raise ValueError(
+                f"state map is {arr.shape[0]} bytes but "
+                f"{self.program.name!r} has {self.program.map_size} "
+                f"({len(self.program.modules)} module(s))")
+        mods = d.get("modules")
+        if mods is not None and tuple(mods) != self.program.module_names:
+            raise ValueError(
+                f"state modules {mods} != {self.program.module_names}")
 
     def set_state(self, state: str) -> None:
         d = json.loads(state)
@@ -237,15 +292,18 @@ class JitHarnessInstrumentation(Instrumentation):
         self.total_execs = int(d.get("total_execs", 0))
         for key in ("virgin_bits", "virgin_crash", "virgin_tmout"):
             if key in d:
-                setattr(self, key, jnp.asarray(decode_array(d[key])))
+                arr = decode_array(d[key])
+                self._check_state_layout(d, arr)
+                setattr(self, key, jnp.asarray(arr))
 
     def merge(self, other_state: str) -> None:
         d = json.loads(other_state)
         for key in ("virgin_bits", "virgin_crash", "virgin_tmout"):
             if key in d:
                 mine = getattr(self, key)
-                theirs = jnp.asarray(decode_array(d[key]))
-                setattr(self, key, merge_virgin(mine, theirs))
+                arr = decode_array(d[key])
+                self._check_state_layout(d, arr)
+                setattr(self, key, merge_virgin(mine, jnp.asarray(arr)))
         self.total_execs += int(d.get("total_execs", 0))
 
     def coverage_bytes(self) -> int:

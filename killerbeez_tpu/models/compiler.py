@@ -40,6 +40,9 @@ class Assembler:
         self.rows: List[List[Union[int, str]]] = []
         self.labels: Dict[str, int] = {}
         self._n_blocks = 0
+        # (module_name, first_block_index) marks; blocks before the
+        # first mark belong to the default "target" module
+        self._module_marks: List[Tuple[str, int]] = []
 
     # -- assembly -------------------------------------------------------
 
@@ -70,6 +73,19 @@ class Assembler:
         """Basic-block head: coverage point (id assigned at build)."""
         self._n_blocks += 1
         self._emit(OP_BLOCK, f"__block_{self._n_blocks - 1}")
+
+    def module(self, name: str) -> None:
+        """Start a coverage module: subsequent blocks belong to it
+        (the reference's per-module maps — a target's shared libraries
+        each get their own map + virgin state,
+        dynamorio_instrumentation.h:27-41; here modules are
+        block-index ranges with their own 64KB slot space)."""
+        if self._module_marks and \
+                self._module_marks[-1][1] == self._n_blocks:
+            raise ValueError(
+                f"module {name!r} would start at the same block as "
+                f"{self._module_marks[-1][0]!r} (empty module)")
+        self._module_marks.append((name, self._n_blocks))
 
     def halt(self, code: int = 0) -> None:
         self._emit(OP_HALT, code)
@@ -142,10 +158,18 @@ class Assembler:
                 else:
                     out.append(int(field))
             instrs[i] = out
+        marks = self._module_marks
+        if not marks or marks[0][1] > 0:
+            marks = [("target", 0)] + marks
+        modules = tuple(
+            (name, lo, marks[i + 1][1] if i + 1 < len(marks)
+             else self._n_blocks)
+            for i, (name, lo) in enumerate(marks))
         return Program(instrs=instrs, name=self.name,
                        mem_size=self.mem_size, max_steps=self.max_steps,
                        n_blocks=self._n_blocks,
-                       block_ids=tuple(int(x) for x in ids))
+                       block_ids=tuple(int(x) for x in ids),
+                       modules=modules)
 
 
 def assign_block_ids(n_blocks: int, seed: int = 0xB10C) -> np.ndarray:

@@ -54,12 +54,19 @@ def load_program_from_options(options: Dict, missing_hint: str
 
     if "program_file" in options:
         d = np.load(options["program_file"], allow_pickle=False)
+        modules = ()
+        if "module_names" in d:
+            modules = tuple(
+                (str(n), int(lo), int(hi)) for n, lo, hi in
+                zip(d["module_names"], d["modules_lo"],
+                    d["modules_hi"]))
         prog = Program(
             instrs=d["instrs"].astype(np.int32),
             name=str(d["name"]) if "name" in d else "file",
             mem_size=int(d["mem_size"]), max_steps=int(d["max_steps"]),
             n_blocks=int(d.get("n_blocks", 0)),
-            block_ids=tuple(int(b) for b in d.get("block_ids", ())))
+            block_ids=tuple(int(b) for b in d.get("block_ids", ())),
+            modules=modules)
     else:
         target = options.get("target")
         if not target:
@@ -70,7 +77,8 @@ def load_program_from_options(options: Dict, missing_hint: str
                        mem_size=prog.mem_size,
                        max_steps=int(options["max_steps"]),
                        n_blocks=prog.n_blocks,
-                       block_ids=prog.block_ids)
+                       block_ids=prog.block_ids,
+                       modules=prog.modules)
     return prog
 
 
@@ -139,7 +147,9 @@ def libtest_target() -> Program:
     a.label("exit")
     a.block()                       # 3: plain-exit block
     a.halt(0)
-    # --- "library" ---
+    # --- "library": its own coverage module (own 64KB map + virgin
+    # state, like the reference's per-library target_module_t) ---
+    a.module("libtest1")
     a.label("lib")
     a.block()                       # 4: lib entry
     a.ldi(3, 1)

@@ -89,6 +89,7 @@ class Program:
     max_steps: int = 256          # hang budget (per-exec step cap)
     n_blocks: int = 0             # number of BLOCK instructions
     block_ids: Tuple[int, ...] = ()
+    modules: Tuple[Tuple[str, int, int], ...] = ()  # (name, lo, hi) blocks
     edge_from: Optional[np.ndarray] = None
     edge_to: Optional[np.ndarray] = None
     edge_slot: Optional[np.ndarray] = None
@@ -108,16 +109,38 @@ class Program:
             object.__setattr__(self, "instrs", instrs)
             object.__setattr__(self, "edge_from", ef)
             object.__setattr__(self, "edge_to", et)
-            object.__setattr__(self, "edge_slot", es)
             object.__setattr__(self, "edge_table", tbl)
             if not self.n_blocks:
                 object.__setattr__(self, "n_blocks", n_blocks)
             if not self.block_ids:
                 object.__setattr__(self, "block_ids", ids)
+            if not self.modules:
+                object.__setattr__(
+                    self, "modules", (("target", 0, self.n_blocks),))
+            # per-module slot spaces: an edge lands in the map of its
+            # DESTINATION block's module (winafl writes the edge into
+            # the current block's module map), at global offset
+            # module_index * MAP_SIZE
+            mod_of_block = np.zeros(max(self.n_blocks, 1),
+                                    dtype=np.int64)
+            for m, (_, lo, hi) in enumerate(self.modules):
+                mod_of_block[lo:hi] = m
+            es = es + (mod_of_block[et] * MAP_SIZE if len(et)
+                       else 0)
+            object.__setattr__(self, "edge_slot", es.astype(np.int32))
 
     @property
     def n_edges(self) -> int:
         return int(self.edge_from.shape[0])
+
+    @property
+    def map_size(self) -> int:
+        """Total coverage-map bytes: one 64KB map per module."""
+        return max(len(self.modules), 1) * MAP_SIZE
+
+    @property
+    def module_names(self) -> Tuple[str, ...]:
+        return tuple(m[0] for m in self.modules)
 
 
 def compute_edges(instrs: np.ndarray):

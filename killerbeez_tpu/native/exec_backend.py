@@ -23,6 +23,10 @@ from .. import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_NONE
 from .build import exec_lib_path, preload_path
 
 KB_MAP_SIZE = 1 << 16
+# per-module partitioning (KB_MODULES=1): mirrors kb_protocol.h
+KB_N_MODULES = 8
+KB_MOD_SIZE = KB_MAP_SIZE // KB_N_MODULES
+KB_MODTAB_NAME = 64
 
 
 class CrashInfo(ct.Structure):
@@ -70,6 +74,9 @@ def _load() -> ct.CDLL:
         ct.POINTER(CrashInfo)]
     lib.kb_target_trace_bits.restype = ct.POINTER(ct.c_uint8)
     lib.kb_target_trace_bits.argtypes = [ct.c_void_p]
+    lib.kb_target_module_table.restype = ct.c_void_p
+    lib.kb_target_module_table.argtypes = [ct.c_void_p]
+    lib.kb_target_add_env.argtypes = [ct.c_void_p, ct.c_char_p]
     lib.kb_target_clear_trace.argtypes = [ct.c_void_p]
     lib.kb_target_pid.restype = ct.c_int
     lib.kb_target_pid.argtypes = [ct.c_void_p]
@@ -106,7 +113,8 @@ class ExecTarget:
                  deferred: bool = False,
                  mem_limit_mb: int = 0,
                  coverage: bool = False,
-                 timeout: float = 2.0):
+                 timeout: float = 2.0,
+                 extra_env: Optional[Sequence[str]] = None):
         self._lib = _load()
         self.timeout = float(timeout)
         self._owns_input_file = input_file is None and use_stdin
@@ -131,6 +139,10 @@ class ExecTarget:
         if not self._h:
             raise RuntimeError(
                 f"kb_target_create: {self._lib.kb_last_error().decode()}")
+        for kv in (extra_env or ()):
+            # per-target child env (e.g. KB_MODULES=1) — never the
+            # fuzzer's own process-global environment
+            self._lib.kb_target_add_env(self._h, kv.encode())
         self.coverage = coverage
         self.use_forkserver = use_forkserver
         self._started = False
@@ -240,6 +252,19 @@ class ExecTarget:
 
     def clear_trace(self) -> None:
         self._lib.kb_target_clear_trace(self._h)
+
+    def module_table(self) -> List[str]:
+        """Names of the modules that claimed map partitions (targets
+        run with KB_MODULES=1); index = submap number."""
+        ptr = self._lib.kb_target_module_table(self._h)
+        if not ptr:
+            return []
+        out = []
+        for i in range(KB_N_MODULES):
+            name = ct.string_at(ptr + i * KB_MODTAB_NAME)
+            if name:
+                out.append(name.decode(errors="replace"))
+        return out
 
     def total_execs(self) -> int:
         return int(self._lib.kb_target_total_execs(self._h))
@@ -351,6 +376,9 @@ class ExecPool:
 
     def clear_trace(self) -> None:
         self.targets[0].clear_trace()
+
+    def module_table(self) -> List[str]:
+        return self.targets[0].module_table()
 
     def total_execs(self) -> int:
         return sum(t.total_execs() for t in self.targets)

@@ -56,18 +56,20 @@ def counts_by_slot(counts: jax.Array, seg_id: jax.Array,
     return out.at[:, seg_id].add(c)
 
 
-def expand_to_map(by_slot: jax.Array, u_slots: jax.Array) -> jax.Array:
-    """uint8[B, U] -> uint8[B, MAP_SIZE] dense bitmaps (the parity /
-    state-export shape). u_slots are unique so .set suffices."""
+def expand_to_map(by_slot: jax.Array, u_slots: jax.Array,
+                  map_size: int = MAP_SIZE) -> jax.Array:
+    """uint8[B, U] -> uint8[B, map_size] dense bitmaps (the parity /
+    state-export shape; map_size = 64KB per module). u_slots are
+    unique so .set suffices."""
     b = by_slot.shape[0]
-    out = jnp.zeros((b, MAP_SIZE), jnp.uint8)
+    out = jnp.zeros((b, map_size), jnp.uint8)
     return out.at[:, u_slots].set(by_slot)
 
 
-def _outside_mask(u_slots: jax.Array) -> jax.Array:
-    """uint8[MAP_SIZE]: the constant simplify_trace contribution of
+def _outside_mask(u_slots: jax.Array, map_size: int) -> jax.Array:
+    """uint8[map_size]: the constant simplify_trace contribution of
     slots outside the universe (class 1 everywhere, 0 at u_slots)."""
-    m = jnp.full((MAP_SIZE,), 1, jnp.uint8)
+    m = jnp.full((map_size,), 1, jnp.uint8)
     return m.at[u_slots].set(0)
 
 
@@ -119,7 +121,7 @@ def static_triage(vb: jax.Array, vc: jax.Array, vh: jax.Array,
                 jnp.uint8(0), jax.lax.bitwise_or, dimensions=(0,))
             v = v.at[u_slots].set(v[u_slots] & ~seen)
             if with_outside:
-                v = v & ~_outside_mask(u_slots)
+                v = v & ~_outside_mask(u_slots, v.shape[0])
             return v
         return jax.lax.cond(jnp.any(active), do, lambda v: v, virgin)
 

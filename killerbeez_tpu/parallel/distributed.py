@@ -56,9 +56,11 @@ class ShardedFuzzState(NamedTuple):
     step: jax.Array          # int32 scalar, counts batches done
 
 
-def sharded_state_init(mesh: Mesh) -> ShardedFuzzState:
+def sharded_state_init(mesh: Mesh,
+                       map_size: int = MAP_SIZE) -> ShardedFuzzState:
+    """``map_size`` must match the program's (64KB x n_modules)."""
     spec = NamedSharding(mesh, P("mp"))
-    full = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
+    full = jnp.full((map_size,), 0xFF, dtype=jnp.uint8)
     return ShardedFuzzState(
         virgin_bits=jax.device_put(full, spec),
         virgin_crash=jax.device_put(full, spec),
@@ -99,9 +101,9 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
     """
     n_dp = mesh.shape["dp"]
     n_mp = mesh.shape["mp"]
-    if MAP_SIZE % n_mp:
-        raise ValueError("mp must divide MAP_SIZE")
-    slice_size = MAP_SIZE // n_mp
+    if program.map_size % n_mp:
+        raise ValueError("mp must divide the program's map size")
+    slice_size = program.map_size // n_mp
     instrs = jnp.asarray(program.instrs)
     edge_table = jnp.asarray(program.edge_table)
     u_slots_np, seg_id_np = make_static_maps(program.edge_slot)
@@ -187,6 +189,11 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
 
     @jax.jit
     def step(state: ShardedFuzzState, seed_buf, seed_len, base_it):
+        if state.virgin_bits.shape[-1] != program.map_size:
+            raise ValueError(
+                f"state map is {state.virgin_bits.shape[-1]} bytes but "
+                f"{program.name!r} needs {program.map_size} — pass "
+                f"sharded_state_init(mesh, program.map_size)")
         if seed_buf.shape[-1] > max_len:
             raise ValueError(
                 f"seed buffer ({seed_buf.shape[-1]}) exceeds max_len "

@@ -42,10 +42,16 @@ def greedy_edge_cover(edge_sets: Dict[str, Set[int]]) -> List[str]:
     return chosen
 
 
-def minimize_edge_files(paths: Iterable[str]) -> Tuple[List[str], int]:
+def minimize_edge_files(paths: Iterable[str],
+                        pairs: bool = False) -> Tuple[List[str], int]:
     """Greedy cover over tracer files; returns (kept paths, total
-    distinct edges covered)."""
-    edge_sets = {p: set(read_edge_file(p).keys()) for p in paths}
+    distinct edges covered).  ``pairs=True`` reads the reference's
+    from:to record format (tracer -f pairs) instead of slot:count."""
+    if pairs:
+        from .tracer import read_pair_file
+        edge_sets = {p: read_pair_file(p) for p in paths}
+    else:
+        edge_sets = {p: set(read_edge_file(p).keys()) for p in paths}
     kept = greedy_edge_cover(edge_sets)
     covered = set().union(*(edge_sets[k] for k in kept)) if kept else set()
     return kept, len(covered)
@@ -59,11 +65,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="tracer edge files, one per corpus input")
     p.add_argument("-o", "--output",
                    help="write kept file names here (default stdout)")
+    p.add_argument("-p", "--pairs", action="store_true",
+                   help="edge files are from:to pair records "
+                        "(tracer -f pairs) instead of slot:count")
     p.add_argument("-l", "--logging-options", help="logging JSON options")
     args = p.parse_args(argv)
     try:
         setup_logging(args.logging_options)
-        kept, covered = minimize_edge_files(args.edge_files)
+        kept, covered = minimize_edge_files(args.edge_files, args.pairs)
         text = "".join(f"{k}\n" for k in kept)
         if args.output:
             from ..utils.fileio import write_buffer_to_file

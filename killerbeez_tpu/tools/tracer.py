@@ -57,9 +57,55 @@ def trace_deterministic_edges(driver, instrumentation,
     return counts or {}
 
 
+def trace_deterministic_pairs(driver, instrumentation,
+                              input_bytes: bytes,
+                              num_iterations: int = 5):
+    """Per-module (from, to) records present in every run — the
+    reference tracer's ``instrumentation_edge_t`` intersect
+    (tracer/main.c:239-252).  One target execution per iteration; all
+    modules are harvested from the same run.  Returns
+    {module_name: {(from_id, to_id), ...}}."""
+    modules = instrumentation.get_module_info() or ["target"]
+    per_mod = None
+    for _ in range(num_iterations):
+        driver.test_input(input_bytes)
+        run: Dict[str, set] = {}
+        for module in modules:
+            rec = instrumentation.get_edge_pairs(module)
+            if rec is None:
+                raise ValueError(
+                    f"{instrumentation.name} cannot report (from, to) "
+                    "edge records (needs a static edge universe)")
+            run[module] = {(f, t) for f, t, _ in rec}
+        if per_mod is None:
+            per_mod = run
+        else:
+            per_mod = {m: per_mod[m] & run[m] for m in modules}
+    return per_mod or {}
+
+
 def write_edge_file(path: str, edges: Dict[int, int]) -> None:
     text = "".join(f"{e}:{c}\n" for e, c in sorted(edges.items()))
     write_buffer_to_file(path, text.encode())
+
+
+def write_pair_file(path: str, pairs) -> None:
+    """Reference text edge format: one ``from:to`` line per edge
+    (tracer/main.c:254-270)."""
+    text = "".join(f"{f}:{t}\n" for f, t in sorted(pairs))
+    write_buffer_to_file(path, text.encode())
+
+
+def read_pair_file(path: str):
+    """{(from, to), ...} from a reference-format edge file."""
+    pairs = set()
+    for line in read_file(path).decode().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        f, t = line.split(":")
+        pairs.add((int(f), int(t)))
+    return pairs
 
 
 def read_edge_file(path: str) -> Dict[int, int]:
@@ -88,7 +134,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("-i", "--instrumentation-options",
                    help="instrumentation JSON options (edges forced on)")
     p.add_argument("-o", "--output", required=True,
-                   help="edge file to write (edge:count lines)")
+                   help="edge file to write (edge:count lines; pairs "
+                        "mode appends .<module> with >1 module)")
+    p.add_argument("-f", "--format", choices=("slots", "pairs"),
+                   default="slots",
+                   help='"slots" = slot:count lines; "pairs" = the '
+                        "reference's from:to text records, one file "
+                        "per module (tracer/main.c:254-270)")
     p.add_argument("-l", "--logging-options", help="logging JSON options")
     args = p.parse_args(argv)
     try:
@@ -98,12 +150,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             force_edges_option(args.instrumentation_options))
         driver = driver_factory(args.driver, args.driver_options,
                                 instrumentation, None)
-        edges = trace_deterministic_edges(
-            driver, instrumentation, read_file(args.seed_file),
-            args.iterations)
-        write_edge_file(args.output, edges)
-        INFO_MSG("%d deterministic edges (of %d runs) -> %s",
-                 len(edges), args.iterations, args.output)
+        data = read_file(args.seed_file)
+        if args.format == "pairs":
+            per_mod = trace_deterministic_pairs(
+                driver, instrumentation, data, args.iterations)
+            for module, pairs in per_mod.items():
+                out = args.output if len(per_mod) == 1 else \
+                    f"{args.output}.{module}"
+                write_pair_file(out, pairs)
+                INFO_MSG("%s: %d deterministic edges (of %d runs) -> %s",
+                         module, len(pairs), args.iterations, out)
+        else:
+            edges = trace_deterministic_edges(
+                driver, instrumentation, data, args.iterations)
+            write_edge_file(args.output, edges)
+            INFO_MSG("%d deterministic edges (of %d runs) -> %s",
+                     len(edges), args.iterations, args.output)
         driver.cleanup()
         instrumentation.cleanup()
         return 0

@@ -105,6 +105,7 @@ struct kb_target {
   int deferred = 0;
   long mem_limit_mb = 0;
   int use_shm = 0;
+  std::vector<std::string> extra_env; /* KEY=VALUE set in the child */
 
   /* runtime state */
   int shm_id = -1;
@@ -127,7 +128,10 @@ const char *kb_last_error(void) { return g_err; }
 /* ------------------------------------------------------------------ */
 
 static int setup_shm(kb_target *t) {
-  t->shm_id = shmget(IPC_PRIVATE, KB_MAP_SIZE, IPC_CREAT | IPC_EXCL | 0600);
+  /* KB_SHM_TOTAL = coverage map + per-module name table (the table
+   * stays zero unless the target runs with KB_MODULES=1). */
+  t->shm_id = shmget(IPC_PRIVATE, KB_SHM_TOTAL,
+                     IPC_CREAT | IPC_EXCL | 0600);
   if (t->shm_id < 0) {
     set_err("shmget: %s", strerror(errno));
     return -1;
@@ -171,6 +175,12 @@ kb_target *kb_target_create(const char *const *argv, int use_stdin,
     return nullptr;
   }
   return t;
+}
+
+/* Add a KEY=VALUE pair to the child environment.  Must be called
+ * before kb_target_start/launch (env is applied at spawn). */
+void kb_target_add_env(kb_target *t, const char *kv) {
+  if (t && kv) t->extra_env.emplace_back(kv);
 }
 
 /* Child-side setup common to forkserver and plain spawns.  Never
@@ -217,6 +227,8 @@ static void child_setup(kb_target *t, int ctl_fd, int st_fd) {
     setenv(KB_PERSIST_ENV, buf, 1);
   }
   if (t->deferred) setenv(KB_DEFER_ENV, "1", 1);
+  for (auto &kv : t->extra_env)
+    putenv(const_cast<char *>(kv.c_str())); /* t outlives the execv */
   setenv("LD_BIND_NOW", "1", 0); /* resolve PLT before the fork point */
   /* Sanitizer defaults so crashes surface as signals / magic exit
    * codes (reference sets the same class of defaults). */
@@ -791,6 +803,13 @@ int kb_target_run_debug(kb_target *t, const uint8_t *input, int32_t len,
 /* ------------------------------------------------------------------ */
 
 const uint8_t *kb_target_trace_bits(kb_target *t) { return t->trace_bits; }
+
+/* Per-module name table (written by kb_rt copies under KB_MODULES=1):
+ * KB_N_MODULES fixed-size entries after the map; empty name = free. */
+const char *kb_target_module_table(kb_target *t) {
+  if (!t->trace_bits) return nullptr;
+  return reinterpret_cast<const char *>(t->trace_bits) + KB_MAP_SIZE;
+}
 
 void kb_target_clear_trace(kb_target *t) {
   if (t->trace_bits) memset(t->trace_bits, 0, KB_MAP_SIZE);

@@ -25,6 +25,25 @@
 #define KB_MAP_SIZE_POW2 16
 #define KB_MAP_SIZE      (1 << KB_MAP_SIZE_POW2)
 
+/* Per-module coverage (KB_MODULES=1): the 64KB map is partitioned
+ * into KB_N_MODULES submaps; every kb_rt copy (main executable and
+ * each kb-cc-built shared library carries its own) claims one submap
+ * and logs its edges there — the role of the reference's one-SHM-per-
+ * module design (dynamorio_instrumentation.h:27-41) inside a single
+ * segment.  A name table in the page after the map tells the fuzzer
+ * which submap belongs to which module. */
+#define KB_MODULES_ENV   "KB_MODULES"
+#define KB_MOD_BITS      3
+#define KB_N_MODULES     (1 << KB_MOD_BITS)
+#define KB_MOD_SIZE      (KB_MAP_SIZE >> KB_MOD_BITS)
+#define KB_MODTAB_NAME   64
+#define KB_MODTAB_SIZE   (KB_N_MODULES * KB_MODTAB_NAME)
+#define KB_SHM_TOTAL     (KB_MAP_SIZE + KB_MODTAB_SIZE)
+
+/* Set by the first runtime copy to start the forkserver so copies in
+ * other DSOs (and forked children re-running constructors) skip it. */
+#define KB_CLAIM_ENV     "KB_FORKSRV_CLAIMED"
+
 /* Handshake: the forkserver announces itself with this 4-byte magic on
  * KB_STATUS_FD as soon as it is ready for commands. */
 #define KB_HELLO 0x4b42465aU /* "KBFZ" */

@@ -21,6 +21,7 @@
  */
 #include <signal.h>
 #include <stdint.h>
+#include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/shm.h>
@@ -31,11 +32,24 @@
 #define KB_FORKSERVER_IMPL /* pull in the shared command loop */
 #include "kb_protocol.h"
 
-static unsigned char kb_dummy_map[KB_MAP_SIZE];
+/* Every kb-cc-built object (main executable AND each shared library)
+ * carries its own copy of this runtime.  The coverage internals are
+ * HIDDEN so each copy binds to its own state — with default
+ * visibility the dynamic linker would interpose every DSO's
+ * references onto the executable's copy, collapsing per-module
+ * anchors/partitions and mis-normalizing library ASLR. */
+static unsigned char kb_dummy_map[KB_SHM_TOTAL];
+__attribute__((visibility("hidden")))
 unsigned char *__kb_trace_bits = kb_dummy_map;
 
 static __thread uintptr_t kb_prev_loc;
 static int kb_persist_active = -1; /* -1 = not yet checked */
+
+/* Per-module mode (KB_MODULES=1): this runtime copy's submap.  In the
+ * default mode base stays 0 and the mask covers the whole map, so the
+ * hot hook is branch-free either way. */
+static uintptr_t kb_mod_base = 0;
+static uintptr_t kb_loc_mask = KB_MAP_SIZE - 1;
 
 /* ------------------------------------------------------------------ */
 /* Coverage                                                            */
@@ -58,6 +72,7 @@ static int kb_persist_active = -1; /* -1 = not yet checked */
  * normalization, linux_ipt_instrumentation.c:163-189). */
 static void kb_anchor(void) {}
 
+__attribute__((visibility("hidden")))
 void __sanitizer_cov_trace_pc(void) {
   uintptr_t pc = (uintptr_t)__builtin_return_address(0) -
                  (uintptr_t)&kb_anchor;
@@ -65,9 +80,54 @@ void __sanitizer_cov_trace_pc(void) {
   h ^= h >> 33;
   h *= 0xff51afd7ed558ccdULL;
   h ^= h >> 29;
-  uintptr_t cur = h & (KB_MAP_SIZE - 1);
-  __kb_trace_bits[cur ^ kb_prev_loc]++;
+  uintptr_t cur = h & kb_loc_mask;
+  __kb_trace_bits[kb_mod_base | (cur ^ kb_prev_loc)]++;
   kb_prev_loc = cur >> 1;
+}
+
+/* Basename of the object this runtime copy is linked into, via
+ * /proc/self/maps (no dladdr dependency): find the mapping holding
+ * kb_anchor's address. */
+static void kb_module_name(char *out, size_t n) {
+  uintptr_t addr = (uintptr_t)&kb_anchor;
+  FILE *f = fopen("/proc/self/maps", "r");
+  char line[512];
+  out[0] = 0;
+  while (f && fgets(line, sizeof line, f)) {
+    unsigned long lo, hi;
+    char path[384];
+    path[0] = 0;
+    if (sscanf(line, "%lx-%lx %*s %*s %*s %*s %383s",
+               &lo, &hi, path) >= 2 &&
+        lo <= addr && addr < hi && path[0] == '/') {
+      const char *base = strrchr(path, '/');
+      snprintf(out, n, "%s", base ? base + 1 : path);
+      break;
+    }
+  }
+  if (f) fclose(f);
+  if (!out[0]) snprintf(out, n, "target");
+}
+
+/* Claim (or find) this module's submap in the name table at the end
+ * of the SHM segment.  Constructors run serially under the loader, so
+ * no locking is needed; forked children only read. */
+static void kb_register_module(void) {
+  char name[KB_MODTAB_NAME];
+  kb_module_name(name, sizeof name);
+  char *tab = (char *)__kb_trace_bits + KB_MAP_SIZE;
+  int idx = 0;
+  for (; idx < KB_N_MODULES; idx++) {
+    char *entry = tab + idx * KB_MODTAB_NAME;
+    if (!entry[0]) {
+      snprintf(entry, KB_MODTAB_NAME, "%s", name);
+      break;
+    }
+    if (!strncmp(entry, name, KB_MODTAB_NAME)) break;
+  }
+  if (idx >= KB_N_MODULES) idx = KB_N_MODULES - 1; /* table full: share */
+  kb_mod_base = (uintptr_t)idx * KB_MOD_SIZE;
+  kb_loc_mask = KB_MOD_SIZE - 1;
 }
 
 static void kb_map_shm(void) {
@@ -77,6 +137,7 @@ static void kb_map_shm(void) {
   mapped = 1;
   void *addr = shmat(atoi(id_str), NULL, 0);
   if (addr != (void *)-1) __kb_trace_bits = (unsigned char *)addr;
+  if (getenv(KB_MODULES_ENV)) kb_register_module();
 }
 
 /* ------------------------------------------------------------------ */
@@ -87,13 +148,25 @@ static void kb_child_reset(void) { kb_prev_loc = 0; }
 
 static void kb_forkserver(void) { kb_serve_forkserver(kb_child_reset); }
 
-void __kb_manual_init(void) {
+/* Per-copy init (static: the exported __kb_manual_init would be
+ * interposed to the executable's copy, so library constructors must
+ * call their own). */
+static void kb_init_local(void) {
   static int done;
   if (done) return;
   done = 1;
   kb_map_shm();
-  kb_forkserver();
+  /* Only ONE runtime copy may speak the forkserver protocol: a
+   * kb-cc-built shared library carries its own copy whose constructor
+   * runs before the executable's — the first claims, later copies
+   * just map coverage and register their module. */
+  if (!getenv(KB_CLAIM_ENV)) {
+    setenv(KB_CLAIM_ENV, "1", 1);
+    kb_forkserver();
+  }
 }
+
+void __kb_manual_init(void) { kb_init_local(); }
 
 __attribute__((constructor))
 static void kb_auto_init(void) {
@@ -101,7 +174,7 @@ static void kb_auto_init(void) {
     kb_map_shm(); /* coverage from process start even when deferred */
     return;
   }
-  __kb_manual_init();
+  kb_init_local();
 }
 
 /* ------------------------------------------------------------------ */

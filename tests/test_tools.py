@@ -187,3 +187,46 @@ def test_showmap_differs_between_inputs(corpus_bin, tmp_path, capsys):
                               "arguments": "@@"})]) == 0
         outs.append(capsys.readouterr().out)
     assert outs[0] and outs[1] and outs[0] != outs[1]
+
+
+def test_tracer_pairs_per_module(tmp_path):
+    """Reference-parity edge records: from:to text lines, one file
+    per module (tracer/main.c:254-270)."""
+    from killerbeez_tpu.tools.tracer import read_pair_file
+    seed = str(tmp_path / "seed")
+    with open(seed, "wb") as f:
+        f.write(b"LX")
+    out = str(tmp_path / "edges")
+    assert tracer_main([
+        "file", "jit_harness", "-sf", seed, "-o", out, "-f", "pairs",
+        "-i", json.dumps({"target": "libtest"})]) == 0
+    main_pairs = read_pair_file(out + ".target")
+    lib_pairs = read_pair_file(out + ".libtest1")
+    assert main_pairs and lib_pairs
+    # module files are disjoint record sets over (from, to)
+    assert not (main_pairs & lib_pairs)
+    # non-library input -> empty library module file
+    seed2 = str(tmp_path / "seed2")
+    with open(seed2, "wb") as f:
+        f.write(b"QQ")
+    out2 = str(tmp_path / "e2")
+    assert tracer_main([
+        "file", "jit_harness", "-sf", seed2, "-o", out2, "-f", "pairs",
+        "-i", json.dumps({"target": "libtest"})]) == 0
+    assert read_pair_file(out2 + ".libtest1") == set()
+
+
+def test_minimize_consumes_pair_files(tmp_path):
+    """The minimizer's greedy cover runs over from:to records, the
+    reference's tracer_info data model."""
+    from killerbeez_tpu.tools.minimize import minimize_edge_files
+    from killerbeez_tpu.tools.tracer import write_pair_file
+    a = str(tmp_path / "a.txt")
+    b = str(tmp_path / "b.txt")
+    c = str(tmp_path / "c.txt")
+    write_pair_file(a, {(1, 2), (2, 3), (3, 4)})
+    write_pair_file(b, {(1, 2)})                  # subset: dropped
+    write_pair_file(c, {(9, 9)})
+    kept, covered = minimize_edge_files([a, b, c], pairs=True)
+    assert set(kept) == {a, c}
+    assert covered == 4

@@ -59,7 +59,7 @@ class Driver:
                    type(self)._host_exec_spec is not Driver._host_exec_spec)
         return (self.instrumentation.supports_batch and host_ok
                 and self.mutator is not None
-                and type(self.mutator).mutate_batch is Mutator.mutate_batch)
+                and self.mutator.batch_capable)
 
     def _host_exec_spec(self) -> Dict[str, Any]:
         """How a host backend should execute the target for the

@@ -9,9 +9,13 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+import numpy as np
+
+from .. import FUZZ_NONE
+from ..instrumentation.base import BatchResult
 from ..mutators.base import MUTATE_MULTIPLE_INPUTS
 from ..utils.serialization import decode_mem_array, encode_mem_array
-from .base import Driver
+from .base import BatchOutcome, Driver
 
 
 class PacketDriver(Driver):
@@ -37,7 +41,50 @@ class PacketDriver(Driver):
 
     @property
     def supports_batch(self) -> bool:
-        return False  # live-socket interaction is inherently per-exec
+        # Candidate GENERATION batches on-device (the manager mutator
+        # runs every child's turns in one call); delivery stays
+        # per-exec — live sockets can't be vectorized.
+        return self.mutator is not None and self.mutator.batch_capable
+
+    def test_batch(self, n: int, pad_to: Optional[int] = None
+                   ) -> BatchOutcome:
+        """Batch-mutate ``n`` packet sequences, deliver them one
+        connection at a time, and assemble host-side result arrays
+        (statuses/novelty from the instrumentation after each run).
+        Saved inputs are encoded mem arrays, like the single-exec
+        path's last_input."""
+        if not self.supports_batch:
+            raise RuntimeError(f"{self.name}: batch path unavailable")
+        if hasattr(self.mutator, "mutate_batch_parts"):
+            seqs = self.mutator.mutate_batch_parts(n)
+        else:
+            bufs, lens = self.mutator.mutate_batch(n)
+            seqs = [[bufs[j, :int(lens[j])].tobytes()] for j in range(n)]
+        instr = self.instrumentation
+        total = pad_to if (pad_to is not None and pad_to > n) else n
+        statuses = np.full(total, FUZZ_NONE, dtype=np.int32)
+        new_paths = np.zeros(total, dtype=np.int32)
+        uc = np.zeros(total, dtype=bool)
+        uh = np.zeros(total, dtype=bool)
+        encoded: List[bytes] = []
+        for j, parts in enumerate(seqs):
+            statuses[j] = self._run(parts)
+            new_paths[j] = instr.is_new_path()
+            uc[j] = instr.last_unique_crash()
+            uh[j] = instr.last_unique_hang()
+            encoded.append(encode_mem_array(parts).encode())
+        self.last_input = encoded[-1] if encoded else None
+        max_len = max(8, max(len(e) for e in encoded)) if encoded else 8
+        inputs = np.zeros((total, max_len), dtype=np.uint8)
+        lengths = np.zeros(total, dtype=np.int32)
+        for j, e in enumerate(encoded):
+            inputs[j, :len(e)] = np.frombuffer(e, dtype=np.uint8)
+            lengths[j] = len(e)
+        result = BatchResult(statuses=statuses, new_paths=new_paths,
+                             unique_crashes=uc, unique_hangs=uh,
+                             exit_codes=np.zeros(total, dtype=np.int32))
+        return BatchOutcome(result=result, inputs=inputs,
+                            lengths=lengths)
 
     def _cmd_line(self) -> str:
         return (f'{self.options["path"]} '

@@ -382,10 +382,15 @@ class AflInstrumentation(Instrumentation):
         return ["target"]
 
     def _partition_size(self) -> int:
-        """Module partition width: 8KB submaps under {"modules": 1},
-        else the whole map is the single "target" module."""
+        """Module partition width: 8KB submaps under {"modules": 1}
+        once the target has actually REGISTERED modules — a runtime
+        that ignores KB_MODULES (old kb_rt, qemu) logs across the full
+        map, so the fallback single "target" module must too."""
         from ..native.exec_backend import KB_MOD_SIZE
-        return KB_MOD_SIZE if self.options["modules"] else MAP_SIZE
+        if self.options["modules"] and self._target is not None \
+                and self._target.module_table():
+            return KB_MOD_SIZE
+        return MAP_SIZE
 
     def get_module_edges(self, module: str):
         """get_edges restricted to one module's map partition, with

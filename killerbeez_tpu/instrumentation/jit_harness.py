@@ -42,9 +42,14 @@ from ..ops.coverage import (
 from ..ops.static_triage import (
     counts_by_slot, expand_to_map, make_static_maps, static_triage,
 )
+from ..utils.logging import WARNING_MSG
 from ..utils.serialization import decode_array, encode_array
 from .base import BatchResult, Instrumentation, module_slice_edges
 from .factory import register_instrumentation
+
+# the sequential exact scan is O(B) serial passes; above this lane
+# count the DEFAULT novelty flips to throughput (VERDICT weak #5)
+EXACT_BATCH_GATE = 1024
 
 
 def _triage_exact(vb, vc, vh, cls, simp, statuses):
@@ -105,7 +110,9 @@ class JitHarnessInstrumentation(Instrumentation):
         "target": "built-in KBVM target name (test/hang/libtest/cgc_like)",
         "program_file": "path to a .npz compiled KBVM program",
         "max_steps": "override the program's hang step budget",
-        "novelty": '"exact" (sequential parity, default) or "throughput"',
+        "novelty": '"exact" (sequential parity; the default, but '
+                   'auto-switches to throughput above 1024-lane '
+                   'batches) or "throughput"',
         "edges": "1 = record per-exec edge lists (tracer mode)",
     }
     DEFAULTS = {"novelty": "exact", "edges": 0}
@@ -119,6 +126,15 @@ class JitHarnessInstrumentation(Instrumentation):
         if self.options["novelty"] not in ("exact", "throughput"):
             raise ValueError('novelty must be "exact" or "throughput"')
         self.exact = self.options["novelty"] == "exact"
+        # whether the user ASKED for exact (vs inheriting the default):
+        # the default flips to throughput above EXACT_BATCH_GATE lanes,
+        # an explicit request is honored (with a warning)
+        try:
+            raw_keys = json.loads(options) if options else {}
+        except ValueError:
+            raw_keys = {}
+        self._novelty_explicit = "novelty" in raw_keys
+        self._gate_warned = False
         self._instrs = jnp.asarray(prog.instrs)
         self._edge_table = jnp.asarray(prog.edge_table)
         u_slots, seg_id = make_static_maps(prog.edge_slot)
@@ -139,6 +155,22 @@ class JitHarnessInstrumentation(Instrumentation):
     # -- batched --------------------------------------------------------
 
     def run_batch(self, inputs, lengths) -> BatchResult:
+        b = int(np.asarray(inputs).shape[0])
+        if self.exact and b > EXACT_BATCH_GATE and not self._gate_warned:
+            self._gate_warned = True
+            if self._novelty_explicit:
+                WARNING_MSG(
+                    "jit_harness: exact novelty judges lanes "
+                    "sequentially — batch %d will be slow (parity "
+                    "gates only; use \"novelty\": \"throughput\" for "
+                    "fuzzing)", b)
+            else:
+                WARNING_MSG(
+                    "jit_harness: batch %d > %d — switching default "
+                    "novelty to \"throughput\" (pass {\"novelty\": "
+                    "\"exact\"} to force the sequential parity scan)",
+                    b, EXACT_BATCH_GATE)
+                self.exact = False
         inputs = jnp.asarray(inputs, dtype=jnp.uint8)
         lengths = jnp.asarray(lengths, dtype=jnp.int32)
         (statuses, new_paths, uc, uh, exit_codes, vb, vc, vh,
@@ -247,12 +279,9 @@ class JitHarnessInstrumentation(Instrumentation):
         """get_edges restricted to one module's slot space, with
         module-local slot numbers (the reference's per-module edge
         lists, dynamorio_instrumentation.c:1577-1606)."""
-        edges = self.get_edges()
-        if edges is None:
-            return None
-        m = list(self.program.module_names).index(module)
-        lo, hi = m * MAP_SIZE, (m + 1) * MAP_SIZE
-        return [(s - lo, c) for s, c in edges if lo <= s < hi]
+        return module_slice_edges(self.get_edges(),
+                                  list(self.program.module_names),
+                                  module, MAP_SIZE)
 
     # -- state / merge --------------------------------------------------
 

@@ -146,7 +146,8 @@ class _Handler(BaseHTTPRequestHandler):
         if self.command == "POST":
             b = self._body()
             rid = self.db.add_result(int(job_id), b["result_type"],
-                                     b["repro_file"])
+                                     b["repro_file"],
+                                     b.get("crash_info"))
             self._json(201, {"id": rid})
         else:
             self._json(200, self.db.get_results(int(job_id)))

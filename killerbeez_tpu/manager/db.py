@@ -51,6 +51,7 @@ CREATE TABLE IF NOT EXISTS results (
     job_id INTEGER NOT NULL,
     result_type TEXT NOT NULL,    -- crash | hang | new_path
     repro_file TEXT NOT NULL,
+    crash_info TEXT,              -- worker verification JSON (crashes)
     created REAL NOT NULL
 );
 CREATE TABLE IF NOT EXISTS job_inputs (
@@ -94,9 +95,21 @@ class ManagerDB:
                                            check_same_thread=False)
             self._shared.row_factory = sqlite3.Row
             self._shared.executescript(_SCHEMA)
+            self._migrate(self._shared)
         else:
             with self._conn() as c:
                 c.executescript(_SCHEMA)
+                self._migrate(c)
+
+    @staticmethod
+    def _migrate(conn: sqlite3.Connection) -> None:
+        """Columns added after a release: CREATE TABLE IF NOT EXISTS
+        skips existing tables, so upgrades need explicit ALTERs."""
+        cols = {r[1] for r in conn.execute(
+            "PRAGMA table_info(results)")}
+        if "crash_info" not in cols:
+            conn.execute(
+                "ALTER TABLE results ADD COLUMN crash_info TEXT")
 
     def _conn(self) -> sqlite3.Connection:
         if self._shared is not None:
@@ -236,13 +249,14 @@ class ManagerDB:
     # -- results --------------------------------------------------------
 
     def add_result(self, job_id: int, result_type: str,
-                   repro_file: str) -> int:
+                   repro_file: str,
+                   crash_info: Optional[str] = None) -> int:
         if result_type not in ("crash", "hang", "new_path"):
             raise ValueError(f"bad result_type {result_type!r}")
         cur = self._exec(
             "INSERT INTO results (job_id, result_type, repro_file, "
-            "created) VALUES (?,?,?,?)",
-            (job_id, result_type, repro_file, time.time()))
+            "crash_info, created) VALUES (?,?,?,?,?)",
+            (job_id, result_type, repro_file, crash_info, time.time()))
         return cur.lastrowid
 
     def get_results(self, job_id: Optional[int] = None

@@ -24,6 +24,7 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional
 
+from .. import FUZZ_CRASH
 from ..utils.logging import INFO_MSG, WARNING_MSG, setup_logging
 
 RESULT_DIRS = {"crashes": "crash", "hangs": "hang",
@@ -43,9 +44,87 @@ def _request(url: str, payload: Optional[Dict[str, Any]] = None,
         return json.loads(body) if body else None
 
 
-def assimilate(manager_url: str, job_id: int, output_dir: str) -> int:
-    """Upload findings and create result rows; returns count."""
+def verify_repro(job: Dict[str, Any], content: bytes,
+                 cache: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+    """Re-run a crash repro ONCE before posting the result — the
+    reference's server flow traces results back through verification
+    (docs/Server.md:215-258); the repo's analogue re-executes the
+    repro under the richest available tier and attaches what it saw:
+
+      * device targets (jit_harness / ipt): re-execute on the KBVM,
+        report the verdict + exit code;
+      * host targets (afl / return_code, file or stdin driver): re-run
+        under the ptrace debug instrumentation, harvesting
+        signal / fault address / module-relative PC;
+      * network deliveries can't be replayed without the live session
+        and are marked unverifiable.
+    """
+    instr_name = job.get("instrumentation", "")
+    driver = job.get("driver", "")
+    try:
+        dopts = json.loads(job["driver_opts"]) \
+            if job.get("driver_opts") else {}
+    except (ValueError, TypeError):
+        dopts = {}
+    try:
+        if instr_name in ("jit_harness", "ipt"):
+            from ..instrumentation.factory import instrumentation_factory
+            # one device instrumentation per job (the cache) — a fresh
+            # instance per crash file would re-trace/compile the XLA
+            # step for every finding
+            instr = (cache or {}).get("device_instr")
+            if instr is None:
+                instr = instrumentation_factory(
+                    instr_name, job.get("instrumentation_opts"))
+                if cache is not None:
+                    cache["device_instr"] = instr
+            instr.enable(content)
+            st = instr.get_fuzz_result()
+            if cache is None:
+                instr.cleanup()
+            return {"verified": st == FUZZ_CRASH, "tier": "device",
+                    "status": int(st)}
+        if driver not in ("file", "stdin"):
+            return {"verified": None,
+                    "reason": f"{driver} delivery is not replayable"}
+        path = dopts.get("path")
+        if not path or not os.path.exists(path):
+            return {"verified": False,
+                    "error": "target binary unavailable on this worker"}
+        from ..instrumentation.debug import DebugInstrumentation
+        dbg = DebugInstrumentation(None)
+        args = (dopts.get("arguments") or "").strip()
+        if driver == "stdin":
+            dbg.enable(content,
+                       cmd_line=f"{path} {args}".strip())
+        else:
+            fd, tmp = tempfile.mkstemp(prefix="kb_repro_")
+            try:
+                os.write(fd, content)
+                os.close(fd)
+                args = (dopts.get("arguments") or "@@").replace("@@", tmp)
+                dbg.enable(None, cmd_line=f"{path} {args}")
+            finally:
+                os.unlink(tmp)
+        verified = dbg.get_fuzz_result() == FUZZ_CRASH
+        out: Dict[str, Any] = {"verified": verified, "tier": "debug"}
+        if verified:
+            out.update(dbg.last_crash_info)
+            out["description"] = dbg.crash_description()
+        dbg.cleanup()
+        return out
+    except Exception as e:   # verification must never block reporting
+        return {"verified": False, "error": str(e)[:200]}
+
+
+def assimilate(manager_url: str, job: Dict[str, Any],
+               output_dir: str) -> int:
+    """Upload findings and create result rows (crashes re-verified
+    first, details attached to the row); returns count."""
     n = 0
+    job_id = job["id"]
+    verify_cache: Dict[str, Any] = {}
     for sub, result_type in RESULT_DIRS.items():
         d = os.path.join(output_dir, sub)
         if not os.path.isdir(d):
@@ -56,10 +135,17 @@ def assimilate(manager_url: str, job_id: int, output_dir: str) -> int:
             up = _request(f"{manager_url}/api/file", {
                 "name": f"job{job_id}_{sub}_{name}",
                 "content_b64": base64.b64encode(content).decode()})
-            _request(f"{manager_url}/api/job/{job_id}/results", {
+            payload = {
                 "result_type": result_type,
-                "repro_file": f"/api/file/{up['id']}"})
+                "repro_file": f"/api/file/{up['id']}",
+            }
+            if result_type == "crash":
+                payload["crash_info"] = json.dumps(
+                    verify_repro(job, content, verify_cache))
+            _request(f"{manager_url}/api/job/{job_id}/results", payload)
             n += 1
+    if "device_instr" in verify_cache:
+        verify_cache["device_instr"].cleanup()
     return n
 
 
@@ -78,7 +164,7 @@ def run_job(manager_url: str, job: Dict[str, Any],
         else:
             rc = subprocess.run(argv).returncode
         status = "done" if rc == 0 else "failed"
-        found = assimilate(manager_url, job["id"], out_dir)
+        found = assimilate(manager_url, job, out_dir)
         INFO_MSG("job %d %s: %d findings", job["id"], status, found)
         return status
 

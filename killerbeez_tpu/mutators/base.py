@@ -101,6 +101,10 @@ class Mutator:
         Returns (uint8[n, L], int32[n])."""
         raise NotImplementedError
 
+    # whether mutate_batch is a real batched path (subclasses that
+    # can't batch set this False; drivers consult it)
+    batch_capable = True
+
     def mutate_batch(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
         """Generate the next ``n`` candidates and advance the walk.
         Raises if a finite walk has fewer than ``n`` left — callers

@@ -111,10 +111,61 @@ class ManagerMutator(Mutator):
             return out[:max_size] if max_size else out
         return self.mutate(max_size)
 
-    def mutate_batch(self, n: int):
-        raise NotImplementedError(
-            "manager mutator is host-composed; use per-part batching via "
-            "children instead")
+    def mutate_batch_parts(self, n: int) -> List[List[bytes]]:
+        """``n`` composite candidates as per-part byte lists, advancing
+        children round-robin exactly like ``n`` sequential mutate()
+        calls — but each child generates ALL its turns in one batched
+        call (its device path), recomposed on host.  Packet drivers
+        consume this form directly (one list = one packet sequence)."""
+        if n <= 0:
+            raise ValueError("batch size must be positive")
+        if self.remaining() < n:
+            raise ValueError(
+                f"{self.name}: only {self.remaining()} iterations "
+                f"left, requested {n}")
+        nc = len(self.children)
+        rem = [c.remaining() for c in self.children]
+        nxt = self._next_child
+        turns: List[int] = []
+        for _ in range(n):
+            for probe in range(nc):
+                i = (nxt + probe) % nc
+                if rem[i] > 0:
+                    turns.append(i)
+                    rem[i] -= 1
+                    nxt = (i + 1) % nc
+                    break
+        counts = [turns.count(i) for i in range(nc)]
+        child_out: Dict[int, List[bytes]] = {}
+        for i, child in enumerate(self.children):
+            if counts[i]:
+                bufs, lens = child.mutate_batch(counts[i])
+                child_out[i] = [bufs[j, :int(lens[j])].tobytes()
+                                for j in range(counts[i])]
+        used = [0] * nc
+        cur = list(self.current)
+        out: List[List[bytes]] = []
+        for i in turns:
+            cur[i] = child_out[i][used[i]]
+            used[i] += 1
+            out.append(list(cur))
+        self.current = cur
+        self._next_child = nxt
+        self.iteration += n
+        return out
+
+    def mutate_batch(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Concatenated-composite form of mutate_batch_parts (matches
+        ``mutate``'s return shape for single-buffer consumers)."""
+        parts = self.mutate_batch_parts(n)
+        comps = [b"".join(p) for p in parts]
+        L = max(8, ((max(len(c) for c in comps) + 7) // 8) * 8)
+        bufs = np.zeros((n, L), dtype=np.uint8)
+        lens = np.zeros((n,), dtype=np.int32)
+        for j, c in enumerate(comps):
+            bufs[j, :len(c)] = np.frombuffer(c, dtype=np.uint8)
+            lens[j] = len(c)
+        return bufs, lens
 
     def get_input_info(self) -> Tuple[int, List[int]]:
         return len(self.children), [len(p) for p in self.current]

@@ -263,3 +263,32 @@ def test_return_code_missing_binary(tmp_path):
                          mutator_factory("nop", None, SEED))
     from killerbeez_tpu import FUZZ_ERROR
     assert drv.test_input(b"x") == FUZZ_ERROR
+
+
+def test_exact_gate_switches_default_at_large_batch():
+    """The exact (sequential) scan is for parity gates; a large batch
+    under the DEFAULT novelty must warn and switch to throughput,
+    while an EXPLICIT exact request is honored (with a warning)."""
+    import io
+    from killerbeez_tpu.utils import logging as kblog
+    big = np.zeros((2048, 8), dtype=np.uint8)
+    lens = np.full(2048, 4, dtype=np.int32)
+    buf = io.StringIO()
+    old_stream = kblog._state.stream
+    kblog._state.stream = buf
+    try:
+        instr = instrumentation_factory("jit_harness",
+                                        '{"target": "test"}')
+        assert instr.exact
+        instr.run_batch(big, lens)
+        assert not instr.exact                  # default switched
+        assert "throughput" in buf.getvalue()
+
+        buf.truncate(0)
+        forced = instrumentation_factory(
+            "jit_harness", '{"target": "test", "novelty": "exact"}')
+        forced.run_batch(big, lens)
+        assert forced.exact                     # explicit wins
+        assert "slow" in buf.getvalue()
+    finally:
+        kblog._state.stream = old_stream

@@ -145,5 +145,12 @@ def test_end_to_end_job_lifecycle(server, corpus_bin, tmp_path):
     url = f"http://127.0.0.1:{server.port}{crash['repro_file']}"
     with urllib.request.urlopen(url, timeout=10) as resp:
         assert resp.read() == b"ABCD"
+    # the worker re-verified the crash under the debug tier before
+    # posting: the result row carries signal-level crash details
+    info = json.loads(crash["crash_info"])
+    assert info["verified"] is True
+    assert info["tier"] == "debug"
+    assert info["signal"] == 11          # SIGSEGV (NULL write)
+    assert "description" in info
     _, full = req(server, f"/api/job/{job['id']}")
     assert full["status"] == "done"

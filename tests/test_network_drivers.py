@@ -147,3 +147,40 @@ def test_single_input_mutator_on_network_driver(corpus_bin):
     assert r in (FUZZ_NONE, FUZZ_CRASH)
     drv.cleanup()
     instr.cleanup()
+
+
+def test_server_multipart_batched(corpus_bin, tmp_path):
+    """VERDICT 'Batched multipart': the manager mutator's batched path
+    drives the network driver through the full Fuzzer loop — batch
+    generation on-device, per-connection delivery — and still finds
+    the multi-packet crash."""
+    from killerbeez_tpu.fuzzer.loop import Fuzzer
+    mut = mutator_factory(
+        "manager",
+        json.dumps({"mutators": ["nop", "bit_flip"]}),
+        seq(b"HELO", b"BOOL"))
+    drv, instr = make_server(corpus_bin, PORT + 7, mutator=mut)
+    assert drv.supports_batch
+    fz = Fuzzer(drv, output_dir=str(tmp_path / "o"),
+                batch_size=16, write_findings=False)
+    stats = fz.run(96)
+    assert stats.crashes >= 1
+    assert stats.new_paths > 0
+    drv.cleanup()
+    instr.cleanup()
+    mut.cleanup()
+
+
+def test_manager_mutate_batch_matches_sequential(corpus_bin):
+    """mutate_batch_parts must replay exactly the sequential mutate()
+    round-robin (candidate-for-candidate)."""
+    opts = json.dumps({"mutators": ["bit_flip", "bit_flip"]})
+    seed = seq(b"AB", b"CD")
+    seq_mut = mutator_factory("manager", opts, seed)
+    bat_mut = mutator_factory("manager", opts, seed)
+    sequential = []
+    for _ in range(12):
+        whole = seq_mut.mutate()
+        sequential.append(whole)
+    batched = [b"".join(p) for p in bat_mut.mutate_batch_parts(12)]
+    assert sequential == batched

@@ -102,7 +102,8 @@ def bench_host_configs():
          crashes=stats.crashes)
 
 
-def bench_device(target, batch, steps, seed, stack_pow2=4):
+def bench_device(target, batch, steps, seed, stack_pow2=4,
+                 engine="xla"):
     """Fused on-device fuzz loop: havoc -> KBVM -> static-edge triage."""
     import jax
     import jax.numpy as jnp
@@ -134,7 +135,7 @@ def bench_device(target, batch, steps, seed, stack_pow2=4):
                                stack_pow2=stack_pow2))(keys)
         statuses, new_paths, uc, uh, ec, vb2, vc2, vh2, _ = _fused_step(
             instrs, edge_table, u_slots, seg_id, bufs, lens, vb, vc, vh,
-            prog.mem_size, prog.max_steps, prog.n_edges, False)
+            prog.mem_size, prog.max_steps, prog.n_edges, False, engine)
         return (vb2, vc2, vh2, jnp.sum(statuses == 2),
                 jnp.sum(new_paths > 0))
 
@@ -223,12 +224,27 @@ def main():
     except Exception as e:
         emit(5, "multichip smoke", 0.0, ok=False, error=str(e)[:200])
 
-    # headline LAST: the CGC-grade flagship
-    vH, crashes = bench_device("tlvstack_vm", 16384, 20,
-                               targets_cgc.tlvstack_vm_seed())
+    vx, _ = bench_device("tlvstack_vm", 16384, 20,
+                         targets_cgc.tlvstack_vm_seed())
+    emit("4b", "flagship tlvstack_vm, xla engine", vx,
+         baseline=FORKSERVER_BASELINE)
+
+    # headline LAST: the CGC-grade flagship on the Pallas VM kernel
+    # (falls back to the XLA engine number if the kernel won't compile
+    # in this environment)
+    try:
+        vH, _ = bench_device("tlvstack_vm", 16384, 20,
+                             targets_cgc.tlvstack_vm_seed(),
+                             engine="pallas")
+        engine_used = "pallas"
+    except Exception as e:
+        emit("4p", "pallas engine unavailable", 0.0, ok=False,
+             error=str(e)[:200])
+        vH, engine_used = vx, "xla"
     print(json.dumps({
         "metric": "execs/sec/chip on tlvstack_vm (110-block CGC-grade "
-                  "target; fused havoc+KBVM+static-edge triage)",
+                  f"target; fused havoc+KBVM({engine_used})+static-edge "
+                  "triage)",
         "value": round(vH, 1),
         "unit": "execs/sec",
         "vs_baseline": round(vH / FORKSERVER_BASELINE, 2),

@@ -74,12 +74,14 @@ class PacketDriver(Driver):
             uh[j] = instr.last_unique_hang()
             encoded.append(encode_mem_array(parts).encode())
         self.last_input = encoded[-1] if encoded else None
-        max_len = max(8, max(len(e) for e in encoded)) if encoded else 8
-        inputs = np.zeros((total, max_len), dtype=np.uint8)
-        lengths = np.zeros(total, dtype=np.int32)
-        for j, e in enumerate(encoded):
-            inputs[j, :len(e)] = np.frombuffer(e, dtype=np.uint8)
-            lengths[j] = len(e)
+        from ..mutators.base import pack_byte_rows
+        inputs, lengths = pack_byte_rows(encoded or [b""])
+        if total > inputs.shape[0]:
+            inputs = np.concatenate(
+                [inputs, np.zeros((total - inputs.shape[0],
+                                   inputs.shape[1]), np.uint8)])
+            lengths = np.concatenate(
+                [lengths, np.zeros(total - lengths.shape[0], np.int32)])
         result = BatchResult(statuses=statuses, new_paths=new_paths,
                              unique_crashes=uc, unique_hangs=uh,
                              exit_codes=np.zeros(total, dtype=np.int32))

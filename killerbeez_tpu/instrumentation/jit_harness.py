@@ -73,13 +73,36 @@ def _triage_exact(vb, vc, vh, cls, simp, statuses):
 
 
 @partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
-                                   "exact"))
+                                   "exact", "engine"))
 def _fused_step(instrs, edge_table, u_slots, seg_id, inputs, lengths,
-                vb, vc, vh, mem_size, max_steps, n_edges, exact):
-    """mutated batch -> VM exec -> static-edge triage, one XLA program."""
+                vb, vc, vh, mem_size, max_steps, n_edges, exact,
+                engine="xla"):
+    """mutated batch -> VM exec -> static-edge triage, one XLA program.
+
+    ``engine="pallas"`` runs the VM loop in the Pallas VMEM-resident
+    kernel (ops/vm_kernel.py, ~4x the XLA engine on chip); the batch
+    is padded to the kernel's lane tile with copies of lane 0
+    (coverage no-ops) and results sliced back."""
     from ..models.vm import _run_batch_impl  # batched one-hot engine
-    res = _run_batch_impl(instrs, edge_table, inputs, lengths, mem_size,
-                          max_steps, n_edges, False)
+    if engine == "pallas":
+        from ..ops.vm_kernel import LANE_TILE, run_batch_pallas
+        b = inputs.shape[0]
+        pad = (-b) % LANE_TILE
+        if pad:
+            inputs = jnp.concatenate(
+                [inputs, jnp.repeat(inputs[:1], pad, axis=0)], axis=0)
+            lengths = jnp.concatenate(
+                [lengths, jnp.repeat(lengths[:1], pad)])
+        res = run_batch_pallas(instrs, edge_table, inputs, lengths,
+                               mem_size, max_steps, n_edges)
+        if pad:
+            res = res._replace(
+                status=res.status[:b], exit_code=res.exit_code[:b],
+                counts=res.counts[:b], steps=res.steps[:b],
+                path_hash=res.path_hash[:b])
+    else:
+        res = _run_batch_impl(instrs, edge_table, inputs, lengths,
+                              mem_size, max_steps, n_edges, False)
     statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG, res.status)
     if exact:
         # dense parity path: expand the static universe back to the
@@ -105,7 +128,7 @@ class JitHarnessInstrumentation(Instrumentation):
     supports_batch = True
     device_backed = True
     OPTION_SCHEMA = {"target": str, "program_file": str, "max_steps": int,
-                     "novelty": str, "edges": int}
+                     "novelty": str, "edges": int, "engine": str}
     OPTION_DESCS = {
         "target": "built-in KBVM target name (test/hang/libtest/cgc_like)",
         "program_file": "path to a .npz compiled KBVM program",
@@ -114,8 +137,10 @@ class JitHarnessInstrumentation(Instrumentation):
                    'auto-switches to throughput above 1024-lane '
                    'batches) or "throughput"',
         "edges": "1 = record per-exec edge lists (tracer mode)",
+        "engine": '"xla" (default) or "pallas" (VMEM-resident VM '
+                  "kernel, ~4x on chip)",
     }
-    DEFAULTS = {"novelty": "exact", "edges": 0}
+    DEFAULTS = {"novelty": "exact", "edges": 0, "engine": "xla"}
 
     def __init__(self, options: Optional[str] = None):
         super().__init__(options)
@@ -125,6 +150,9 @@ class JitHarnessInstrumentation(Instrumentation):
             '{"program_file": path}')
         if self.options["novelty"] not in ("exact", "throughput"):
             raise ValueError('novelty must be "exact" or "throughput"')
+        if self.options["engine"] not in ("xla", "pallas"):
+            raise ValueError('engine must be "xla" or "pallas"')
+        self.engine = self.options["engine"]
         self.exact = self.options["novelty"] == "exact"
         # whether the user ASKED for exact (vs inheriting the default):
         # the default flips to throughput above EXACT_BATCH_GATE lanes,
@@ -178,7 +206,8 @@ class JitHarnessInstrumentation(Instrumentation):
             self._instrs, self._edge_table, self._u_slots, self._seg_id,
             inputs, lengths, self.virgin_bits,
             self.virgin_crash, self.virgin_tmout, self.program.mem_size,
-            self.program.max_steps, self.program.n_edges, self.exact)
+            self.program.max_steps, self.program.n_edges, self.exact,
+            self.engine)
         self.virgin_bits, self.virgin_crash, self.virgin_tmout = vb, vc, vh
         self.total_execs += int(inputs.shape[0])
         if self.options.get("edges"):

@@ -125,27 +125,30 @@ def assimilate(manager_url: str, job: Dict[str, Any],
     n = 0
     job_id = job["id"]
     verify_cache: Dict[str, Any] = {}
-    for sub, result_type in RESULT_DIRS.items():
-        d = os.path.join(output_dir, sub)
-        if not os.path.isdir(d):
-            continue
-        for name in sorted(os.listdir(d)):
-            with open(os.path.join(d, name), "rb") as f:
-                content = f.read()
-            up = _request(f"{manager_url}/api/file", {
-                "name": f"job{job_id}_{sub}_{name}",
-                "content_b64": base64.b64encode(content).decode()})
-            payload = {
-                "result_type": result_type,
-                "repro_file": f"/api/file/{up['id']}",
-            }
-            if result_type == "crash":
-                payload["crash_info"] = json.dumps(
-                    verify_repro(job, content, verify_cache))
-            _request(f"{manager_url}/api/job/{job_id}/results", payload)
-            n += 1
-    if "device_instr" in verify_cache:
-        verify_cache["device_instr"].cleanup()
+    try:
+        for sub, result_type in RESULT_DIRS.items():
+            d = os.path.join(output_dir, sub)
+            if not os.path.isdir(d):
+                continue
+            for name in sorted(os.listdir(d)):
+                with open(os.path.join(d, name), "rb") as f:
+                    content = f.read()
+                up = _request(f"{manager_url}/api/file", {
+                    "name": f"job{job_id}_{sub}_{name}",
+                    "content_b64": base64.b64encode(content).decode()})
+                payload = {
+                    "result_type": result_type,
+                    "repro_file": f"/api/file/{up['id']}",
+                }
+                if result_type == "crash":
+                    payload["crash_info"] = json.dumps(
+                        verify_repro(job, content, verify_cache))
+                _request(f"{manager_url}/api/job/{job_id}/results",
+                         payload)
+                n += 1
+    finally:
+        if "device_instr" in verify_cache:
+            verify_cache["device_instr"].cleanup()
     return n
 
 

@@ -33,6 +33,20 @@ def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
+def pack_byte_rows(rows: Sequence[bytes]) -> Tuple[np.ndarray, np.ndarray]:
+    """Pack variable-length byte strings into the framework's batch
+    shape: (uint8[n, L] zero-padded, int32[n] lengths), L rounded up
+    to 8 with a floor of 8 (the shared candidate-tensor convention)."""
+    max_len = max((len(r) for r in rows), default=1)
+    L = max(8, _round_up(max_len, 8))
+    bufs = np.zeros((len(rows), L), dtype=np.uint8)
+    lens = np.zeros((len(rows),), dtype=np.int32)
+    for i, r in enumerate(rows):
+        bufs[i, :len(r)] = np.frombuffer(r, dtype=np.uint8)
+        lens[i] = len(r)
+    return bufs, lens
+
+
 class Mutator:
     """Base mutator. Subclasses set ``name``, ``OPTION_SCHEMA``,
     ``OPTION_DESCS`` and implement ``_generate(its) -> (bufs, lens)``

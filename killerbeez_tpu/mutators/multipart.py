@@ -157,15 +157,9 @@ class ManagerMutator(Mutator):
     def mutate_batch(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
         """Concatenated-composite form of mutate_batch_parts (matches
         ``mutate``'s return shape for single-buffer consumers)."""
+        from .base import pack_byte_rows
         parts = self.mutate_batch_parts(n)
-        comps = [b"".join(p) for p in parts]
-        L = max(8, ((max(len(c) for c in comps) + 7) // 8) * 8)
-        bufs = np.zeros((n, L), dtype=np.uint8)
-        lens = np.zeros((n,), dtype=np.int32)
-        for j, c in enumerate(comps):
-            bufs[j, :len(c)] = np.frombuffer(c, dtype=np.uint8)
-            lens[j] = len(c)
-        return bufs, lens
+        return pack_byte_rows([b"".join(p) for p in parts])
 
     def get_input_info(self) -> Tuple[int, List[int]]:
         return len(self.children), [len(p) for p in self.current]

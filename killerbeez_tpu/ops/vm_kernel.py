@@ -1,0 +1,275 @@
+"""Pallas TPU kernel for the KBVM step machine.
+
+The XLA while_loop engine (models/vm._run_batch_impl) round-trips the
+full interpreter state (registers, scratch memory, edge counts —
+~25MB at B=16k) through HBM on every VM step; at ~400 steps per batch
+that traffic, not compute, bounds throughput.  This kernel runs the
+ENTIRE step loop inside one pallas_call: each grid instance owns a
+TILE-lane slice whose state lives in VMEM for the whole execution,
+and only the final verdicts/counts are written back.
+
+Mosaic constraints shape the code:
+  * lane-LAST layout everywhere — per-lane scalars are [1, T] rows
+    and tables are [X, T], so every broadcast is a sublane
+    replication (a [T, 1] column would need lane replication, which
+    Mosaic's relayout rejects);
+  * no 1D arrays (1D boolean vectors fail to lower) and no
+    `jnp.select` (it lowers through an f32-only argmax);
+  * selects operate on i32 0/1, never on bool VALUES (Mosaic widens
+    selected bools to i8 and cannot truncate back to a mask).
+
+The two per-lane "gathers" (instruction fetch, edge-table lookup) are
+transposed one-hot MXU matmuls — the TPU has no per-lane gather in
+either programming model.
+
+Semantics are bit-identical to models/vm._step_batched (parity-tested
+against it); stream recording is not supported here — tracer/ipt runs
+stay on the XLA engine.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .. import FUZZ_CRASH, FUZZ_NONE, FUZZ_RUNNING, MAP_SIZE
+from ..models.vm import (
+    ALU_ADD, ALU_AND, ALU_MUL, ALU_OR, ALU_SHL, ALU_SHR, ALU_SUB, ALU_XOR,
+    CMP_EQ, CMP_GE, CMP_LT, CMP_NE, N_REGS,
+    OP_ADDI, OP_ALU, OP_BLOCK, OP_BR, OP_CRASH, OP_HALT, OP_JMP, OP_LDB,
+    OP_LDI, OP_LDM, OP_LEN, OP_STM, VMResult, _mix32,
+)
+
+LANE_TILE = 512  # lanes per grid instance (multiple of 128)
+
+
+def _pick_rows(table, idx):
+    """out[0, t] = table[idx[0, t], t] for table [R, T], idx [1, T]:
+    one-hot over the (small, static) row axis."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, table.shape, 0)
+    return jnp.sum(jnp.where(rows == idx, table, 0), axis=0,
+                   keepdims=True).astype(table.dtype)
+
+
+def _chain(pairs, default):
+    """Branch-free first-match select."""
+    out = default
+    for cond, val in reversed(pairs):
+        out = jnp.where(cond, val, out)
+    return out
+
+
+def _vm_kernel(instrs_t_ref, table_t_ref, bufs_ref, lens_ref, zero_ref,
+               status_ref, exit_ref, counts_ref, steps_ref, hash_ref,
+               *, mem_size, max_steps, n_edges):
+    t = bufs_ref.shape[1]                       # TILE lanes
+    instrs_t = instrs_t_ref[...].astype(jnp.float32)     # [4, NI]
+    table_t = table_t_ref[...].astype(jnp.float32)       # [nb, nb+1]
+    ni = instrs_t.shape[1]
+    nb = table_t.shape[0]
+    bufs = bufs_ref[...]                                 # [L, T] i32
+    lengths = lens_ref[...]                              # [1, T]
+    L = bufs.shape[0]
+
+    def step(state):
+        (pc, regs, mem, prev_loc, status, exit_code, prev_idx,
+         counts, path_hash, i, lane_steps) = state
+        running = status == FUZZ_RUNNING                 # [1, T] bool
+
+        # ---- instruction fetch: transposed one-hot MXU matmul ----
+        pcc = jnp.clip(pc, 0, ni - 1)
+        onehot_pc = (jax.lax.broadcasted_iota(jnp.int32, (ni, t), 0)
+                     == pcc).astype(jnp.float32)         # [NI, T]
+        row = jax.lax.dot(instrs_t, onehot_pc,
+                          precision=jax.lax.Precision.HIGHEST)
+        row = row.astype(jnp.int32)                      # [4, T]
+        op = row[0:1, :]
+        a = row[1:2, :]
+        b = row[2:3, :]
+        c = row[3:4, :]
+
+        rb_idx = (c >> 3) & (N_REGS - 1)
+        alu_sel = c & 7
+        cmp_sel = b & 3
+        cmp_rb = (b >> 2) & (N_REGS - 1)
+
+        ra = _pick_rows(regs, jnp.clip(a, 0, N_REGS - 1))
+        rb = _pick_rows(regs, jnp.clip(b, 0, N_REGS - 1))
+        ry = _pick_rows(regs, rb_idx)
+        cmp_y = _pick_rows(regs, cmp_rb)
+
+        # LDB
+        ldb_ok = (rb >= 0) & (rb < lengths)
+        ldb_val = _pick_rows(bufs, jnp.clip(rb, 0, L - 1))
+        ldb_val = jnp.where(ldb_ok, ldb_val, 0)
+
+        x, y = rb, ry
+        shift = jnp.clip(y, 0, 31)
+        alu_val = _chain(
+            [(alu_sel == ALU_ADD, x + y), (alu_sel == ALU_SUB, x - y),
+             (alu_sel == ALU_AND, x & y), (alu_sel == ALU_OR, x | y),
+             (alu_sel == ALU_XOR, x ^ y), (alu_sel == ALU_SHL, x << shift),
+             (alu_sel == ALU_SHR, jax.lax.shift_right_logical(x, shift)),
+             (alu_sel == ALU_MUL, x * y)], jnp.zeros_like(x))
+        taken = _chain(
+            [(cmp_sel == CMP_EQ, (ra == cmp_y).astype(jnp.int32)),
+             (cmp_sel == CMP_NE, (ra != cmp_y).astype(jnp.int32)),
+             (cmp_sel == CMP_LT, (ra < cmp_y).astype(jnp.int32)),
+             (cmp_sel == CMP_GE, (ra >= cmp_y).astype(jnp.int32))],
+            jnp.zeros_like(ra)) != 0
+
+        mem_ok_ld = (rb >= 0) & (rb < mem_size)
+        ldm_val = _pick_rows(mem, jnp.clip(rb, 0, mem_size - 1))
+        ldm_val = jnp.where(mem_ok_ld, ldm_val, 0)
+        mem_ok_st = (ra >= 0) & (ra < mem_size)
+
+        nxt = pc + 1
+        new_pc = _chain([(op == OP_JMP, a),
+                         (op == OP_BR, jnp.where(taken, c, nxt))], nxt)
+        wr_val = _chain(
+            [(op == OP_LDB, ldb_val), (op == OP_LDI, b),
+             (op == OP_ALU, alu_val), (op == OP_ADDI, rb + c),
+             (op == OP_LEN, lengths), (op == OP_LDM, ldm_val)],
+            jnp.zeros_like(pc))
+        writes_reg = ((op == OP_LDB) | (op == OP_LDI) | (op == OP_ALU) |
+                      (op == OP_ADDI) | (op == OP_LEN) | (op == OP_LDM))
+        ridx = jax.lax.broadcasted_iota(jnp.int32, (N_REGS, t), 0)
+        wmask = (writes_reg & running) & \
+            (ridx == jnp.clip(a, 0, N_REGS - 1))
+        new_regs = jnp.where(wmask, wr_val, regs)
+
+        do_store = (op == OP_STM) & mem_ok_st & running
+        midx = jax.lax.broadcasted_iota(jnp.int32, (mem_size, t), 0)
+        smask = do_store & (midx == jnp.clip(ra, 0, mem_size - 1))
+        new_mem = jnp.where(smask, rb, mem)
+
+        crashes = (op == OP_CRASH) | \
+                  ((op == OP_LDM) & ~mem_ok_ld) | \
+                  ((op == OP_STM) & ~mem_ok_st) | \
+                  (pc < 0) | (pc >= ni)
+        halts = op == OP_HALT
+        new_status = jnp.where(crashes, FUZZ_CRASH,
+                               jnp.where(halts, FUZZ_NONE, status))
+        new_exit = jnp.where(halts & running, a, exit_code)
+
+        # ---- static-edge accounting ----
+        is_block = (op == OP_BLOCK) & running
+        cur_loc = a & (MAP_SIZE - 1)
+        new_prev = jnp.where(is_block, cur_loc >> 1, prev_loc)
+        cur_idx = jnp.clip(b, 0, nb - 1)
+        onehot_prev = (jax.lax.broadcasted_iota(
+            jnp.int32, (nb + 1, t), 0) == prev_idx).astype(jnp.float32)
+        rows_e = jax.lax.dot(table_t, onehot_prev,
+                             precision=jax.lax.Precision.HIGHEST)
+        # rows_e[cidx, t] = edge index for (prev[t], cidx)   [nb, T]
+        eidx = jnp.sum(jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (nb, t), 0) == cur_idx,
+            rows_e, 0), axis=0, keepdims=True).astype(jnp.int32)
+        eiota = jax.lax.broadcasted_iota(jnp.int32, (n_edges + 1, t), 0)
+        emask = (eiota == eidx) & is_block
+        new_counts = counts + emask.astype(jnp.int32)
+        new_prev_idx = jnp.where(is_block, cur_idx + 1, prev_idx)
+        new_hash = jnp.where(
+            is_block, _mix32(path_hash ^ cur_loc.astype(jnp.uint32)),
+            path_hash)
+
+        def keep(new, old):
+            return jnp.where(running, new, old)
+
+        return (keep(new_pc, pc),
+                jnp.where(running, new_regs, regs),
+                jnp.where(running, new_mem, mem),
+                keep(new_prev, prev_loc),
+                keep(new_status, status),
+                keep(new_exit, exit_code),
+                keep(new_prev_idx, prev_idx),
+                new_counts, keep(new_hash, path_hash),
+                i + 1,
+                lane_steps + running.astype(jnp.int32))
+
+    # Loop carries must descend from a memory LOAD: a constant splat
+    # (or anything folded to one, like lens*0) gets Mosaic's
+    # fully-replicated {*,*} layout, and the loop back-edge cannot
+    # relayout the computed {0,0} values into it.
+    z = zero_ref[...]                                    # [1, T] zeros
+    state0 = (z,
+              jnp.zeros((N_REGS, t), jnp.int32) + z,
+              jnp.zeros((mem_size, t), jnp.int32) + z,
+              z,
+              z + FUZZ_RUNNING,
+              z,
+              z,
+              jnp.zeros((n_edges + 1, t), jnp.int32) + z,
+              z.astype(jnp.uint32),
+              jnp.int32(0),
+              z)
+
+    def cond(s):
+        return jnp.any(s[4] == FUZZ_RUNNING) & (s[9] < max_steps)
+
+    final = jax.lax.while_loop(cond, lambda s: step(s), state0)
+    status_ref[...] = final[4]
+    exit_ref[...] = final[5]
+    counts_ref[...] = final[7]
+    steps_ref[...] = final[10]
+    hash_ref[...] = final[8]
+
+
+@partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
+                                   "interpret"))
+def run_batch_pallas(instrs, edge_table, inputs, lengths, mem_size,
+                     max_steps, n_edges, interpret=False) -> VMResult:
+    """Pallas engine entry: same contract as vm._run_batch_impl with
+    record_stream=False.  B must be a multiple of LANE_TILE (callers
+    pad; padded lanes are regular executions of duplicated inputs)."""
+    b, L = inputs.shape
+    if b % LANE_TILE:
+        raise ValueError(f"batch {b} not a multiple of {LANE_TILE}")
+    grid = (b // LANE_TILE,)
+    instrs_t = instrs.T                          # [4, NI]
+    table_t = edge_table.T                       # [nb, nb+1]
+    bufs_t = inputs.T.astype(jnp.int32)          # [L, B]
+    lens = lengths.astype(jnp.int32).reshape(1, b)
+    zeros = jnp.zeros((1, b), jnp.int32)         # carry-init source
+
+    kernel = partial(_vm_kernel, mem_size=mem_size,
+                     max_steps=max_steps, n_edges=n_edges)
+    out_shapes = (
+        jax.ShapeDtypeStruct((1, b), jnp.int32),          # status
+        jax.ShapeDtypeStruct((1, b), jnp.int32),          # exit
+        jax.ShapeDtypeStruct((n_edges + 1, b), jnp.int32),  # counts
+        jax.ShapeDtypeStruct((1, b), jnp.int32),          # steps
+        jax.ShapeDtypeStruct((1, b), jnp.uint32),         # path hash
+    )
+    whole = lambda *_: (0, 0)  # noqa: E731 — replicate full array
+    lane_block = lambda i: (0, i)  # noqa: E731
+    status, exit_code, counts, steps, path_hash = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(instrs_t.shape, whole),
+            pl.BlockSpec(table_t.shape, whole),
+            pl.BlockSpec((L, LANE_TILE), lane_block),
+            pl.BlockSpec((1, LANE_TILE), lane_block),
+            pl.BlockSpec((1, LANE_TILE), lane_block),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, LANE_TILE), lane_block),
+            pl.BlockSpec((1, LANE_TILE), lane_block),
+            pl.BlockSpec((n_edges + 1, LANE_TILE), lane_block),
+            pl.BlockSpec((1, LANE_TILE), lane_block),
+            pl.BlockSpec((1, LANE_TILE), lane_block),
+        ),
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(instrs_t, table_t, bufs_t, lens, zeros)
+    return VMResult(status=status.reshape(b),
+                    exit_code=exit_code.reshape(b),
+                    counts=counts.T.astype(jnp.uint8),
+                    steps=steps.reshape(b),
+                    path_hash=path_hash.reshape(b),
+                    edge_ids=None)

@@ -26,7 +26,7 @@ Package map:
   native/           C/C++ host-side exec backend (forkserver protocol)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 MAP_SIZE_POW2 = 16
 MAP_SIZE = 1 << MAP_SIZE_POW2  # AFL-compatible edge bitmap size (reference afl_progs/config.h:314-315)

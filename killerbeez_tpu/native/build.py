@@ -13,7 +13,12 @@ from typing import Optional
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
-NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+# The C sources live in the repository's top-level native/ (KB_NATIVE_DIR
+# overrides).  A pip-installed wheel does not ship them: the host exec
+# tier (afl/return_code/debug/preload) needs a source checkout — the
+# device tiers (jit_harness/ipt) work from the wheel alone.
+NATIVE_DIR = os.environ.get(
+    "KB_NATIVE_DIR", os.path.join(_REPO_ROOT, "native"))
 BUILD_DIR = os.path.join(NATIVE_DIR, "build")
 
 _lock = threading.Lock()
@@ -47,7 +52,7 @@ def build_native(force: bool = False) -> bool:
             return _build_error is None
         _built = True
         if not os.path.isdir(NATIVE_DIR):
-            _build_error = f"native source dir missing: {NATIVE_DIR}"
+            _build_error = (f"native source dir missing: {NATIVE_DIR} — the host exec tier needs a source checkout (or KB_NATIVE_DIR pointing at the native/ sources); pip-installed wheels ship only the device tiers")
             return False
         if not force and not _stale():
             _build_error = None

@@ -8,6 +8,9 @@ persistence, preload forkserver, and coverage monotonicity as the
 input homes in on the magic.
 """
 
+import json
+import os
+
 import numpy as np
 import pytest
 
@@ -205,3 +208,26 @@ def test_afl_workers_option(corpus_bin):
     assert (res.statuses == 2).sum() == 2          # both ABCD lanes
     assert instr.total_execs == 6
     instr.cleanup()
+
+
+@pytest.mark.skipif(not os.environ.get("KB_QEMU_PATH"),
+                    reason="set KB_QEMU_PATH to an instrumented "
+                           "qemu-user binary to exercise qemu mode")
+def test_qemu_mode(corpus_bin):
+    """Binary-only targets via qemu-user (reference afl_progs
+    qemu_mode): the emulator is prepended to argv and coverage flows
+    through the same SHM contract. Gated: no qemu is bundled in this
+    image (docs/ARCHITECTURE.md out-of-scope note)."""
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    qemu = os.environ["KB_QEMU_PATH"]
+    instr = instrumentation_factory("afl", json.dumps(
+        {"qemu_mode": 1, "qemu_path": qemu, "use_fork_server": 0}))
+    try:
+        instr.enable(b"ABCD", cmd_line=corpus_bin("test-plain"))
+        assert instr.get_fuzz_result() == FUZZ_CRASH
+        instr.enable(b"zzzz", cmd_line=corpus_bin("test-plain"))
+        assert instr.get_fuzz_result() == FUZZ_NONE
+    finally:
+        instr.cleanup()

@@ -417,6 +417,8 @@ def havoc_at(buf: jax.Array, length: jax.Array, key: jax.Array,
     words = jax.random.bits(key, (n_steps + 1, 8), dtype=jnp.uint32)
     stack = jnp.uint32(1) << (1 + words[0, 0] % stack_pow2)
 
+    # scan, not unroll: unrolling the 16 edits was measured at zero
+    # runtime gain on chip but ~6x the trace/compile time
     def step(carry, xs):
         i, w = xs
         b, ln = carry

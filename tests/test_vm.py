@@ -158,3 +158,36 @@ def test_pc_out_of_range_crashes():
     prog = a.build()
     res = run_inputs(prog, [b"x"])
     assert int(res.status[0]) == FUZZ_CRASH
+
+
+def test_single_lane_reference_engine_parity(rng):
+    """vm._run_one is the readable single-lane reference the batched
+    one-hot engine is built against: statuses, exit codes, edge
+    streams, counts and path hashes must agree lane-for-lane."""
+    import jax
+    from killerbeez_tpu.models.vm import _run_batch_impl, _run_one
+
+    for name in ("test", "cgc_like", "tlvstack_vm"):
+        prog = targets.get_target(name)
+        B, L = 16, 32
+        inputs = rng.integers(0, 256, (B, L)).astype(np.uint8)
+        from killerbeez_tpu.models import targets_cgc
+        seed_fn = targets_cgc.VM_SEEDS.get(name)
+        seed = seed_fn[0]() if seed_fn else b"ABC@"
+        inputs[0, :len(seed)] = np.frombuffer(seed, np.uint8)
+        lengths = rng.integers(1, L + 1, B).astype(np.int32)
+        instrs = jnp.asarray(prog.instrs)
+        table = jnp.asarray(prog.edge_table)
+        batched = _run_batch_impl(instrs, table, jnp.asarray(inputs),
+                                  jnp.asarray(lengths), prog.mem_size,
+                                  prog.max_steps, prog.n_edges, True)
+        one = jax.vmap(
+            lambda b, ln: _run_one(instrs, table, prog.n_edges,
+                                   prog.mem_size, prog.max_steps, b, ln)
+        )(jnp.asarray(inputs), jnp.asarray(lengths))
+        for f in ("status", "exit_code", "counts", "steps",
+                  "path_hash", "edge_ids"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(batched, f)),
+                np.asarray(getattr(one, f)),
+                err_msg=f"{name}: {f} diverged")

@@ -54,6 +54,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-msd", "--mutator-state-dump",
                    help="dump mutator state to file on exit")
     p.add_argument("-l", "--logging-options", help="logging JSON options")
+    p.add_argument("-dt", "--debug-triage", action="store_true",
+                   help="re-run each unique crash once under the "
+                        "ptrace debug tier and save signal-level "
+                        "details next to the repro (host targets)")
     p.add_argument("-b", "--batch-size", type=int, default=1024,
                    help="candidates per device step (batched backends)")
     p.add_argument("--list", action="store_true",
@@ -97,7 +101,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 instrumentation, mutator)
 
         fuzzer = Fuzzer(driver, output_dir=args.output,
-                        batch_size=args.batch_size)
+                        batch_size=args.batch_size,
+                        debug_triage=args.debug_triage)
         stats = fuzzer.run(args.iterations)
         INFO_MSG(
             "results: %d crashes (%d unique), %d hangs (%d unique), "

@@ -45,11 +45,14 @@ class Fuzzer:
     """Drives driver/instrumentation/mutator to completion."""
 
     def __init__(self, driver: Driver, output_dir: str = "output",
-                 batch_size: int = 1024, write_findings: bool = True):
+                 batch_size: int = 1024, write_findings: bool = True,
+                 debug_triage: bool = False):
         self.driver = driver
         self.output_dir = output_dir
         self.batch_size = int(batch_size)
         self.write_findings = write_findings
+        self.debug_triage = debug_triage
+        self._dbg = None
         self.stats = FuzzStats()
         self._seen = {k: set() for k in ("crashes", "hangs", "new_paths")}
         if write_findings:
@@ -78,6 +81,47 @@ class Fuzzer:
                          else "crash", digest)
         return True
 
+    def _debug_repro(self, buf: bytes) -> None:
+        """Re-run a unique crash ONCE under the ptrace debug tier and
+        log (and persist) signal-level details — the "re-run the
+        interesting lanes under a debugger" post-pass: fuzzing speed
+        stays batched, crash detail stays single-exec."""
+        instr = self.driver.instrumentation
+        if instr is not None and instr.device_backed:
+            return  # device targets carry their detail in exit codes
+        try:
+            spec = self.driver._host_exec_spec()
+        except (NotImplementedError, KeyError):
+            return
+        try:
+            if self._dbg is None:
+                import json as _json
+                from ..instrumentation.debug import DebugInstrumentation
+                # inherit execution conditions from the batched tier —
+                # a slow or rlimit-dependent crash must re-run under
+                # the same timeout/mem_limit to reproduce
+                opts = {}
+                for key in ("timeout", "mem_limit"):
+                    if instr is not None and key in getattr(
+                            instr, "options", {}):
+                        opts[key] = instr.options[key]
+                self._dbg = DebugInstrumentation(
+                    _json.dumps(opts) if opts else None)
+            if spec.get("use_stdin"):
+                self._dbg.enable(buf, cmd_line=spec["cmd_line"])
+            else:
+                write_buffer_to_file(spec["input_file"], buf)
+                self._dbg.enable(None, cmd_line=spec["cmd_line"])
+            desc = self._dbg.crash_description()
+            CRITICAL_MSG("crash triage: %s", desc)
+            if self.write_findings:
+                write_buffer_to_file(
+                    os.path.join(self.output_dir, "crashes",
+                                 md5_hex(buf) + ".info"),
+                    (desc + "\n").encode())
+        except Exception as e:  # triage detail must never stop fuzzing
+            WARNING_MSG("debug triage failed: %s", e)
+
     def _triage_lane(self, status: int, new_path: int, buf: bytes,
                      unique_crash: bool = False,
                      unique_hang: bool = False) -> None:
@@ -86,6 +130,8 @@ class Fuzzer:
             s.crashes += 1
             s.unique_crashes += int(unique_crash)
             self._record("crashes", buf)
+            if unique_crash and self.debug_triage:
+                self._debug_repro(buf)
         elif status == FUZZ_HANG:
             s.hangs += 1
             s.unique_hangs += int(unique_hang)

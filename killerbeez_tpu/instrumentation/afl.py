@@ -275,11 +275,15 @@ class AflInstrumentation(Instrumentation):
             bitmaps = np.where(self._ignore[None, :], 0, bitmaps)
         if pad_to is not None and pad_to > real:
             # pad only the RESULT arrays to the stable triage shape:
-            # zero bitmaps + exit-0 statuses are novelty/verdict no-ops
-            # and cost no target executions
+            # zero bitmaps are novelty no-ops and cost no target
+            # executions.  Padded statuses carry a distinct sentinel
+            # (-3 -> FUZZ_ERROR) so a caller that ever consumes lanes
+            # beyond the real count fails LOUDLY (error-count spike)
+            # instead of silently reading plausible exit-0 results.
             pad = pad_to - real
             statuses_raw = np.concatenate(
-                [statuses_raw, np.zeros(pad, dtype=statuses_raw.dtype)])
+                [statuses_raw,
+                 np.full(pad, -3, dtype=statuses_raw.dtype)])
             if bitmaps is not None:
                 bitmaps = np.concatenate(
                     [bitmaps,
@@ -288,7 +292,7 @@ class AflInstrumentation(Instrumentation):
         verdicts = np.full(n, FUZZ_NONE, dtype=np.int32)
         verdicts[statuses_raw >= 512] = FUZZ_CRASH
         verdicts[statuses_raw == -1] = FUZZ_HANG
-        verdicts[statuses_raw == -2] = FUZZ_ERROR
+        verdicts[statuses_raw <= -2] = FUZZ_ERROR  # incl. -3 padding
         exit_codes = np.where(statuses_raw >= 512, statuses_raw - 512,
                               np.maximum(statuses_raw, 0)).astype(np.int32)
 

@@ -292,3 +292,40 @@ def test_exact_gate_switches_default_at_large_batch():
         assert "slow" in buf.getvalue()
     finally:
         kblog._state.stream = old_stream
+
+
+def test_debug_triage_post_pass(tmp_path, corpus_bin):
+    """VERDICT weak #6: unique crashes re-run once under the ptrace
+    debug tier — fuzzing stays batched, crash detail (signal, fault
+    address, module-relative PC) lands next to the repro."""
+    instr = instrumentation_factory("afl", None)
+    mut = mutator_factory("bit_flip", None, b"ABC@")
+    drv = driver_factory("stdin", json.dumps(
+        {"path": corpus_bin("test")}), instr, mut)
+    fz = Fuzzer(drv, output_dir=str(tmp_path / "o"), batch_size=32,
+                debug_triage=True)
+    stats = fz.run(32)
+    assert stats.unique_crashes == 1
+    crash_dir = tmp_path / "o" / "crashes"
+    infos = [p for p in os.listdir(crash_dir) if p.endswith(".info")]
+    assert len(infos) == 1
+    text = (crash_dir / infos[0]).read_text()
+    assert "SIGSEGV" in text and "pc=0x" in text
+    drv.cleanup()
+    instr.cleanup()
+
+
+def test_afl_padding_sentinel(corpus_bin):
+    """VERDICT weak #8: result-array padding carries a loud sentinel
+    (FUZZ_ERROR) rather than plausible exit-0 statuses."""
+    instr = instrumentation_factory("afl", None)
+    instr.prepare_host(corpus_bin("test"), use_stdin=True)
+    inputs = np.zeros((3, 4), dtype=np.uint8)
+    inputs[0, :4] = np.frombuffer(b"ABCD", dtype=np.uint8)
+    res = instr.run_batch(inputs, np.full(3, 4, dtype=np.int32),
+                          pad_to=8)
+    assert res.statuses[0] == FUZZ_CRASH
+    assert (res.statuses[3:] == 4).all()       # FUZZ_ERROR sentinel
+    assert (res.new_paths[3:] == 0).all()
+    assert instr.total_execs == 3              # padding cost nothing
+    instr.cleanup()

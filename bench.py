@@ -102,6 +102,35 @@ def bench_host_configs():
          crashes=stats.crashes)
 
 
+
+def _prep_seed(seed):
+    import jax.numpy as jnp
+    import numpy as np
+    L = max(8, len(seed))
+    seed_buf = np.zeros(L, dtype=np.uint8)
+    seed_buf[:len(seed)] = np.frombuffer(seed, dtype=np.uint8)
+    return jnp.asarray(seed_buf), jnp.int32(len(seed))
+
+
+def _time_fuzz_loop(fuzz_step, batch, steps):
+    """Warm up, then time `steps` dependent fuzz steps.  fuzz_step:
+    (vb, vc, vh, it) -> (vb, vc, vh, crashes, new_paths)."""
+    import jax
+    import jax.numpy as jnp
+    from killerbeez_tpu import MAP_SIZE
+    virgin = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
+    vb, vc, vh = virgin, virgin, virgin
+    vb, vc, vh, crashes, news = fuzz_step(vb, vc, vh, jnp.uint32(0))
+    jax.block_until_ready(vb)
+    t0 = time.time()
+    for i in range(1, steps + 1):
+        vb, vc, vh, crashes, news = fuzz_step(vb, vc, vh,
+                                              jnp.uint32(i))
+    jax.block_until_ready(vb)
+    dt = time.time() - t0
+    return batch * steps / dt, int(crashes)
+
+
 def bench_device(target, batch, steps, seed, stack_pow2=4,
                  engine="xla"):
     """Fused on-device fuzz loop: havoc -> KBVM -> static-edge triage."""
@@ -114,16 +143,14 @@ def bench_device(target, batch, steps, seed, stack_pow2=4,
     from killerbeez_tpu.ops.mutate_core import havoc_at
     from killerbeez_tpu.ops.static_triage import make_static_maps
 
+    from killerbeez_tpu import FUZZ_CRASH
+
     prog = targets.get_target(target)
     instrs = jnp.asarray(prog.instrs)
     edge_table = jnp.asarray(prog.edge_table)
     u_np, s_np = make_static_maps(prog.edge_slot)
     u_slots, seg_id = jnp.asarray(u_np), jnp.asarray(s_np)
-    L = max(8, len(seed))
-    seed_buf = np.zeros(L, dtype=np.uint8)
-    seed_buf[:len(seed)] = np.frombuffer(seed, dtype=np.uint8)
-    seed_buf = jnp.asarray(seed_buf)
-    seed_len = jnp.int32(len(seed))
+    seed_buf, seed_len = _prep_seed(seed)
 
     @jax.jit
     def fuzz_step(vb, vc, vh, it):
@@ -136,19 +163,53 @@ def bench_device(target, batch, steps, seed, stack_pow2=4,
         statuses, new_paths, uc, uh, ec, vb2, vc2, vh2, _ = _fused_step(
             instrs, edge_table, u_slots, seg_id, bufs, lens, vb, vc, vh,
             prog.mem_size, prog.max_steps, prog.n_edges, False, engine)
-        return (vb2, vc2, vh2, jnp.sum(statuses == 2),
+        return (vb2, vc2, vh2, jnp.sum(statuses == FUZZ_CRASH),
                 jnp.sum(new_paths > 0))
 
-    virgin = jnp.full((MAP_SIZE,), 0xFF, dtype=jnp.uint8)
-    vb, vc, vh = virgin, virgin, virgin
-    vb, vc, vh, crashes, news = fuzz_step(vb, vc, vh, jnp.uint32(0))
-    jax.block_until_ready(vb)
-    t0 = time.time()
-    for i in range(1, steps + 1):
-        vb, vc, vh, crashes, news = fuzz_step(vb, vc, vh, jnp.uint32(i))
-    jax.block_until_ready(vb)
-    dt = time.time() - t0
-    return batch * steps / dt, int(crashes)
+    return _time_fuzz_loop(fuzz_step, batch, steps)
+
+
+def bench_device_fused(target, batch, steps, seed):
+    """Mutation AND execution in ONE pallas_call (ops/vm_kernel
+    fuzz_batch_pallas): candidates are born, run and counted while
+    resident in VMEM; triage consumes the counts."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from killerbeez_tpu import (
+        FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING, MAP_SIZE,
+    )
+    from killerbeez_tpu.models import targets
+    from killerbeez_tpu.ops.static_triage import (
+        make_static_maps, static_triage,
+    )
+    from killerbeez_tpu.ops.vm_kernel import (
+        fuzz_batch_pallas, havoc_words,
+    )
+
+    prog = targets.get_target(target)
+    ins = jnp.asarray(prog.instrs)
+    tbl = jnp.asarray(prog.edge_table)
+    u_np, s_np = make_static_maps(prog.edge_slot)
+    u_slots, seg_id = jnp.asarray(u_np), jnp.asarray(s_np)
+    seed_j, seed_len = _prep_seed(seed)
+
+    @jax.jit
+    def fuzz_step(vb, vc, vh, it):
+        w = havoc_words(jax.random.fold_in(jax.random.key(0), it),
+                        batch)
+        res, bufs, lens = fuzz_batch_pallas(
+            ins, tbl, seed_j, seed_len, w, prog.mem_size,
+            prog.max_steps, prog.n_edges)
+        statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
+                             res.status)
+        new_paths, uc, uh, vb2, vc2, vh2 = static_triage(
+            vb, vc, vh, res.counts, u_slots, seg_id,
+            statuses == FUZZ_CRASH, statuses == FUZZ_HANG)
+        return (vb2, vc2, vh2, jnp.sum(statuses == FUZZ_CRASH),
+                jnp.sum(new_paths > 0))
+
+    return _time_fuzz_loop(fuzz_step, batch, steps)
 
 
 def bench_multichip_smoke():
@@ -229,21 +290,20 @@ def main():
     emit("4b", "flagship tlvstack_vm, xla engine", vx,
          baseline=FORKSERVER_BASELINE)
 
-    # headline LAST: the CGC-grade flagship on the Pallas VM kernel
-    # (falls back to the XLA engine number if the kernel won't compile
-    # in this environment)
+    # headline LAST: the CGC-grade flagship with mutation AND
+    # execution fused into one Pallas kernel (falls back to the XLA
+    # engine number if the kernel won't compile in this environment)
     try:
-        vH, _ = bench_device("tlvstack_vm", 16384, 20,
-                             targets_cgc.tlvstack_vm_seed(),
-                             engine="pallas")
-        engine_used = "pallas"
+        vH, _ = bench_device_fused("tlvstack_vm", 16384, 20,
+                                   targets_cgc.tlvstack_vm_seed())
+        engine_used = "fused pallas"
     except Exception as e:
         emit("4p", "pallas engine unavailable", 0.0, ok=False,
              error=str(e)[:200])
         vH, engine_used = vx, "xla"
     print(json.dumps({
         "metric": "execs/sec/chip on tlvstack_vm (110-block CGC-grade "
-                  f"target; fused havoc+KBVM({engine_used})+static-edge "
+                  f"target; {engine_used} havoc+KBVM+static-edge "
                   "triage)",
         "value": round(vH, 1),
         "unit": "execs/sec",

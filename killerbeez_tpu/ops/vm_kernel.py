@@ -63,16 +63,15 @@ def _chain(pairs, default):
     return out
 
 
-def _vm_kernel(instrs_t_ref, table_t_ref, bufs_ref, lens_ref, zero_ref,
-               status_ref, exit_ref, counts_ref, steps_ref, hash_ref,
-               *, mem_size, max_steps, n_edges):
-    t = bufs_ref.shape[1]                       # TILE lanes
-    instrs_t = instrs_t_ref[...].astype(jnp.float32)     # [4, NI]
-    table_t = table_t_ref[...].astype(jnp.float32)       # [nb, nb+1]
+def _vm_loop(instrs_t, table_t, bufs, lengths, z,
+             mem_size, max_steps, n_edges):
+    """The VM step loop shared by the plain and fused kernels: takes
+    lane-last [L, T] candidate bytes + [1, T] lengths, returns the
+    final carry tuple.  ``z`` is a loaded [1, T] zeros row (see the
+    carry-layout note in state0)."""
+    t = bufs.shape[1]
     ni = instrs_t.shape[1]
     nb = table_t.shape[0]
-    bufs = bufs_ref[...]                                 # [L, T] i32
-    lengths = lens_ref[...]                              # [1, T]
     L = bufs.shape[0]
 
     def step(state):
@@ -195,7 +194,6 @@ def _vm_kernel(instrs_t_ref, table_t_ref, bufs_ref, lens_ref, zero_ref,
     # (or anything folded to one, like lens*0) gets Mosaic's
     # fully-replicated {*,*} layout, and the loop back-edge cannot
     # relayout the computed {0,0} values into it.
-    z = zero_ref[...]                                    # [1, T] zeros
     state0 = (z,
               jnp.zeros((N_REGS, t), jnp.int32) + z,
               jnp.zeros((mem_size, t), jnp.int32) + z,
@@ -211,7 +209,16 @@ def _vm_kernel(instrs_t_ref, table_t_ref, bufs_ref, lens_ref, zero_ref,
     def cond(s):
         return jnp.any(s[4] == FUZZ_RUNNING) & (s[9] < max_steps)
 
-    final = jax.lax.while_loop(cond, lambda s: step(s), state0)
+    return jax.lax.while_loop(cond, lambda s: step(s), state0)
+
+
+def _vm_kernel(instrs_t_ref, table_t_ref, bufs_ref, lens_ref, zero_ref,
+               status_ref, exit_ref, counts_ref, steps_ref, hash_ref,
+               *, mem_size, max_steps, n_edges):
+    instrs_t = instrs_t_ref[...].astype(jnp.float32)     # [4, NI]
+    table_t = table_t_ref[...].astype(jnp.float32)       # [nb, nb+1]
+    final = _vm_loop(instrs_t, table_t, bufs_ref[...], lens_ref[...],
+                     zero_ref[...], mem_size, max_steps, n_edges)
     status_ref[...] = final[4]
     exit_ref[...] = final[5]
     counts_ref[...] = final[7]
@@ -273,3 +280,256 @@ def run_batch_pallas(instrs, edge_table, inputs, lengths, mem_size,
                     steps=steps.reshape(b),
                     path_hash=path_hash.reshape(b),
                     edge_ids=None)
+
+
+# --------------------------------------------------------------------
+# Fused mutate + execute: the whole fuzz candidate lifecycle in VMEM
+# --------------------------------------------------------------------
+#
+# havoc's stacked edits are elementwise over the candidate buffer, so
+# they port to the kernel's lane-last layout directly — the buffer
+# never leaves VMEM between mutation and execution.  Bit-for-bit
+# parity with ops/mutate_core.havoc_at (same PRNG words, generated on
+# host with the same keys) is enforced by tests.
+
+def _havoc_edit(buf, length, w, active, L):
+    """One stacked havoc edit, lane-last: buf [L, T] i32 (byte
+    values), length [1, T] i32, w [8, T] u32 random words, active
+    [1, T] bool.  Mirrors mutate_core._havoc_one exactly."""
+    from .mutate_core import (
+        ARITH_MAX, INTERESTING_8, INTERESTING_16, INTERESTING_32,
+        N_HAVOC_OPS,
+    )
+    u32 = jnp.uint32
+    op = (w[0:1] % N_HAVOC_OPS).astype(jnp.int32)
+    maxlen = jnp.maximum(length, 1).astype(u32)
+    pos = (w[1:2] % maxlen).astype(jnp.int32)
+    pos2 = (w[2:3] % maxlen).astype(jnp.int32)
+    rbyte = w[3:4] % 256
+    rint = w[4:5] & 0x7FFFFFFF
+    be = (w[5:6] & 1) == 1
+    # maxes stay in i32: Mosaic has no unsigned-max (arith.maxui)
+    blk_span = jnp.maximum(
+        jnp.maximum(length // 2, 2) - 1, 1).astype(u32)
+    blk = (1 + w[6:7] % blk_span).astype(jnp.int32)
+    bit = (w[7:8] % jnp.maximum(length * 8, 1).astype(u32)
+           ).astype(jnp.int32)
+    delta = (rint % ARITH_MAX + 1).astype(u32)
+    use_fill = (rint % 4) == 0
+
+    is_flip = op == 0
+    is_int = (op >= 1) & (op <= 3)
+    is_arith = (op >= 4) & (op <= 9)
+    is_xor = op == 10
+    is_del = (op == 11) | (op == 12)
+    is_ins = op == 13
+    is_ovw = op == 14
+    is_write = is_int | is_arith
+
+    width = _chain(
+        [(is_int, _chain([(op == 1, jnp.full_like(op, 1)),
+                          (op == 2, jnp.full_like(op, 2))],
+                         jnp.full_like(op, 4))),
+         (is_arith, _chain([(op <= 5, jnp.full_like(op, 1)),
+                            (op <= 7, jnp.full_like(op, 2))],
+                           jnp.full_like(op, 4)))],
+        jnp.full_like(op, 1))
+
+    def const_pick(sel, values):
+        """values[sel] for a small python tuple of scalar constants."""
+        out = jnp.zeros_like(sel, dtype=u32) + u32(values[0])
+        for r, v in enumerate(values[1:], start=1):
+            out = jnp.where(sel == r, u32(v), out)
+        return out
+
+    int_val = _chain(
+        [(op == 1, const_pick(rint % len(INTERESTING_8),
+                              tuple(int(x) for x in
+                                    INTERESTING_8.astype(np.uint32)))
+          & 0xFF),
+         (op == 2, const_pick(rint % len(INTERESTING_16),
+                              tuple(int(x) for x in
+                                    INTERESTING_16.astype(np.uint32)))
+          & 0xFFFF)],
+        const_pick(rint % len(INTERESTING_32),
+                   tuple(int(x) for x in
+                         (INTERESTING_32 & 0xFFFFFFFF).astype(np.uint32))))
+
+    # LE dword at pos (mirrors read_bytes(buf, pos, 4, False))
+    cur = jnp.zeros_like(rint)
+    for k in range(4):
+        byte = _pick_rows(buf, jnp.clip(pos + k, 0, L - 1)).astype(u32)
+        cur = cur | (byte << (8 * k))
+    cur_w = _chain(
+        [(width == 1, cur & 0xFF),
+         (width == 2, jnp.where(be,
+                                ((cur & 0xFF) << 8) | ((cur >> 8) & 0xFF),
+                                cur & 0xFFFF))],
+        jnp.where(be,
+                  ((cur & 0xFF) << 24) | ((cur & 0xFF00) << 8)
+                  | ((cur >> 8) & 0xFF00) | ((cur >> 24) & 0xFF),
+                  cur))
+    sign_add = (op == 5) | (op == 7) | (op == 9)
+    d = jnp.where(sign_add, delta, u32(0) - delta)
+    arith_val = cur_w + d
+    wmask = _chain([(width == 1, jnp.zeros_like(rint) + u32(0xFF)),
+                    (width == 2, jnp.zeros_like(rint) + u32(0xFFFF))],
+                   jnp.zeros_like(rint) + u32(0xFFFFFFFF))
+    write_val = jnp.where(is_arith, arith_val, int_val) & wmask
+
+    idx = jax.lax.broadcasted_iota(jnp.int32, buf.shape, 0)  # [L, T]
+    src_del = jnp.where(idx >= pos, idx + blk, idx)
+    in_ins = (idx >= pos) & (idx < pos + blk)
+    src_ins = jnp.where(idx >= pos + blk, idx - blk,
+                        jnp.where(in_ins, pos2 + (idx - pos), idx))
+    src_ovw = jnp.where(in_ins & ~use_fill, pos2 + (idx - pos), idx)
+    src = jnp.where(is_del, src_del,
+                    jnp.where(is_ins, src_ins,
+                              jnp.where(is_ovw, src_ovw, idx)))
+    src_c = jnp.clip(src, 0, L - 1)
+    gathered = jnp.zeros_like(buf)
+    for j in range(L):
+        gathered = jnp.where(src_c == j, buf[j:j + 1, :], gathered)
+
+    xval = jnp.where(is_flip,
+                     128 >> (bit & 7),
+                     jnp.maximum(rbyte.astype(jnp.int32), 1))
+    xbyte = jnp.where(is_flip, bit >> 3, pos)
+    xor_mask = jnp.where((idx == xbyte) & (is_flip | is_xor), xval, 0)
+
+    off = idx - pos
+    k = jnp.where(be, width - 1 - off, off)
+    vbytes = ((write_val >> (8 * jnp.clip(k, 0, 3)).astype(u32))
+              & 0xFF).astype(jnp.int32)
+    in_write = is_write & (off >= 0) & (off < width)
+    in_fill = (is_ins | is_ovw) & use_fill & in_ins
+    set_mask = in_write | in_fill
+    set_val = jnp.where(in_write, vbytes, rbyte.astype(jnp.int32))
+
+    out = jnp.where(set_mask, set_val, gathered ^ xor_mask) & 0xFF
+    new_len = _chain(
+        [(is_del, jnp.maximum(length - blk, 1)),
+         (is_ins, jnp.minimum(length + blk, L))], length)
+    return (jnp.where(active, out, buf),
+            jnp.where(active, new_len, length))
+
+
+def _fuzz_kernel(instrs_t_ref, table_t_ref, seed_ref, lens_ref,
+                 words_ref, zero_ref,
+                 status_ref, exit_ref, counts_ref, steps_ref, hash_ref,
+                 bufs_out_ref, lens_out_ref,
+                 *, mem_size, max_steps, n_edges, stack_pow2):
+    instrs_t = instrs_t_ref[...].astype(jnp.float32)
+    table_t = table_t_ref[...].astype(jnp.float32)
+    z = zero_ref[...]
+    buf = seed_ref[...] + z                     # [L, T] (load-derived)
+    length = lens_ref[...] + z                  # [1, T]
+    words = words_ref[...]                      # [(n_steps+1)*8, T] u32
+    L = buf.shape[0]
+    n_steps = 1 << stack_pow2
+
+    stack = jnp.uint32(1) << (1 + words[0:1] % stack_pow2)
+    for i in range(n_steps):
+        w = words[(i + 1) * 8:(i + 2) * 8]
+        active = (jnp.zeros_like(length, dtype=jnp.uint32)
+                  + jnp.uint32(i)) < stack
+        buf, length = _havoc_edit(buf, length, w, active, L)
+
+    final = _vm_loop(instrs_t, table_t, buf, length, z,
+                     mem_size, max_steps, n_edges)
+    status_ref[...] = final[4]
+    exit_ref[...] = final[5]
+    counts_ref[...] = final[7]
+    steps_ref[...] = final[10]
+    hash_ref[...] = final[8]
+    bufs_out_ref[...] = buf
+    lens_out_ref[...] = length
+
+
+def havoc_words(key, b, stack_pow2=4):
+    """The per-lane PRNG words the fused kernel consumes — generated
+    with EXACTLY havoc_at's keys/stream so fused mutants are
+    bit-identical to the mutate-then-execute pipeline.
+
+    Returns uint32[(2**stack_pow2 + 1) * 8, b] (lane-last)."""
+    n_steps = 1 << stack_pow2
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(b, dtype=jnp.uint32))
+    words = jax.vmap(
+        lambda k: jax.random.bits(k, (n_steps + 1, 8),
+                                  dtype=jnp.uint32))(keys)
+    return words.reshape(b, (n_steps + 1) * 8).T
+
+
+@partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
+                                   "stack_pow2", "interpret"))
+def fuzz_batch_pallas(instrs, edge_table, seed_buf, seed_len, words,
+                      mem_size, max_steps, n_edges, stack_pow2=4,
+                      interpret=False):
+    """Fused fuzz step: havoc mutation AND VM execution in one
+    pallas_call — candidates are born, run and triaged (counts) while
+    resident in VMEM.  ``seed_buf`` uint8[L], ``words`` from
+    havoc_words().  Returns (VMResult, bufs uint8[B, L], lens)."""
+    n_words, b = words.shape
+    L = seed_buf.shape[0]
+    if b % LANE_TILE:
+        raise ValueError(f"batch {b} not a multiple of {LANE_TILE}")
+    if n_words != ((1 << stack_pow2) + 1) * 8:
+        raise ValueError(
+            f"words has {n_words} rows but stack_pow2={stack_pow2} "
+            f"needs {((1 << stack_pow2) + 1) * 8} — generate with "
+            f"havoc_words(key, b, stack_pow2)")
+    grid = (b // LANE_TILE,)
+    instrs_t = instrs.T
+    table_t = edge_table.T
+    seed_b = jnp.broadcast_to(seed_buf.astype(jnp.int32)[:, None],
+                              (L, b))
+    lens = jnp.broadcast_to(
+        seed_len.astype(jnp.int32).reshape(1, 1), (1, b))
+    zeros = jnp.zeros((1, b), jnp.int32)
+
+    kernel = partial(_fuzz_kernel, mem_size=mem_size,
+                     max_steps=max_steps, n_edges=n_edges,
+                     stack_pow2=stack_pow2)
+    out_shapes = (
+        jax.ShapeDtypeStruct((1, b), jnp.int32),
+        jax.ShapeDtypeStruct((1, b), jnp.int32),
+        jax.ShapeDtypeStruct((n_edges + 1, b), jnp.int32),
+        jax.ShapeDtypeStruct((1, b), jnp.int32),
+        jax.ShapeDtypeStruct((1, b), jnp.uint32),
+        jax.ShapeDtypeStruct((L, b), jnp.int32),
+        jax.ShapeDtypeStruct((1, b), jnp.int32),
+    )
+    whole = lambda *_: (0, 0)  # noqa: E731
+    lane_block = lambda i: (0, i)  # noqa: E731
+    (status, exit_code, counts, steps, path_hash, bufs,
+     out_lens) = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(instrs_t.shape, whole),
+            pl.BlockSpec(table_t.shape, whole),
+            pl.BlockSpec((L, LANE_TILE), lane_block),
+            pl.BlockSpec((1, LANE_TILE), lane_block),
+            pl.BlockSpec((n_words, LANE_TILE), lane_block),
+            pl.BlockSpec((1, LANE_TILE), lane_block),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, LANE_TILE), lane_block),
+            pl.BlockSpec((1, LANE_TILE), lane_block),
+            pl.BlockSpec((n_edges + 1, LANE_TILE), lane_block),
+            pl.BlockSpec((1, LANE_TILE), lane_block),
+            pl.BlockSpec((1, LANE_TILE), lane_block),
+            pl.BlockSpec((L, LANE_TILE), lane_block),
+            pl.BlockSpec((1, LANE_TILE), lane_block),
+        ),
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(instrs_t, table_t, seed_b, lens, words, zeros)
+    res = VMResult(status=status.reshape(b),
+                   exit_code=exit_code.reshape(b),
+                   counts=counts.T.astype(jnp.uint8),
+                   steps=steps.reshape(b),
+                   path_hash=path_hash.reshape(b),
+                   edge_ids=None)
+    return res, bufs.T.astype(jnp.uint8), out_lens.reshape(b)

@@ -84,3 +84,42 @@ def test_jit_harness_pallas_engine(tmp_path):
         jh._fused_step.clear_cache()
     np.testing.assert_array_equal(r_x.statuses, r_p.statuses)
     np.testing.assert_array_equal(r_x.new_paths, r_p.new_paths)
+
+
+def test_fused_mutate_execute_parity(rng):
+    """fuzz_batch_pallas runs havoc INSIDE the kernel; with the same
+    PRNG words it must reproduce the havoc_at -> VM pipeline
+    bit-for-bit: mutant bytes, lengths, and every execution field."""
+    import jax
+    from killerbeez_tpu.ops.mutate_core import havoc_at
+    from killerbeez_tpu.ops.vm_kernel import (
+        fuzz_batch_pallas, havoc_words,
+    )
+    prog = targets.get_target("tlvstack_vm")
+    B, L = LANE_TILE, 32
+    seed = targets_cgc.VM_SEEDS["tlvstack_vm"][0]()
+    seed_buf = np.zeros(L, np.uint8)
+    seed_buf[:len(seed)] = np.frombuffer(seed, np.uint8)
+    seed_j = jnp.asarray(seed_buf)
+    seed_len = jnp.int32(len(seed))
+    ins = jnp.asarray(prog.instrs)
+    tbl = jnp.asarray(prog.edge_table)
+
+    key = jax.random.fold_in(jax.random.key(0), 3)
+    words = havoc_words(key, B)
+    res, bufs, lens = fuzz_batch_pallas(
+        ins, tbl, seed_j, seed_len, words, prog.mem_size,
+        prog.max_steps, prog.n_edges, interpret=True)
+
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(B, dtype=jnp.uint32))
+    rbufs, rlens = jax.vmap(
+        lambda k: havoc_at(seed_j, seed_len, k, stack_pow2=4))(keys)
+    ref = _run_batch_impl(ins, tbl, rbufs, rlens, prog.mem_size,
+                          prog.max_steps, prog.n_edges, False)
+    np.testing.assert_array_equal(np.asarray(rbufs), np.asarray(bufs))
+    np.testing.assert_array_equal(np.asarray(rlens), np.asarray(lens))
+    for f in FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, f)), np.asarray(getattr(res, f)),
+            err_msg=f"fused: {f} diverged")

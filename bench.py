@@ -136,14 +136,11 @@ def bench_device(target, batch, steps, seed, stack_pow2=4,
     """Fused on-device fuzz loop: havoc -> KBVM -> static-edge triage."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
-    from killerbeez_tpu import MAP_SIZE
+    from killerbeez_tpu import FUZZ_CRASH
     from killerbeez_tpu.models import targets
     from killerbeez_tpu.instrumentation.jit_harness import _fused_step
     from killerbeez_tpu.ops.mutate_core import havoc_at
     from killerbeez_tpu.ops.static_triage import make_static_maps
-
-    from killerbeez_tpu import FUZZ_CRASH
 
     prog = targets.get_target(target)
     instrs = jnp.asarray(prog.instrs)
@@ -175,10 +172,7 @@ def bench_device_fused(target, batch, steps, seed):
     resident in VMEM; triage consumes the counts."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
-    from killerbeez_tpu import (
-        FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING, MAP_SIZE,
-    )
+    from killerbeez_tpu import FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING
     from killerbeez_tpu.models import targets
     from killerbeez_tpu.ops.static_triage import (
         make_static_maps, static_triage,

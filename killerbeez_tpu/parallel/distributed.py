@@ -34,8 +34,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING, MAP_SIZE
 from ..models.vm import Program, _run_batch_impl
 from ..ops.coverage import classify_counts, simplify_trace
-from ..ops.hashing import hash_bitmaps
 from ..ops.mutate_core import havoc_at
+from ..ops.sparse_coverage import stream_hash
 from ..ops.static_triage import counts_by_slot, make_static_maps
 
 
@@ -69,16 +69,38 @@ def sharded_state_init(mesh: Mesh,
     )
 
 
-def _slice_bitmap(counts, u_slots, seg_id, slice_size, slice_lo):
-    """Per-lane hit counts for this shard's [lo, lo+size) slot range,
-    scattered from the program's static edge universe (u_slots are
-    unique, so in-slice scatter positions never collide)."""
-    b = counts.shape[0]
-    by_slot = counts_by_slot(counts, seg_id, u_slots.shape[0])
-    in_slice = (u_slots >= slice_lo) & (u_slots < slice_lo + slice_size)
-    idx = jnp.where(in_slice, u_slots - slice_lo, slice_size)
-    bm = jnp.zeros((b, slice_size + 1), dtype=jnp.uint8)
-    return bm.at[:, idx].set(by_slot)[:, :slice_size]
+def _shard_static_maps(program: Program, n_mp: int):
+    """Host-side partition of the program's static slot universe over
+    the mp axis.  The virgin maps are mp-sharded by dense slot ranges
+    (state-export compatibility); each shard's per-step WORK however
+    runs over only its own u-slots:
+
+    Returns (u_loc int32[n_mp, U_max]  shard-local virgin offsets
+             (sentinel = slice_size for padding),
+             eidx  int32[n_mp, E]      edge -> shard u-column
+             (sentinel = U_max: edge belongs to another shard),
+             outside uint8[n_mp, slice_size]  the constant
+             simplify-trace class-1 pattern of slots outside the
+             universe, per shard slice)."""
+    u_slots, seg_id = make_static_maps(program.edge_slot)
+    slice_size = program.map_size // n_mp
+    shard_of_u = u_slots // slice_size
+    counts = np.bincount(shard_of_u, minlength=n_mp)
+    u_max = max(int(counts.max(initial=0)), 1)
+    u_loc = np.full((n_mp, u_max), slice_size, dtype=np.int32)
+    u_pos = np.zeros(len(u_slots), dtype=np.int32)
+    for m in range(n_mp):
+        idxs = np.where(shard_of_u == m)[0]
+        u_loc[m, :len(idxs)] = u_slots[idxs] - m * slice_size
+        u_pos[idxs] = np.arange(len(idxs))
+    eidx = np.full((n_mp, len(seg_id)), u_max, dtype=np.int32)
+    for e, g in enumerate(seg_id):
+        eidx[shard_of_u[g], e] = u_pos[g]
+    outside = np.ones((n_mp, slice_size), dtype=np.uint8)
+    for m in range(n_mp):
+        sel = u_loc[m][u_loc[m] < slice_size]
+        outside[m, sel] = 0
+    return u_loc, eidx, outside
 
 
 def _gather_and_fold(v_local, axis):
@@ -106,15 +128,19 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
     slice_size = program.map_size // n_mp
     instrs = jnp.asarray(program.instrs)
     edge_table = jnp.asarray(program.edge_table)
-    u_slots_np, seg_id_np = make_static_maps(program.edge_slot)
-    u_slots = jnp.asarray(u_slots_np)
-    seg_id = jnp.asarray(seg_id_np)
+    u_loc_np, eidx_np, outside_np = _shard_static_maps(program, n_mp)
+    u_loc_all = jnp.asarray(u_loc_np)
+    eidx_all = jnp.asarray(eidx_np)
+    outside_all = jnp.asarray(outside_np)
+    u_max = u_loc_np.shape[1]
 
     def local_step(vb, vc, vh, seed_buf, seed_len, base_it):
         # ---- which shard am I ----
         dp_i = jax.lax.axis_index("dp")
         mp_i = jax.lax.axis_index("mp")
-        slice_lo = mp_i.astype(jnp.int32) * slice_size
+        u_loc = u_loc_all[mp_i]          # [U_max] my virgin offsets
+        eidx = eidx_all[mp_i]            # [E] edge -> my u-column
+        outside = outside_all[mp_i]      # [slice] class-1 constant
 
         # ---- mutate: per-global-lane keys (mesh-shape independent) ----
         lane = (dp_i.astype(jnp.uint32) * batch_per_device
@@ -135,23 +161,27 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
                              res.status)
 
-        # ---- coverage on my map slice ----
-        bm = _slice_bitmap(res.counts, u_slots, seg_id, slice_size,
-                           slice_lo)
-        cls = classify_counts(bm)
-        simp = simplify_trace(bm)
+        # ---- coverage over MY u-slots (the per-shard share of the
+        # static universe — no dense slice is ever materialized) ----
+        by = counts_by_slot(res.counts, eidx, u_max + 1)[:, :u_max]
+        cls = classify_counts(by)                    # [B, U_max]
+        simp = simplify_trace(by)
 
-        # ---- local novelty (vs my virgin slice) ----
-        inter = cls & vb[None, :]
-        new_count = jnp.any(inter != 0, axis=1)
-        new_tuple = jnp.any((cls != 0) & (vb[None, :] == 0xFF), axis=1)
+        # ---- local novelty (vs my virgin slice, gathered at my
+        # u-slots; padded columns read 0 = never novel) ----
+        vloc = jnp.where(u_loc < slice_size,
+                         vb[jnp.clip(u_loc, 0, slice_size - 1)],
+                         jnp.uint8(0))
+        new_count = jnp.any((cls & vloc[None, :]) != 0, axis=1)
+        new_tuple = jnp.any((cls != 0) & (vloc[None, :] == 0xFF),
+                            axis=1)
         local_ret = jnp.where(new_tuple, 2,
                               jnp.where(new_count, 1, 0)).astype(jnp.int32)
-        # a lane is new if ANY map slice saw novelty: max over mp
+        # a lane is new if ANY map shard saw novelty: max over mp
         rets = jax.lax.pmax(local_ret, "mp")
 
-        # in-batch dedup by full-map hash: slice hashes combined by psum
-        slice_hash = hash_bitmaps(cls)
+        # in-batch dedup by full-map hash: shard hashes combined by psum
+        slice_hash = stream_hash(cls.astype(jnp.uint32))
         full_hash = jax.lax.psum(slice_hash, "mp")
         # first occurrence within my dp shard's batch
         same = full_hash[:, None] == full_hash[None, :]
@@ -160,18 +190,28 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         first = ~jnp.any(same & earlier, axis=1)
         rets = jnp.where(first, rets, 0)
 
-        # ---- virgin updates: clear my slice with new lanes' bits ----
+        # ---- virgin updates: clear my slice with new lanes' bits
+        # (scatter at my u-slots; crash/hang maps also clear the
+        # constant outside-universe class-1 pattern — dense
+        # simplify_trace parity) ----
         def fold_new(traces, active):
-            seen = jax.lax.reduce(
+            return jax.lax.reduce(
                 jnp.where(active[:, None], traces, jnp.uint8(0)),
                 jnp.uint8(0), jax.lax.bitwise_or, dimensions=(0,))
-            return seen
 
-        vb2 = vb & ~fold_new(cls, rets > 0)
+        def clear(virgin, seen_u, outside_mask):
+            cur = virgin[jnp.clip(u_loc, 0, slice_size - 1)]
+            out = virgin & ~outside_mask
+            return out.at[u_loc].set(cur & ~seen_u, mode="drop")
+
         crash = statuses == FUZZ_CRASH
         hang = statuses == FUZZ_HANG
-        vc2 = vc & ~fold_new(simp, crash)
-        vh2 = vh & ~fold_new(simp, hang)
+        zero_out = jnp.zeros_like(outside)
+        vb2 = clear(vb, fold_new(cls, rets > 0), zero_out)
+        vc2 = clear(vc, fold_new(simp, crash),
+                    jnp.where(jnp.any(crash), outside, zero_out))
+        vh2 = clear(vh, fold_new(simp, hang),
+                    jnp.where(jnp.any(hang), outside, zero_out))
 
         # ---- union across dp (the per-step "merger") ----
         vb2 = _gather_and_fold(vb2, "dp")

@@ -284,6 +284,11 @@ def main():
     emit("4b", "flagship tlvstack_vm, xla engine", vx,
          baseline=FORKSERVER_BASELINE)
 
+    vi, _ = bench_device_fused("imgparse_vm", 16384, 20,
+                               targets_cgc.imgparse_vm_seed())
+    emit("4c", "imgparse_vm (chunked-format CGC target, fused pallas)",
+         vi, baseline=FORKSERVER_BASELINE)
+
     # headline LAST: the CGC-grade flagship with mutation AND
     # execution fused into one Pallas kernel (falls back to the XLA
     # engine number if the kernel won't compile in this environment)

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import json
 from functools import partial
-from typing import List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +42,25 @@ from .factory import register_instrumentation
 
 TIP_SEED = np.uint32(0x1994C9A5)  # control-flow-target stream hash
 TNT_SEED = np.uint32(0x7E57ED01)  # branch-outcome stream hash
+
+
+@partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges"))
+def _ipt_step_fast(instrs, edge_table, inputs, lengths, mem_size,
+                   max_steps, n_edges):
+    """Unfiltered (the default) trace-hash step WITHOUT materializing
+    edge streams: the VM's in-loop path hash is the order-sensitive
+    component (the reference's TIP stream role) and a positional hash
+    of the static-edge counts is the multiset component (the TNT
+    role) — together a 64-bit path identity, matching the reference's
+    XXH64 pair width (linux_ipt_instrumentation.c:419-425)."""
+    from ..ops.sparse_coverage import stream_hash
+    res = _run_batch_impl(instrs, edge_table, inputs, lengths, mem_size,
+                          max_steps, n_edges, False)
+    statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
+                         res.status)
+    tip = res.path_hash
+    tnt = stream_hash(res.counts.astype(jnp.uint32))
+    return statuses, res.exit_code, tip, tnt
 
 
 @partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges"))
@@ -101,6 +120,9 @@ class IptInstrumentation(Instrumentation):
             "for host targets")
         self._instrs = jnp.asarray(prog.instrs)
         self._edge_table = jnp.asarray(prog.edge_table)
+        # no filters configured (the default) = whole-trace hashing,
+        # which the engines compute in-loop — no stream materialized
+        self._unfiltered = not self.options.get("filters")
         filters = self.options.get("filters") or [[0, (1 << 31) - 1]]
         filt = np.asarray(filters, dtype=np.int32)
         if filt.ndim != 2 or filt.shape[1] != 2:
@@ -119,11 +141,17 @@ class IptInstrumentation(Instrumentation):
     def run_batch(self, inputs, lengths) -> BatchResult:
         inputs = jnp.asarray(inputs, dtype=jnp.uint8)
         lengths = jnp.asarray(lengths, dtype=jnp.int32)
-        statuses, exit_codes, tip, tnt = _ipt_step(
-            self._instrs, self._edge_table,
-            inputs, lengths, self._filt_lo, self._filt_hi,
-            self.program.mem_size, self.program.max_steps,
-            self.program.n_edges)
+        if self._unfiltered:
+            statuses, exit_codes, tip, tnt = _ipt_step_fast(
+                self._instrs, self._edge_table, inputs, lengths,
+                self.program.mem_size, self.program.max_steps,
+                self.program.n_edges)
+        else:
+            statuses, exit_codes, tip, tnt = _ipt_step(
+                self._instrs, self._edge_table,
+                inputs, lengths, self._filt_lo, self._filt_hi,
+                self.program.mem_size, self.program.max_steps,
+                self.program.n_edges)
         statuses = np.asarray(statuses)
         tip = np.asarray(tip, dtype=np.uint64)
         tnt = np.asarray(tnt, dtype=np.uint64)
@@ -185,10 +213,26 @@ class IptInstrumentation(Instrumentation):
     def _load(items: List[str]) -> Set[int]:
         return {int(h, 16) for h in items}
 
+    @property
+    def _hash_scheme(self) -> str:
+        """Hash-space identity: fast (in-loop path hash + counts
+        hash) and filtered (murmur over the windowed stream) pairs
+        are DIFFERENT 64-bit spaces — states only union within one."""
+        return "path+counts" if self._unfiltered else "stream"
+
+    def _check_scheme(self, d: Dict) -> None:
+        theirs = d.get("hash_scheme", "stream")
+        if theirs != self._hash_scheme:
+            raise ValueError(
+                f"state hashes are {theirs!r} but this instance uses "
+                f"{self._hash_scheme!r} (filters change the hash "
+                "space); merge only like-configured states")
+
     def get_state(self) -> str:
         return json.dumps({
             "instrumentation": self.name,
             "target": self.program.name,
+            "hash_scheme": self._hash_scheme,
             "total_execs": self.total_execs,
             "hashes": self._dump(self.hashes),
             "crash_hashes": self._dump(self.crash_hashes),
@@ -201,6 +245,7 @@ class IptInstrumentation(Instrumentation):
             raise ValueError(
                 f"state is for {d.get('instrumentation')!r}, not "
                 f"{self.name!r}")
+        self._check_scheme(d)
         self.total_execs = int(d.get("total_execs", 0))
         self.hashes = self._load(d.get("hashes", []))
         self.crash_hashes = self._load(d.get("crash_hashes", []))
@@ -208,6 +253,7 @@ class IptInstrumentation(Instrumentation):
 
     def merge(self, other_state: str) -> None:
         d = json.loads(other_state)
+        self._check_scheme(d)
         self.hashes |= self._load(d.get("hashes", []))
         self.crash_hashes |= self._load(d.get("crash_hashes", []))
         self.hang_hashes |= self._load(d.get("hang_hashes", []))

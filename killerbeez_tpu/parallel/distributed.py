@@ -35,7 +35,7 @@ from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING, MAP_SIZE
 from ..models.vm import Program, _run_batch_impl
 from ..ops.coverage import classify_counts, simplify_trace
 from ..ops.mutate_core import havoc_at
-from ..ops.sparse_coverage import stream_hash
+from ..ops.sparse_coverage import first_occurrence, stream_hash
 from ..ops.static_triage import counts_by_slot, make_static_maps
 
 
@@ -180,14 +180,14 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         # a lane is new if ANY map shard saw novelty: max over mp
         rets = jax.lax.pmax(local_ret, "mp")
 
-        # in-batch dedup by full-map hash: shard hashes combined by psum
+        # in-batch dedup by full-map hash: shard hashes combined by
+        # psum; first occurrence within my dp shard's batch (sort-
+        # based — the pairwise matrix is O(B^2) and dominates beyond
+        # B~8k, sparse_coverage.first_occurrence)
         slice_hash = stream_hash(cls.astype(jnp.uint32))
         full_hash = jax.lax.psum(slice_hash, "mp")
-        # first occurrence within my dp shard's batch
-        same = full_hash[:, None] == full_hash[None, :]
-        earlier = jnp.tril(
-            jnp.ones((batch_per_device,) * 2, dtype=bool), k=-1)
-        first = ~jnp.any(same & earlier, axis=1)
+        first = first_occurrence(
+            full_hash, jnp.ones((batch_per_device,), bool))
         rets = jnp.where(first, rets, 0)
 
         # ---- virgin updates: clear my slice with new lanes' bits

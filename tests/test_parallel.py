@@ -80,3 +80,60 @@ def test_mp_must_divide_map():
     mesh = make_mesh(2, 3)
     with pytest.raises(ValueError, match="divide"):
         make_sharded_fuzz_step(prog, mesh, 8, 16)
+
+
+def test_sharded_triage_matches_single_chip_reference():
+    """The mp-sharded u-space triage must produce EXACTLY the virgin
+    maps the single-chip static_triage path produces for the same
+    candidate stream — a systematic sharding deviation would otherwise
+    pass the mesh-shape-invariance test (which only compares the
+    sharded code against itself)."""
+    from killerbeez_tpu import FUZZ_HANG, FUZZ_RUNNING
+    from killerbeez_tpu.models.vm import _run_batch_impl
+    from killerbeez_tpu.ops.mutate_core import havoc_at
+    from killerbeez_tpu.ops.static_triage import (
+        make_static_maps, static_triage,
+    )
+
+    prog = targets.get_target("cgc_like")
+    n_steps, bpd, n_dp, n_mp = 4, 16, 4, 2
+    B = bpd * n_dp
+
+    # sharded run
+    mesh = make_mesh(n_dp, n_mp)
+    step = make_sharded_fuzz_step(prog, mesh, batch_per_device=bpd,
+                                  max_len=16)
+    state = sharded_state_init(mesh, prog.map_size)
+    sb, sl = seed_arrays()
+    for it in range(n_steps):
+        state, *_ = step(state, sb, sl, jnp.int32(it))
+
+    # single-chip reference over the identical candidate stream (the
+    # sharded step's global-lane PRNG) with static_triage
+    ins = jnp.asarray(prog.instrs)
+    tbl = jnp.asarray(prog.edge_table)
+    u_np, s_np = make_static_maps(prog.edge_slot)
+    u_slots, seg_id = jnp.asarray(u_np), jnp.asarray(s_np)
+    vb = vc = vh = jnp.full((prog.map_size,), 0xFF, jnp.uint8)
+    base = jax.random.key(0)
+    for it in range(n_steps):
+        keys = jax.vmap(
+            lambda l: jax.random.fold_in(
+                jax.random.fold_in(base, jnp.uint32(it)), l)
+        )(jnp.arange(B, dtype=jnp.uint32))
+        bufs, lens = jax.vmap(
+            lambda k: havoc_at(sb, sl, k, stack_pow2=4))(keys)
+        res = _run_batch_impl(ins, tbl, bufs, lens, prog.mem_size,
+                              prog.max_steps, prog.n_edges, False)
+        statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
+                             res.status)
+        _, _, _, vb, vc, vh = static_triage(
+            vb, vc, vh, res.counts, u_slots, seg_id,
+            statuses == FUZZ_CRASH, statuses == FUZZ_HANG)
+
+    np.testing.assert_array_equal(np.asarray(state.virgin_bits),
+                                  np.asarray(vb))
+    np.testing.assert_array_equal(np.asarray(state.virgin_crash),
+                                  np.asarray(vc))
+    np.testing.assert_array_equal(np.asarray(state.virgin_tmout),
+                                  np.asarray(vh))

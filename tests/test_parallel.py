@@ -137,3 +137,25 @@ def test_sharded_triage_matches_single_chip_reference():
                                   np.asarray(vc))
     np.testing.assert_array_equal(np.asarray(state.virgin_tmout),
                                   np.asarray(vh))
+
+
+def test_sharded_step_multimodule_program():
+    """Multi-module programs (libtest: 2 x 64KB slot spaces) shard
+    over mp like any other map size; library-module novelty must be
+    visible in the merged virgin maps."""
+    from killerbeez_tpu import MAP_SIZE as ONE_MAP
+    prog = targets.get_target("libtest")
+    mesh = make_mesh(4, 2)
+    step = make_sharded_fuzz_step(prog, mesh, batch_per_device=16,
+                                  max_len=8)
+    state = sharded_state_init(mesh, prog.map_size)
+    sb, sl = seed_arrays(seed=b"LXLX", L=8)
+    for it in range(4):
+        state, statuses, rets, bufs, lens = step(
+            state, sb, sl, jnp.int32(it))
+    vb = np.asarray(state.virgin_bits)
+    assert vb.shape == (2 * ONE_MAP,)
+    # both the main module's and the library module's slot spaces saw
+    # coverage (havoc around an 'LX' seed hits both)
+    assert (vb[:ONE_MAP] != 0xFF).sum() > 0
+    assert (vb[ONE_MAP:] != 0xFF).sum() > 0

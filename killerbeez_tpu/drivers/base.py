@@ -84,6 +84,14 @@ class Driver:
         return self.test_input(buf)
 
     def get_last_input(self) -> Optional[bytes]:
+        if self.last_input is None and \
+                getattr(self, "_last_batch_tail", None) is not None:
+            bufs, lens, i = self._last_batch_tail
+            # slice FIRST (on device for lazy arrays) so only one row
+            # transfers; drop the reference so the batch isn't pinned
+            self.last_input = np.asarray(
+                bufs[i, :int(lens[i])]).tobytes()
+            self._last_batch_tail = None
         return self.last_input
 
     # -- batched --------------------------------------------------------
@@ -102,10 +110,16 @@ class Driver:
         bufs, lens = self.mutator.mutate_batch(n)
         if self.instrumentation.device_backed:
             if pad_to is not None and pad_to > n:
+                # keep lazy device arrays lazy (np.concatenate would
+                # sync and bounce them through the host)
+                if isinstance(bufs, np.ndarray):
+                    xp = np
+                else:
+                    import jax.numpy as xp
                 pad = pad_to - n
-                bufs = np.concatenate(
-                    [bufs, np.repeat(bufs[:1], pad, axis=0)], axis=0)
-                lens = np.concatenate([lens, np.repeat(lens[:1], pad)])
+                bufs = xp.concatenate(
+                    [bufs, xp.repeat(bufs[:1], pad, axis=0)], axis=0)
+                lens = xp.concatenate([lens, xp.repeat(lens[:1], pad)])
             result = self.instrumentation.run_batch(bufs, lens)
         else:
             # idempotent per target key; re-binds if a single exec
@@ -114,7 +128,11 @@ class Driver:
             result = self.instrumentation.run_batch(bufs, lens,
                                                     pad_to=pad_to)
         if n > 0:
-            self.last_input = bufs[n - 1, :int(lens[n - 1])].tobytes()
+            # defer materialization (get_last_input slices on demand):
+            # .tobytes() here would sync the host to this batch and
+            # break the loop's one-batch pipeline
+            self._last_batch_tail = (bufs, lens, n - 1)
+            self.last_input = None
         return BatchOutcome(result=result, inputs=bufs, lengths=lens)
 
     def cleanup(self) -> None:

@@ -59,6 +59,8 @@ class PacketDriver(Driver):
             seqs = self.mutator.mutate_batch_parts(n)
         else:
             bufs, lens = self.mutator.mutate_batch(n)
+            # one bulk transfer, not 2n per-lane device round trips
+            bufs, lens = np.asarray(bufs), np.asarray(lens)
             seqs = [[bufs[j, :int(lens[j])].tobytes()] for j in range(n)]
         instr = self.instrumentation
         total = pad_to if (pad_to is not None and pad_to > n) else n

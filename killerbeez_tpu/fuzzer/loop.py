@@ -163,31 +163,58 @@ class Fuzzer:
             return 2**62 - self.stats.iterations
         return n_iterations - self.stats.iterations
 
-    def _run_batched(self, n_iterations: int) -> None:
-        mut = self.driver.mutator
-        while True:
-            room = min(self._remaining(n_iterations), mut.remaining(),
-                       self.batch_size)
-            if room <= 0:
-                break
-            # a smaller tail batch would change tensor shapes and force
-            # a full XLA recompile; the driver pads to batch_size with
-            # duplicate lanes (coverage no-ops) and we triage only the
-            # first `room` real lanes
-            out = self.driver.test_batch(room, pad_to=self.batch_size)
-            self.stats.iterations += room
-            res = out.result
-            interesting = np.flatnonzero(
-                (res.statuses[:room] != FUZZ_NONE)
-                | (res.new_paths[:room] > 0))
+    def _triage_batch(self, out, room: int, done_through: int) -> None:
+        """``done_through`` is the global iteration count as of THIS
+        batch — with pipelining, stats.iterations runs ahead of the
+        batch being triaged, so logs must not read it."""
+        res = out.result
+        statuses = np.asarray(res.statuses)
+        new_paths = np.asarray(res.new_paths)
+        interesting = np.flatnonzero(
+            (statuses[:room] != FUZZ_NONE) | (new_paths[:room] > 0))
+        if len(interesting):
+            inputs = np.asarray(out.inputs)
+            lengths = np.asarray(out.lengths)
+            uc = np.asarray(res.unique_crashes)
+            uh = np.asarray(res.unique_hangs)
             for i in interesting:
-                buf = out.inputs[i, :int(out.lengths[i])].tobytes()
-                self._triage_lane(int(res.statuses[i]),
-                                  int(res.new_paths[i]), buf,
-                                  bool(res.unique_crashes[i]),
-                                  bool(res.unique_hangs[i]))
-            DEBUG_MSG("batch done: %d iterations total",
-                      self.stats.iterations)
+                buf = inputs[i, :int(lengths[i])].tobytes()
+                self._triage_lane(int(statuses[i]), int(new_paths[i]),
+                                  buf, bool(uc[i]), bool(uh[i]))
+        DEBUG_MSG("batch done: %d iterations total", done_through)
+
+    # batches kept in flight before results are pulled to the host:
+    # device backends return LAZY arrays, so later batches' work is
+    # enqueued before earlier results transfer — dispatch/transfer
+    # latency (severe over remote-tunnel devices) overlaps compute
+    # (SURVEY hard part: "double-buffer batches, async dispatch")
+    PIPELINE_DEPTH = 4
+
+    def _run_batched(self, n_iterations: int) -> None:
+        from collections import deque
+        mut = self.driver.mutator
+        pending: "deque" = deque()
+        try:
+            while True:
+                room = min(self._remaining(n_iterations),
+                           mut.remaining(), self.batch_size)
+                if room <= 0:
+                    break
+                # a smaller tail batch would change tensor shapes and
+                # force a full XLA recompile; the driver pads to
+                # batch_size with duplicate lanes (coverage no-ops)
+                # and we triage only the first `room` real lanes
+                out = self.driver.test_batch(room,
+                                             pad_to=self.batch_size)
+                self.stats.iterations += room
+                pending.append((out, room, self.stats.iterations))
+                if len(pending) >= self.PIPELINE_DEPTH:
+                    self._triage_batch(*pending.popleft())
+        finally:
+            # findings in already-executed batches must survive an
+            # interrupt (Ctrl-C on an infinite run) or a raise
+            while pending:
+                self._triage_batch(*pending.popleft())
 
     def _run_single(self, n_iterations: int) -> None:
         instr = self.driver.instrumentation

@@ -183,7 +183,8 @@ class JitHarnessInstrumentation(Instrumentation):
     # -- batched --------------------------------------------------------
 
     def run_batch(self, inputs, lengths) -> BatchResult:
-        b = int(np.asarray(inputs).shape[0])
+        b = int(inputs.shape[0])    # no np.asarray: would sync lazy
+                                    # device inputs to host
         if self.exact and b > EXACT_BATCH_GATE and not self._gate_warned:
             self._gate_warned = True
             if self._novelty_explicit:
@@ -212,12 +213,15 @@ class JitHarnessInstrumentation(Instrumentation):
         self.total_execs += int(inputs.shape[0])
         if self.options.get("edges"):
             self._last_counts = np.asarray(counts)
+        # LAZY device arrays: forcing them here would sync the host to
+        # this batch; the fuzzer loop pipelines one batch ahead and
+        # materializes results when it triages
         return BatchResult(
-            statuses=np.asarray(statuses),
-            new_paths=np.asarray(new_paths),
-            unique_crashes=np.asarray(uc),
-            unique_hangs=np.asarray(uh),
-            exit_codes=np.asarray(exit_codes),
+            statuses=statuses,
+            new_paths=new_paths,
+            unique_crashes=uc,
+            unique_hangs=uh,
+            exit_codes=exit_codes,
         )
 
     # -- single-exec shim ----------------------------------------------

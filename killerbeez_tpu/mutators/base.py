@@ -132,8 +132,15 @@ class Mutator:
         its = np.arange(self.iteration, self.iteration + n, dtype=np.int64)
         bufs, lens = self._generate(its)
         self.iteration += n
-        return np.asarray(bufs, dtype=np.uint8), np.asarray(
-            lens, dtype=np.int32)
+        if isinstance(bufs, np.ndarray):
+            return (np.asarray(bufs, dtype=np.uint8),
+                    np.asarray(lens, dtype=np.int32))
+        # device-generated candidates stay device arrays: forcing them
+        # to numpy here would sync the host every batch AND bounce the
+        # tensors device->host->device on their way to a device-backed
+        # instrumentation
+        import jax.numpy as jnp
+        return bufs.astype(jnp.uint8), lens.astype(jnp.int32)
 
     def mutate(self, max_size: Optional[int] = None) -> Optional[bytes]:
         """Single-buffer API: next candidate, or None when exhausted."""

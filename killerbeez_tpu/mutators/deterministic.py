@@ -55,7 +55,7 @@ class BitFlipMutator(Mutator):
         bufs, lens = self._fn(jnp.asarray(self.seed_buf),
                               jnp.int32(self.seed_len),
                               jnp.asarray(its, dtype=jnp.int32))
-        return np.asarray(bufs), np.asarray(lens)
+        return bufs, lens  # device arrays: base keeps them lazy
 
 
 class ArithmeticMutator(Mutator):
@@ -74,7 +74,7 @@ class ArithmeticMutator(Mutator):
         bufs, lens = self._fn(jnp.asarray(self.seed_buf),
                               jnp.int32(self.seed_len),
                               jnp.asarray(its, dtype=jnp.int32))
-        return np.asarray(bufs), np.asarray(lens)
+        return bufs, lens  # device arrays: base keeps them lazy
 
 
 class InterestingValueMutator(Mutator):
@@ -93,7 +93,7 @@ class InterestingValueMutator(Mutator):
         bufs, lens = self._fn(jnp.asarray(self.seed_buf),
                               jnp.int32(self.seed_len),
                               jnp.asarray(its, dtype=jnp.int32))
-        return np.asarray(bufs), np.asarray(lens)
+        return bufs, lens  # device arrays: base keeps them lazy
 
 
 class DictionaryMutator(Mutator):
@@ -143,4 +143,4 @@ class DictionaryMutator(Mutator):
                               jnp.asarray(its, dtype=jnp.int32),
                               jnp.asarray(self.tokens),
                               jnp.asarray(self.token_lens))
-        return np.asarray(bufs), np.asarray(lens)
+        return bufs, lens  # device arrays: base keeps them lazy

@@ -140,6 +140,7 @@ class ManagerMutator(Mutator):
         for i, child in enumerate(self.children):
             if counts[i]:
                 bufs, lens = child.mutate_batch(counts[i])
+                bufs, lens = np.asarray(bufs), np.asarray(lens)
                 child_out[i] = [bufs[j, :int(lens[j])].tobytes()
                                 for j in range(counts[i])]
         used = [0] * nc

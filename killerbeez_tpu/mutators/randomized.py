@@ -48,7 +48,7 @@ class HavocMutator(_KeyedMutator):
     def _generate(self, its):
         bufs, lens = self._fn(jnp.asarray(self.seed_buf),
                               jnp.int32(self.seed_len), self._keys(its))
-        return np.asarray(bufs), np.asarray(lens)
+        return bufs, lens  # device arrays: base keeps them lazy
 
 
 class ZzufMutator(_KeyedMutator):
@@ -71,7 +71,7 @@ class ZzufMutator(_KeyedMutator):
     def _generate(self, its):
         bufs, lens = self._fn(jnp.asarray(self.seed_buf),
                               jnp.int32(self.seed_len), self._keys(its))
-        return np.asarray(bufs), np.asarray(lens)
+        return bufs, lens  # device arrays: base keeps them lazy
 
 
 class NiMutator(_KeyedMutator):
@@ -112,7 +112,7 @@ class NiMutator(_KeyedMutator):
     def _generate(self, its):
         bufs, lens = self._fn(jnp.asarray(self.seed_buf),
                               jnp.int32(self.seed_len), self._keys(its))
-        return np.asarray(bufs), np.asarray(lens)
+        return bufs, lens  # device arrays: base keeps them lazy
 
 
 class HonggfuzzMutator(_KeyedMutator):
@@ -134,7 +134,7 @@ class HonggfuzzMutator(_KeyedMutator):
     def _generate(self, its):
         bufs, lens = self._fn(jnp.asarray(self.seed_buf),
                               jnp.int32(self.seed_len), self._keys(its))
-        return np.asarray(bufs), np.asarray(lens)
+        return bufs, lens  # device arrays: base keeps them lazy
 
 
 class SpliceMutator(_KeyedMutator):
@@ -186,4 +186,4 @@ class SpliceMutator(_KeyedMutator):
                               jnp.asarray(self.partners),
                               jnp.asarray(self.partner_lens),
                               self._keys(its))
-        return np.asarray(bufs), np.asarray(lens)
+        return bufs, lens  # device arrays: base keeps them lazy

@@ -329,3 +329,28 @@ def test_afl_padding_sentinel(corpus_bin):
     assert (res.new_paths[3:] == 0).all()
     assert instr.total_execs == 3              # padding cost nothing
     instr.cleanup()
+
+
+def test_pipeline_drains_findings_on_error(tmp_path):
+    """The loop keeps batches in flight; findings from already-
+    executed batches must survive a mid-run failure (the drain runs
+    in a finally block)."""
+    fz, instr, _ = make_fuzzer(tmp_path, mutator="havoc",
+                               mopts='{"seed": 1}', batch=8)
+    orig = fz.driver.test_batch
+    calls = {"n": 0}
+
+    def flaky(room, pad_to=None):
+        calls["n"] += 1
+        if calls["n"] > 3:
+            raise RuntimeError("injected failure")
+        return orig(room, pad_to=pad_to)
+
+    fz.driver.test_batch = flaky
+    with pytest.raises(RuntimeError, match="injected"):
+        fz.run(1024)
+    # batches 1-3 executed before the failure: their findings (the
+    # ABCD crash falls out of havoc on an 8-byte seed quickly, and
+    # new paths always appear in batch 1) must be on disk
+    assert fz.stats.new_paths > 0
+    assert os.listdir(tmp_path / "output" / "new_paths")

@@ -25,6 +25,9 @@ class BatchOutcome(NamedTuple):
     result: BatchResult
     inputs: np.ndarray    # uint8[B, L]
     lengths: np.ndarray   # int32[B]
+    #: device-compacted interesting-lane report (fused path only) —
+    #: lets triage skip the full inputs transfer on slow links
+    compact: Optional[Any] = None
 
 
 class Driver:
@@ -107,6 +110,22 @@ class Driver:
         fork+exec). Callers triage only the first ``n`` lanes."""
         if not self.supports_batch:
             raise RuntimeError(f"{self.name}: batch path unavailable")
+        wants_fused = getattr(self.instrumentation, "wants_fused", None)
+        if (self.instrumentation.device_backed and wants_fused is not None
+                and wants_fused(self.mutator)):
+            # fused mutate+execute: the instrumentation generates the
+            # mutator's lanes inside the VM kernel (bit-identical
+            # candidates, no HBM round-trip between mutate and exec)
+            its = self.mutator.peek_iterations(n)
+            result, bufs, lens, compact = \
+                self.instrumentation.run_batch_fused(
+                    self.mutator, its, pad_to=pad_to)
+            self.mutator.advance(n)
+            if n > 0:
+                self._last_batch_tail = (bufs, lens, n - 1)
+                self.last_input = None
+            return BatchOutcome(result=result, inputs=bufs,
+                                lengths=lens, compact=compact)
         bufs, lens = self.mutator.mutate_batch(n)
         if self.instrumentation.device_backed:
             if pad_to is not None and pad_to > n:

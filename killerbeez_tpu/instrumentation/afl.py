@@ -34,6 +34,7 @@ from ..ops.coverage import (
     COUNT_CLASS_LOOKUP, classify_counts, count_non_255_bytes,
     merge_virgin, simplify_trace,
 )
+from ..utils.logging import WARNING_MSG
 from ..utils.serialization import decode_array, encode_array
 from .base import BatchResult, Instrumentation, module_slice_edges
 from .factory import register_instrumentation
@@ -183,6 +184,13 @@ class AflInstrumentation(Instrumentation):
             self._target = ExecPool(argv, workers, **kwargs)
         else:
             # file delivery shares the driver's @@ path: single instance
+            if workers > 1:
+                WARNING_MSG(
+                    "afl: workers=%d requested but %s delivery forces "
+                    "a single target instance (each worker would need "
+                    "its own input file); running 1 instance — see "
+                    "docs/AFL.md", workers,
+                    "file" if not use_stdin else "explicit input_file")
             self._target = ExecTarget(argv, **kwargs)
         self._target_key = key
         return self._target

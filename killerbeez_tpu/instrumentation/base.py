@@ -33,6 +33,19 @@ class BatchResult(NamedTuple):
     exit_codes: np.ndarray    # int32[B]
 
 
+class CompactReport(NamedTuple):
+    """Device-side compaction of a batch's interesting lanes (crash /
+    hang / new path): the candidate bytes of up to ``capacity`` such
+    lanes, gathered IN the jitted step so triage never pulls the full
+    [B, L] tensor across a slow device->host link.  ``count`` is the
+    true number of interesting lanes — when it exceeds capacity the
+    consumer falls back to a full transfer for that batch."""
+    idx: np.ndarray           # int32[C] lane numbers (valid: first count)
+    bufs: np.ndarray          # uint8[C, L] candidate bytes of those lanes
+    lens: np.ndarray          # int32[C]
+    count: np.ndarray         # int32 scalar
+
+
 class Instrumentation:
     name = "base"
     OPTION_SCHEMA: Dict[str, type] = {}

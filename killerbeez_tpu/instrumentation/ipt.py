@@ -37,6 +37,7 @@ from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING
 from ..models import targets as targets_mod
 from ..models.vm import _run_batch_impl
 from ..ops.hashing import murmur3_32
+from ..utils.logging import WARNING_MSG
 from .base import BatchResult, Instrumentation
 from .factory import register_instrumentation
 
@@ -220,13 +221,20 @@ class IptInstrumentation(Instrumentation):
         are DIFFERENT 64-bit spaces — states only union within one."""
         return "path+counts" if self._unfiltered else "stream"
 
-    def _check_scheme(self, d: Dict) -> None:
+    def _check_scheme(self, d: Dict) -> bool:
+        """True when the state's hash space matches ours.  A mismatch
+        (including pre-0.2 states that carry no ``hash_scheme`` key)
+        is not an error: hashes from a different space are safely
+        discardable, so callers degrade to a fresh set with a warning
+        rather than breaking cross-version manager flows."""
         theirs = d.get("hash_scheme", "stream")
-        if theirs != self._hash_scheme:
-            raise ValueError(
-                f"state hashes are {theirs!r} but this instance uses "
-                f"{self._hash_scheme!r} (filters change the hash "
-                "space); merge only like-configured states")
+        if theirs == self._hash_scheme:
+            return True
+        WARNING_MSG(
+            "ipt state hashes are %r but this instance uses %r "
+            "(filters change the hash space) — discarding the foreign "
+            "hash sets and keeping counters", theirs, self._hash_scheme)
+        return False
 
     def get_state(self) -> str:
         return json.dumps({
@@ -245,15 +253,19 @@ class IptInstrumentation(Instrumentation):
             raise ValueError(
                 f"state is for {d.get('instrumentation')!r}, not "
                 f"{self.name!r}")
-        self._check_scheme(d)
+        same_space = self._check_scheme(d)
         self.total_execs = int(d.get("total_execs", 0))
-        self.hashes = self._load(d.get("hashes", []))
-        self.crash_hashes = self._load(d.get("crash_hashes", []))
-        self.hang_hashes = self._load(d.get("hang_hashes", []))
+        self.hashes = self._load(d.get("hashes", [])) if same_space \
+            else set()
+        self.crash_hashes = self._load(d.get("crash_hashes", [])) \
+            if same_space else set()
+        self.hang_hashes = self._load(d.get("hang_hashes", [])) \
+            if same_space else set()
 
     def merge(self, other_state: str) -> None:
         d = json.loads(other_state)
-        self._check_scheme(d)
+        if not self._check_scheme(d):
+            return
         self.hashes |= self._load(d.get("hashes", []))
         self.crash_hashes |= self._load(d.get("crash_hashes", []))
         self.hang_hashes |= self._load(d.get("hang_hashes", []))

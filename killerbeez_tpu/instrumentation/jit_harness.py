@@ -44,7 +44,9 @@ from ..ops.static_triage import (
 )
 from ..utils.logging import WARNING_MSG
 from ..utils.serialization import decode_array, encode_array
-from .base import BatchResult, Instrumentation, module_slice_edges
+from .base import (
+    BatchResult, CompactReport, Instrumentation, module_slice_edges,
+)
 from .factory import register_instrumentation
 
 # the sequential exact scan is O(B) serial passes; above this lane
@@ -70,6 +72,23 @@ def _triage_exact(vb, vc, vh, cls, simp, statuses):
     (vb2, vc2, vh2), (new_paths, uc, uh) = jax.lax.scan(
         step, (vb, vc, vh), (cls, simp, statuses))
     return new_paths, uc, uh, vb2, vc2, vh2
+
+
+def _triage_counts(counts, statuses, u_slots, seg_id, vb, vc, vh,
+                   exact):
+    """Shared triage tail: static-edge counts -> novelty verdicts +
+    virgin updates (exact = sequential dense parity scan)."""
+    if exact:
+        # dense parity path: expand the static universe back to the
+        # 64KB map shape and judge lanes sequentially
+        by_slot = counts_by_slot(counts, seg_id, u_slots.shape[0])
+        bitmap = expand_to_map(by_slot, u_slots, vb.shape[0])
+        cls = classify_counts(bitmap)
+        simp = simplify_trace(bitmap)
+        return _triage_exact(vb, vc, vh, cls, simp, statuses)
+    return static_triage(
+        vb, vc, vh, counts, u_slots, seg_id,
+        statuses == FUZZ_CRASH, statuses == FUZZ_HANG)
 
 
 @partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
@@ -104,21 +123,54 @@ def _fused_step(instrs, edge_table, u_slots, seg_id, inputs, lengths,
         res = _run_batch_impl(instrs, edge_table, inputs, lengths,
                               mem_size, max_steps, n_edges, False)
     statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG, res.status)
-    if exact:
-        # dense parity path: expand the static universe back to the
-        # 64KB map shape and judge lanes sequentially
-        by_slot = counts_by_slot(res.counts, seg_id, u_slots.shape[0])
-        bitmap = expand_to_map(by_slot, u_slots, vb.shape[0])
-        cls = classify_counts(bitmap)
-        simp = simplify_trace(bitmap)
-        new_paths, uc, uh, vb2, vc2, vh2 = _triage_exact(
-            vb, vc, vh, cls, simp, statuses)
-    else:
-        new_paths, uc, uh, vb2, vc2, vh2 = static_triage(
-            vb, vc, vh, res.counts, u_slots, seg_id,
-            statuses == FUZZ_CRASH, statuses == FUZZ_HANG)
+    new_paths, uc, uh, vb2, vc2, vh2 = _triage_counts(
+        res.counts, statuses, u_slots, seg_id, vb, vc, vh, exact)
     return (statuses, new_paths, uc, uh, res.exit_code, vb2, vc2, vh2,
             res.counts)
+
+
+# lanes the in-step compaction can report per batch; batches with
+# more interesting lanes than this fall back to a full-tensor pull
+COMPACT_CAP = 1024
+
+
+@partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
+                                   "exact", "stack_pow2"))
+def _fused_fuzz_step(instrs, edge_table, u_slots, seg_id, seed_buf,
+                     seed_len, base_key, its, n_real, vb, vc, vh,
+                     mem_size, max_steps, n_edges, exact, stack_pow2):
+    """The flagship product path: per-lane PRNG keys, havoc mutation
+    AND VM execution in one program (mutate+exec share a single
+    pallas_call, ops/vm_kernel.fuzz_batch_pallas) followed by
+    static-edge triage — candidates are born, run and judged without
+    leaving the device, and only verdicts + the mutant bytes (for
+    findings writing) come back.  Key derivation fold_in(base_key,
+    it) happens IN the jit: eager per-batch vmap dispatches were
+    measured at ~25ms host time each on a tunneled device.  ``its``
+    length must already be a LANE_TILE multiple (run_batch_fused
+    pads)."""
+    from ..ops.vm_kernel import fuzz_batch_pallas, havoc_words_for_keys
+    keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(its)
+    words = havoc_words_for_keys(keys, stack_pow2)
+    res, bufs, lens = fuzz_batch_pallas(
+        instrs, edge_table, seed_buf, seed_len, words, mem_size,
+        max_steps, n_edges, stack_pow2=stack_pow2)
+    statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG, res.status)
+    new_paths, uc, uh, vb2, vc2, vh2 = _triage_counts(
+        res.counts, statuses, u_slots, seg_id, vb, vc, vh, exact)
+    # in-step compaction: gather the interesting lanes' candidate
+    # bytes here so the host reads a ~COMPACT_CAP-row report instead
+    # of the full [B, L] tensor (padded lanes >= n_real excluded)
+    b = bufs.shape[0]
+    flags = ((statuses != FUZZ_NONE) | (new_paths > 0)) & \
+        (jnp.arange(b) < n_real)
+    (sel_idx,) = jnp.nonzero(flags, size=COMPACT_CAP, fill_value=0)
+    sel_bufs = jnp.take(bufs, sel_idx, axis=0)
+    sel_lens = jnp.take(lens, sel_idx)
+    count = jnp.sum(flags).astype(jnp.int32)
+    return (statuses, new_paths, uc, uh, res.exit_code, vb2, vc2, vh2,
+            res.counts, bufs, lens,
+            (sel_idx.astype(jnp.int32), sel_bufs, sel_lens, count))
 
 
 @register_instrumentation
@@ -137,8 +189,10 @@ class JitHarnessInstrumentation(Instrumentation):
                    'auto-switches to throughput above 1024-lane '
                    'batches) or "throughput"',
         "edges": "1 = record per-exec edge lists (tracer mode)",
-        "engine": '"xla" (default) or "pallas" (VMEM-resident VM '
-                  "kernel, ~4x on chip)",
+        "engine": '"xla" (default), "pallas" (VMEM-resident VM '
+                  'kernel, ~4x on chip) or "pallas_fused" (mutation '
+                  "AND execution in one kernel — requires a fusable "
+                  "mutator like havoc; the flagship path)",
     }
     DEFAULTS = {"novelty": "exact", "edges": 0, "engine": "xla"}
 
@@ -150,9 +204,12 @@ class JitHarnessInstrumentation(Instrumentation):
             '{"program_file": path}')
         if self.options["novelty"] not in ("exact", "throughput"):
             raise ValueError('novelty must be "exact" or "throughput"')
-        if self.options["engine"] not in ("xla", "pallas"):
-            raise ValueError('engine must be "xla" or "pallas"')
+        if self.options["engine"] not in ("xla", "pallas",
+                                          "pallas_fused"):
+            raise ValueError(
+                'engine must be "xla", "pallas" or "pallas_fused"')
         self.engine = self.options["engine"]
+        self._fuse_warned = False
         self.exact = self.options["novelty"] == "exact"
         # whether the user ASKED for exact (vs inheriting the default):
         # the default flips to throughput above EXACT_BATCH_GATE lanes,
@@ -182,24 +239,36 @@ class JitHarnessInstrumentation(Instrumentation):
 
     # -- batched --------------------------------------------------------
 
+    def _apply_exact_gate(self, b: int) -> None:
+        """Flip the DEFAULT novelty to throughput above the gate (an
+        explicit "exact" request is honored with a warning).  The flip
+        changes counts the persistence-mode way: within one batch all
+        lanes are judged against the incoming virgin maps, so several
+        lanes covering the same new path each count (over-report,
+        never under-report) — see docs/USAGE.md."""
+        if not (self.exact and b > EXACT_BATCH_GATE) or self._gate_warned:
+            return
+        self._gate_warned = True
+        if self._novelty_explicit:
+            WARNING_MSG(
+                "jit_harness: exact novelty judges lanes "
+                "sequentially — batch %d will be slow (parity "
+                "gates only; use \"novelty\": \"throughput\" for "
+                "fuzzing)", b)
+        else:
+            WARNING_MSG(
+                "jit_harness: batch %d > %d — switching default "
+                "novelty to \"throughput\": same-step duplicates of a "
+                "new path each count, inflating new-path totals the "
+                "way the reference's persistence mode does (pass "
+                "{\"novelty\": \"exact\"} to force the sequential "
+                "parity scan)", b, EXACT_BATCH_GATE)
+            self.exact = False
+
     def run_batch(self, inputs, lengths) -> BatchResult:
         b = int(inputs.shape[0])    # no np.asarray: would sync lazy
                                     # device inputs to host
-        if self.exact and b > EXACT_BATCH_GATE and not self._gate_warned:
-            self._gate_warned = True
-            if self._novelty_explicit:
-                WARNING_MSG(
-                    "jit_harness: exact novelty judges lanes "
-                    "sequentially — batch %d will be slow (parity "
-                    "gates only; use \"novelty\": \"throughput\" for "
-                    "fuzzing)", b)
-            else:
-                WARNING_MSG(
-                    "jit_harness: batch %d > %d — switching default "
-                    "novelty to \"throughput\" (pass {\"novelty\": "
-                    "\"exact\"} to force the sequential parity scan)",
-                    b, EXACT_BATCH_GATE)
-                self.exact = False
+        self._apply_exact_gate(b)
         inputs = jnp.asarray(inputs, dtype=jnp.uint8)
         lengths = jnp.asarray(lengths, dtype=jnp.int32)
         (statuses, new_paths, uc, uh, exit_codes, vb, vc, vh,
@@ -208,7 +277,7 @@ class JitHarnessInstrumentation(Instrumentation):
             inputs, lengths, self.virgin_bits,
             self.virgin_crash, self.virgin_tmout, self.program.mem_size,
             self.program.max_steps, self.program.n_edges, self.exact,
-            self.engine)
+            "pallas" if self.engine == "pallas_fused" else self.engine)
         self.virgin_bits, self.virgin_crash, self.virgin_tmout = vb, vc, vh
         self.total_execs += int(inputs.shape[0])
         if self.options.get("edges"):
@@ -223,6 +292,63 @@ class JitHarnessInstrumentation(Instrumentation):
             unique_hangs=uh,
             exit_codes=exit_codes,
         )
+
+    # -- fused mutate+execute (the flagship product path) ---------------
+
+    def wants_fused(self, mutator) -> bool:
+        """True when this instrumentation should drive the one-kernel
+        mutate+execute path for ``mutator`` (drivers consult this
+        before mutate_batch).  Any pallas engine auto-fuses with a
+        fusable mutator — the fused kernel consumes the mutator's OWN
+        per-lane keys, so candidates and verdicts are bit-identical
+        to the mutate-then-execute pipeline, just without the HBM
+        round-trip between the two."""
+        fusable = getattr(mutator, "fused_spec", None) is not None
+        if self.engine == "pallas_fused" and not fusable \
+                and not self._fuse_warned:
+            self._fuse_warned = True
+            WARNING_MSG(
+                "jit_harness: engine \"pallas_fused\" needs a fusable "
+                "mutator (havoc); %s mutates separately — running the "
+                "unfused pallas engine",
+                getattr(mutator, "name", type(mutator).__name__))
+        return self.engine in ("pallas", "pallas_fused") and fusable
+
+    def run_batch_fused(self, mutator, its, pad_to: Optional[int] = None
+                        ) -> Tuple[BatchResult, Any, Any, CompactReport]:
+        """Execute iterations ``its`` of ``mutator`` (havoc) with
+        mutation fused into the VM kernel.  Returns (BatchResult,
+        mutant bufs uint8[B, L], lens int32[B], CompactReport) — B is
+        ``its`` padded to a LANE_TILE multiple (>= pad_to) with
+        REPEATS OF LANE 0's iteration: the duplicate mutants are
+        coverage no-ops, exactly like the unfused path's lane-0
+        padding; callers triage only the first len(its) lanes."""
+        from ..ops.vm_kernel import LANE_TILE
+        n = len(its)
+        b = max(n, pad_to or 0)
+        b += (-b) % LANE_TILE
+        self._apply_exact_gate(b)
+        seed_buf, seed_len, base_key, stack_pow2 = mutator.fused_spec()
+        its = np.asarray(its, dtype=np.uint32)
+        if b > n:  # duplicate lane 0's iteration: coverage no-ops
+            its = np.concatenate([its, np.repeat(its[:1], b - n)])
+        (statuses, new_paths, uc, uh, exit_codes, vb, vc, vh, counts,
+         bufs, lens, compact) = _fused_fuzz_step(
+            self._instrs, self._edge_table, self._u_slots, self._seg_id,
+            jnp.asarray(seed_buf), jnp.int32(seed_len), base_key,
+            jnp.asarray(its), jnp.int32(n),
+            self.virgin_bits, self.virgin_crash, self.virgin_tmout,
+            self.program.mem_size, self.program.max_steps,
+            self.program.n_edges, self.exact, stack_pow2)
+        self.virgin_bits, self.virgin_crash, self.virgin_tmout = vb, vc, vh
+        self.total_execs += b
+        if self.options.get("edges"):
+            self._last_counts = np.asarray(counts)
+        # results stay LAZY (see run_batch): the fuzzer loop pipelines
+        return (BatchResult(
+            statuses=statuses, new_paths=new_paths, unique_crashes=uc,
+            unique_hangs=uh, exit_codes=exit_codes), bufs, lens,
+            CompactReport(*compact))
 
     # -- single-exec shim ----------------------------------------------
 

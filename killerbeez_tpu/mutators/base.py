@@ -119,17 +119,27 @@ class Mutator:
     # can't batch set this False; drivers consult it)
     batch_capable = True
 
-    def mutate_batch(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Generate the next ``n`` candidates and advance the walk.
-        Raises if a finite walk has fewer than ``n`` left — callers
-        clamp with ``remaining()``."""
+    def peek_iterations(self, n: int) -> np.ndarray:
+        """The next ``n`` absolute iteration indices WITHOUT advancing
+        — fused instrumentation paths generate these lanes themselves
+        and call ``advance(n)`` after the device step is enqueued."""
         if n <= 0:
             raise ValueError("batch size must be positive")
         if self.remaining() < n:
             raise ValueError(
                 f"{self.name}: only {self.remaining()} iterations left, "
                 f"requested {n}")
-        its = np.arange(self.iteration, self.iteration + n, dtype=np.int64)
+        return np.arange(self.iteration, self.iteration + n,
+                         dtype=np.int64)
+
+    def advance(self, n: int) -> None:
+        self.iteration += n
+
+    def mutate_batch(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Generate the next ``n`` candidates and advance the walk.
+        Raises if a finite walk has fewer than ``n`` left — callers
+        clamp with ``remaining()``."""
+        its = self.peek_iterations(n)
         bufs, lens = self._generate(its)
         self.iteration += n
         if isinstance(bufs, np.ndarray):

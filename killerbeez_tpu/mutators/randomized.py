@@ -50,6 +50,17 @@ class HavocMutator(_KeyedMutator):
                               jnp.int32(self.seed_len), self._keys(its))
         return bufs, lens  # device arrays: base keeps them lazy
 
+    def fused_spec(self):
+        """What a fused mutate+execute kernel needs to generate this
+        mutator's lanes itself: (seed_buf, seed_len, base PRNG key,
+        stack_pow2).  The kernel derives per-lane keys as
+        fold_in(base, absolute_iteration) — EXACTLY _keys — so fused
+        candidates are bit-identical to the mutate-then-execute
+        pipeline."""
+        base = jax.random.key(int(self.options.get("seed", 0)))
+        return (self.seed_buf, self.seed_len, base,
+                int(self.options["stack_pow2"]))
+
 
 class ZzufMutator(_KeyedMutator):
     """zzuf-style: flips each bit with probability ``ratio_bits``."""

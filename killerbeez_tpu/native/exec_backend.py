@@ -255,15 +255,34 @@ class ExecTarget:
 
     def module_table(self) -> List[str]:
         """Names of the modules that claimed map partitions (targets
-        run with KB_MODULES=1); index = submap number."""
+        run with KB_MODULES=1); index = submap number.  Warns once if
+        an entry's degraded-accounting flag (byte KB_MODTAB_NAME-1,
+        set by kb_rt on table overflow or truncated-name merge) shows
+        that its partition aliases multiple modules."""
         ptr = self._lib.kb_target_module_table(self._h)
         if not ptr:
             return []
         out = []
+        degraded = []
         for i in range(KB_N_MODULES):
-            name = ct.string_at(ptr + i * KB_MODTAB_NAME)
+            # bounded read: byte KB_MODTAB_NAME-1 is the flag, not
+            # part of the (always-NUL-terminated-within-width) name
+            name = ct.string_at(ptr + i * KB_MODTAB_NAME,
+                                KB_MODTAB_NAME - 1).split(b"\x00")[0]
             if name:
                 out.append(name.decode(errors="replace"))
+                flag = ct.string_at(
+                    ptr + i * KB_MODTAB_NAME + KB_MODTAB_NAME - 1, 1)
+                if flag != b"\x00":
+                    degraded.append(out[-1])
+        if degraded and not getattr(self, "_modtab_warned", False):
+            self._modtab_warned = True
+            from ..utils.logging import WARNING_MSG
+            WARNING_MSG(
+                "per-module coverage degraded: partition(s) %s alias "
+                "multiple modules (>%d kb-cc modules registered, or "
+                "basenames truncated at %d chars collided)",
+                degraded, KB_N_MODULES, KB_MODTAB_NAME - 1)
         return out
 
     def total_execs(self) -> int:

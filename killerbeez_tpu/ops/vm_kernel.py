@@ -64,11 +64,14 @@ def _chain(pairs, default):
 
 
 def _vm_loop(instrs_t, table_t, bufs, lengths, z,
-             mem_size, max_steps, n_edges):
+             mem_size, max_steps, n_edges, status0=None):
     """The VM step loop shared by the plain and fused kernels: takes
     lane-last [L, T] candidate bytes + [1, T] lengths, returns the
     final carry tuple.  ``z`` is a loaded [1, T] zeros row (see the
-    carry-layout note in state0)."""
+    carry-layout note in state0).  ``status0`` overrides the initial
+    per-lane status (two-phase scheduling marks already-finished
+    lanes FUZZ_NONE so their tiles exit the while-loop immediately);
+    it must be load-derived like everything else."""
     t = bufs.shape[1]
     ni = instrs_t.shape[1]
     nb = table_t.shape[0]
@@ -198,7 +201,7 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
               jnp.zeros((N_REGS, t), jnp.int32) + z,
               jnp.zeros((mem_size, t), jnp.int32) + z,
               z,
-              z + FUZZ_RUNNING,
+              (z + FUZZ_RUNNING) if status0 is None else status0,
               z,
               z,
               jnp.zeros((n_edges + 1, t), jnp.int32) + z,
@@ -226,13 +229,38 @@ def _vm_kernel(instrs_t_ref, table_t_ref, bufs_ref, lens_ref, zero_ref,
     hash_ref[...] = final[8]
 
 
+def _vm_kernel_skip(instrs_t_ref, table_t_ref, bufs_ref, lens_ref,
+                    skip_ref, zero_ref,
+                    status_ref, exit_ref, counts_ref, steps_ref,
+                    hash_ref, *, mem_size, max_steps, n_edges):
+    """_vm_kernel with a per-lane skip mask: skipped lanes start
+    FUZZ_NONE, so a tile of all-skipped lanes exits its while-loop
+    after zero iterations — the phase-2 half of two-phase scheduling
+    pays only for tiles that contain real survivors."""
+    instrs_t = instrs_t_ref[...].astype(jnp.float32)
+    table_t = table_t_ref[...].astype(jnp.float32)
+    skip = skip_ref[...]                                 # [1, T] 0/1
+    status0 = (1 - skip) * FUZZ_RUNNING + zero_ref[...]
+    final = _vm_loop(instrs_t, table_t, bufs_ref[...], lens_ref[...],
+                     zero_ref[...], mem_size, max_steps, n_edges,
+                     status0=status0)
+    status_ref[...] = final[4]
+    exit_ref[...] = final[5]
+    counts_ref[...] = final[7]
+    steps_ref[...] = final[10]
+    hash_ref[...] = final[8]
+
+
 @partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
                                    "interpret"))
 def run_batch_pallas(instrs, edge_table, inputs, lengths, mem_size,
-                     max_steps, n_edges, interpret=False) -> VMResult:
+                     max_steps, n_edges, interpret=False,
+                     skip=None) -> VMResult:
     """Pallas engine entry: same contract as vm._run_batch_impl with
     record_stream=False.  B must be a multiple of LANE_TILE (callers
-    pad; padded lanes are regular executions of duplicated inputs)."""
+    pad; padded lanes are regular executions of duplicated inputs).
+    ``skip`` (optional int32[B] 0/1) marks lanes to not execute at
+    all (status FUZZ_NONE, zero counts) — see _vm_kernel_skip."""
     b, L = inputs.shape
     if b % LANE_TILE:
         raise ValueError(f"batch {b} not a multiple of {LANE_TILE}")
@@ -243,8 +271,6 @@ def run_batch_pallas(instrs, edge_table, inputs, lengths, mem_size,
     lens = lengths.astype(jnp.int32).reshape(1, b)
     zeros = jnp.zeros((1, b), jnp.int32)         # carry-init source
 
-    kernel = partial(_vm_kernel, mem_size=mem_size,
-                     max_steps=max_steps, n_edges=n_edges)
     out_shapes = (
         jax.ShapeDtypeStruct((1, b), jnp.int32),          # status
         jax.ShapeDtypeStruct((1, b), jnp.int32),          # exit
@@ -254,16 +280,27 @@ def run_batch_pallas(instrs, edge_table, inputs, lengths, mem_size,
     )
     whole = lambda *_: (0, 0)  # noqa: E731 — replicate full array
     lane_block = lambda i: (0, i)  # noqa: E731
+    in_specs = [
+        pl.BlockSpec(instrs_t.shape, whole),
+        pl.BlockSpec(table_t.shape, whole),
+        pl.BlockSpec((L, LANE_TILE), lane_block),
+        pl.BlockSpec((1, LANE_TILE), lane_block),
+    ]
+    operands = [instrs_t, table_t, bufs_t, lens]
+    if skip is None:
+        kernel = partial(_vm_kernel, mem_size=mem_size,
+                         max_steps=max_steps, n_edges=n_edges)
+    else:
+        kernel = partial(_vm_kernel_skip, mem_size=mem_size,
+                         max_steps=max_steps, n_edges=n_edges)
+        in_specs.append(pl.BlockSpec((1, LANE_TILE), lane_block))
+        operands.append(skip.astype(jnp.int32).reshape(1, b))
+    in_specs.append(pl.BlockSpec((1, LANE_TILE), lane_block))
+    operands.append(zeros)
     status, exit_code, counts, steps, path_hash = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec(instrs_t.shape, whole),
-            pl.BlockSpec(table_t.shape, whole),
-            pl.BlockSpec((L, LANE_TILE), lane_block),
-            pl.BlockSpec((1, LANE_TILE), lane_block),
-            pl.BlockSpec((1, LANE_TILE), lane_block),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, LANE_TILE), lane_block),
             pl.BlockSpec((1, LANE_TILE), lane_block),
@@ -273,7 +310,7 @@ def run_batch_pallas(instrs, edge_table, inputs, lengths, mem_size,
         ),
         out_shape=out_shapes,
         interpret=interpret,
-    )(instrs_t, table_t, bufs_t, lens, zeros)
+    )(*operands)
     return VMResult(status=status.reshape(b),
                     exit_code=exit_code.reshape(b),
                     counts=counts.T.astype(jnp.uint8),
@@ -446,19 +483,29 @@ def _fuzz_kernel(instrs_t_ref, table_t_ref, seed_ref, lens_ref,
     lens_out_ref[...] = length
 
 
-def havoc_words(key, b, stack_pow2=4):
-    """The per-lane PRNG words the fused kernel consumes — generated
-    with EXACTLY havoc_at's keys/stream so fused mutants are
-    bit-identical to the mutate-then-execute pipeline.
+def havoc_words_for_keys(keys, stack_pow2=4):
+    """The per-lane PRNG words the fused kernel consumes, one column
+    per key — generated with EXACTLY havoc_at's stream (one
+    ``jax.random.bits(key, (n_steps+1, 8))`` draw per lane) so fused
+    mutants are bit-identical to the mutate-then-execute pipeline for
+    the SAME per-lane keys, however the caller derived them (the CLI
+    mutator folds in absolute iteration indices; bench folds in
+    0..B-1).
 
     Returns uint32[(2**stack_pow2 + 1) * 8, b] (lane-last)."""
     n_steps = 1 << stack_pow2
-    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
-        jnp.arange(b, dtype=jnp.uint32))
+    b = keys.shape[0]
     words = jax.vmap(
         lambda k: jax.random.bits(k, (n_steps + 1, 8),
                                   dtype=jnp.uint32))(keys)
     return words.reshape(b, (n_steps + 1) * 8).T
+
+
+def havoc_words(key, b, stack_pow2=4):
+    """havoc_words_for_keys over fold_in(key, 0..b-1)."""
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(b, dtype=jnp.uint32))
+    return havoc_words_for_keys(keys, stack_pow2)
 
 
 @partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
@@ -533,3 +580,57 @@ def fuzz_batch_pallas(instrs, edge_table, seed_buf, seed_len, words,
                    path_hash=path_hash.reshape(b),
                    edge_ids=None)
     return res, bufs.T.astype(jnp.uint8), out_lens.reshape(b)
+
+
+# --------------------------------------------------------------------
+# Two-phase scheduling: break the tail-latency ceiling
+# --------------------------------------------------------------------
+#
+# Each grid tile runs its while-loop until the DEEPEST live lane
+# halts.  Mutant depth is heavy-tailed (flagship tlvstack_vm at 16k
+# lanes: mean 71 steps, p50 26, but per-tile max ~366 — every tile
+# pays ~5x the mean).  Phase 1 runs the fused kernel with a small
+# budget K; the ~15% of lanes still running are stably sorted to the
+# front and re-executed from scratch with the full budget, with every
+# finished lane skip-masked so its tile exits after zero iterations.
+# Re-execution (instead of carrying VM state across kernels) keeps
+# the kernels unchanged and is cheap: survivors * K wasted steps vs
+# the ~all-tiles * (max - K) saved.  Results are bit-identical to the
+# single-phase kernel: finished lanes' fields are final at K, and
+# survivors re-run deterministically.
+
+def fuzz_batch_pallas_2phase(instrs, edge_table, seed_buf, seed_len,
+                             words, mem_size, max_steps, n_edges,
+                             stack_pow2=4, phase1_steps=0,
+                             interpret=False):
+    """fuzz_batch_pallas with two-phase tail scheduling.
+    ``phase1_steps`` = 0 or >= max_steps disables phase 2."""
+    res1, bufs, lens = fuzz_batch_pallas(
+        instrs, edge_table, seed_buf, seed_len, words, mem_size,
+        min(phase1_steps, max_steps) if phase1_steps else max_steps,
+        n_edges, stack_pow2=stack_pow2, interpret=interpret)
+    if not phase1_steps or phase1_steps >= max_steps:
+        return res1, bufs, lens
+
+    surv = res1.status == FUZZ_RUNNING
+    # stable: equal keys keep lane order -> deterministic tiling
+    order = jnp.argsort(jnp.where(surv, 0, 1), stable=True)
+    inv = jnp.argsort(order, stable=True)
+    res2 = run_batch_pallas(
+        instrs, edge_table,
+        jnp.take(bufs, order, axis=0), jnp.take(lens, order),
+        mem_size, max_steps, n_edges, interpret=interpret,
+        skip=jnp.take((~surv).astype(jnp.int32), order))
+
+    def mix(f1, f2_sorted):
+        f2 = jnp.take(f2_sorted, inv, axis=0)
+        m = surv if f1.ndim == 1 else surv[:, None]
+        return jnp.where(m, f2, f1)
+
+    res = VMResult(status=mix(res1.status, res2.status),
+                   exit_code=mix(res1.exit_code, res2.exit_code),
+                   counts=mix(res1.counts, res2.counts),
+                   steps=mix(res1.steps, res2.steps),
+                   path_hash=mix(res1.path_hash, res2.path_hash),
+                   edge_ids=None)
+    return res, bufs, lens

@@ -111,7 +111,15 @@ static void kb_module_name(char *out, size_t n) {
 
 /* Claim (or find) this module's submap in the name table at the end
  * of the SHM segment.  Constructors run serially under the loader, so
- * no locking is needed; forked children only read. */
+ * no locking is needed; forked children only read.
+ *
+ * Degraded-accounting flag: snprintf always NUL-terminates, so byte
+ * KB_MODTAB_NAME-1 of an entry is never part of a name.  It is set
+ * nonzero when that entry's coverage aliases more than one module —
+ * table overflow (later modules share the last partition) or a
+ * truncated-name match (two >63-char basenames merging) — so the
+ * fuzzer side can surface the degradation instead of silently
+ * mis-attributing per-module novelty. */
 static void kb_register_module(void) {
   char name[KB_MODTAB_NAME];
   kb_module_name(name, sizeof name);
@@ -120,12 +128,23 @@ static void kb_register_module(void) {
   for (; idx < KB_N_MODULES; idx++) {
     char *entry = tab + idx * KB_MODTAB_NAME;
     if (!entry[0]) {
-      snprintf(entry, KB_MODTAB_NAME, "%s", name);
+      /* width-1: names keep a NUL at <= byte KB_MODTAB_NAME-2, so
+       * the flag byte never clobbers a maximal name's terminator */
+      snprintf(entry, KB_MODTAB_NAME - 1, "%s", name);
       break;
     }
-    if (!strncmp(entry, name, KB_MODTAB_NAME)) break;
+    if (!strncmp(entry, name, KB_MODTAB_NAME - 2)) {
+      /* a full-width match may be a truncated alias of a DIFFERENT
+       * long basename, not a re-registration of ours */
+      if (strlen(name) >= KB_MODTAB_NAME - 2)
+        entry[KB_MODTAB_NAME - 1] = 1;
+      break;
+    }
   }
-  if (idx >= KB_N_MODULES) idx = KB_N_MODULES - 1; /* table full: share */
+  if (idx >= KB_N_MODULES) { /* table full: share the last partition */
+    idx = KB_N_MODULES - 1;
+    tab[idx * KB_MODTAB_NAME + KB_MODTAB_NAME - 1] = 1;
+  }
   kb_mod_base = (uintptr_t)idx * KB_MOD_SIZE;
   kb_loc_mask = KB_MOD_SIZE - 1;
 }

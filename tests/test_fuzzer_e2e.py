@@ -354,3 +354,82 @@ def test_pipeline_drains_findings_on_error(tmp_path):
     # new paths always appear in batch 1) must be on disk
     assert fz.stats.new_paths > 0
     assert os.listdir(tmp_path / "output" / "new_paths")
+
+
+def _interpret_pallas(monkeypatch):
+    """Route the pallas entries through interpret mode (CI has no
+    TPU) and clear the jit caches that captured them."""
+    import killerbeez_tpu.instrumentation.jit_harness as jh
+    import killerbeez_tpu.ops.vm_kernel as vk
+    orig_fuzz = vk.fuzz_batch_pallas
+    orig_run = vk.run_batch_pallas
+    monkeypatch.setattr(
+        vk, "fuzz_batch_pallas",
+        lambda *a, **k: orig_fuzz(*a, interpret=True, **k))
+    monkeypatch.setattr(
+        vk, "run_batch_pallas",
+        lambda *a, **k: orig_run(*a, interpret=True, **k))
+    jh._fused_step.clear_cache()
+    jh._fused_fuzz_step.clear_cache()
+    return (jh._fused_step, jh._fused_fuzz_step)
+
+
+def test_fused_cli_path_matches_unfused(tmp_path, monkeypatch):
+    """The product path for the flagship number: engine
+    "pallas_fused" + havoc drives mutation AND execution in one
+    kernel from the ordinary Fuzzer loop, and must produce IDENTICAL
+    stats and on-disk findings to the unfused engine (same mutator
+    keys -> bit-identical candidates and verdicts)."""
+    from killerbeez_tpu.models import targets_cgc
+    steps = _interpret_pallas(monkeypatch)
+    seed = targets_cgc.tlvstack_vm_seed()
+    try:
+        runs = {}
+        for engine in ("xla", "pallas_fused"):
+            instr = instrumentation_factory(
+                "jit_harness",
+                json.dumps({"target": "tlvstack_vm", "engine": engine}))
+            mut = mutator_factory("havoc", '{"seed": 5}', seed)
+            drv = driver_factory("file", None, instr, mut)
+            out = tmp_path / engine
+            fz = Fuzzer(drv, output_dir=str(out), batch_size=128)
+            stats = fz.run(256)
+            findings = {
+                kind: sorted(os.listdir(out / kind))
+                for kind in ("crashes", "hangs", "new_paths")}
+            runs[engine] = (stats.as_dict(), findings,
+                            instr.get_state(), mut.iteration)
+    finally:
+        for s in steps:
+            s.clear_cache()
+    (s_x, f_x, st_x, it_x), (s_f, f_f, st_f, it_f) = (
+        runs["xla"], runs["pallas_fused"])
+    assert f_x == f_f                       # identical findings on disk
+    assert f_x["new_paths"]                 # non-vacuous
+    assert s_x["new_paths"] == s_f["new_paths"]
+    assert s_x["crashes"] == s_f["crashes"]
+    assert it_x == it_f == 256              # mutator walk advanced
+    # virgin maps identical too (state interchangeable across engines)
+    a, b = json.loads(st_x), json.loads(st_f)
+    assert a["virgin_bits"] == b["virgin_bits"]
+    assert a["virgin_crash"] == b["virgin_crash"]
+
+
+def test_fused_engine_falls_back_for_unfusable_mutator(tmp_path,
+                                                      monkeypatch):
+    """engine "pallas_fused" with a non-havoc mutator warns and runs
+    the unfused pallas engine — never silently wrong results."""
+    steps = _interpret_pallas(monkeypatch)
+    try:
+        instr = instrumentation_factory(
+            "jit_harness",
+            '{"target": "test", "engine": "pallas_fused"}')
+        mut = mutator_factory("bit_flip", None, SEED)
+        drv = driver_factory("file", None, instr, mut)
+        assert not instr.wants_fused(mut)   # warns once, returns False
+        fz = Fuzzer(drv, output_dir=str(tmp_path / "out"), batch_size=8)
+        stats = fz.run(32)
+        assert stats.crashes == 1           # the ABCD crash still found
+    finally:
+        for s in steps:
+            s.clear_cache()

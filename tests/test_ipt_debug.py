@@ -85,6 +85,32 @@ def test_ipt_filters_restrict_tracing():
     assert list(res.new_paths) == [1, 0, 0]
 
 
+def test_ipt_foreign_hash_scheme_degrades_not_raises():
+    """A state from a differently-filtered instance (or a pre-0.2
+    state with no hash_scheme key) lives in a different 64-bit hash
+    space: set_state starts fresh but keeps counters, merge is a
+    no-op — neither raises (cross-version manager compat)."""
+    a = make_ipt()                      # unfiltered: "path+counts"
+    batch(a, [b"zzzz", b"Azzz"])
+    foreign = json.loads(a.get_state())
+    foreign["hash_scheme"] = "stream"   # simulate a filtered instance
+    b = make_ipt()
+    b.merge(json.dumps(foreign))        # no-op, not ValueError
+    assert b.coverage_bytes() == 0
+    b.set_state(json.dumps(foreign))    # fresh sets, counters kept
+    assert b.coverage_bytes() == 0
+    assert b.total_execs == a.total_execs
+    # pre-0.2 states carry no key at all: defaults to "stream"
+    del foreign["hash_scheme"]
+    c = make_ipt()
+    c.set_state(json.dumps(foreign))
+    assert c.coverage_bytes() == 0
+    # like-configured states still roundtrip fully
+    d = make_ipt()
+    d.set_state(a.get_state())
+    assert d.coverage_bytes() == a.coverage_bytes()
+
+
 def test_ipt_rejects_host_targets():
     with pytest.raises(ValueError, match="PMU|afl"):
         instrumentation_factory("ipt", None)

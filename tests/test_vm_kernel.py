@@ -123,3 +123,60 @@ def test_fused_mutate_execute_parity(rng):
         np.testing.assert_array_equal(
             np.asarray(getattr(ref, f)), np.asarray(getattr(res, f)),
             err_msg=f"fused: {f} diverged")
+
+
+def test_skip_mask_suppresses_lanes(rng):
+    """run_batch_pallas(skip=...): skipped lanes report FUZZ_NONE with
+    zero counts/steps; unskipped lanes are bit-identical to a no-skip
+    run (the phase-2 half of two-phase scheduling)."""
+    from killerbeez_tpu import FUZZ_NONE
+    from killerbeez_tpu.ops.vm_kernel import run_batch_pallas as rbp
+    prog = targets.get_target("tlvstack_vm")
+    B, L = LANE_TILE, 32
+    inputs, lengths = _mutant_batch("tlvstack_vm", rng, B, L)
+    args = (jnp.asarray(prog.instrs), jnp.asarray(prog.edge_table),
+            jnp.asarray(inputs), jnp.asarray(lengths),
+            prog.mem_size, prog.max_steps, prog.n_edges)
+    skip = (np.arange(B) % 2).astype(np.int32)
+    full = rbp(*args, interpret=True)
+    part = rbp(*args, interpret=True, skip=jnp.asarray(skip))
+    sk = skip.astype(bool)
+    assert (np.asarray(part.status)[sk] == FUZZ_NONE).all()
+    assert (np.asarray(part.counts)[sk] == 0).all()
+    assert (np.asarray(part.steps)[sk] == 0).all()
+    for f in FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(full, f))[~sk],
+            np.asarray(getattr(part, f))[~sk],
+            err_msg=f"unskipped lanes: {f} diverged")
+
+
+def test_two_phase_matches_single_phase(rng):
+    """fuzz_batch_pallas_2phase must be bit-identical to the
+    single-phase kernel for every phase1 budget (finished lanes are
+    final at K; survivors re-run deterministically)."""
+    import jax
+    from killerbeez_tpu.ops.vm_kernel import (
+        fuzz_batch_pallas, fuzz_batch_pallas_2phase, havoc_words,
+    )
+    prog = targets.get_target("tlvstack_vm")
+    B, L = LANE_TILE, 32
+    seed = targets_cgc.VM_SEEDS["tlvstack_vm"][0]()
+    seed_buf = np.zeros(L, np.uint8)
+    seed_buf[:len(seed)] = np.frombuffer(seed, np.uint8)
+    words = havoc_words(jax.random.fold_in(jax.random.key(0), 11), B)
+    base_args = (jnp.asarray(prog.instrs), jnp.asarray(prog.edge_table),
+                 jnp.asarray(seed_buf), jnp.int32(len(seed)), words,
+                 prog.mem_size, prog.max_steps, prog.n_edges)
+    ref, rbufs, rlens = fuzz_batch_pallas(*base_args, interpret=True)
+    for k in (8, 64, prog.max_steps):
+        out, obufs, olens = fuzz_batch_pallas_2phase(
+            *base_args, phase1_steps=k, interpret=True)
+        np.testing.assert_array_equal(np.asarray(rbufs),
+                                      np.asarray(obufs))
+        np.testing.assert_array_equal(np.asarray(rlens),
+                                      np.asarray(olens))
+        for f in FIELDS:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, f)), np.asarray(getattr(out, f)),
+                err_msg=f"phase1_steps={k}: {f} diverged")

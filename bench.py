@@ -178,7 +178,7 @@ def bench_device_fused(target, batch, steps, seed):
         make_static_maps, static_triage,
     )
     from killerbeez_tpu.ops.vm_kernel import (
-        fuzz_batch_pallas, havoc_words,
+        auto_phase1_steps, fuzz_batch_pallas_2phase, havoc_words,
     )
 
     prog = targets.get_target(target)
@@ -187,14 +187,16 @@ def bench_device_fused(target, batch, steps, seed):
     u_np, s_np = make_static_maps(prog.edge_slot)
     u_slots, seg_id = jnp.asarray(u_np), jnp.asarray(s_np)
     seed_j, seed_len = _prep_seed(seed)
+    # the product's auto two-phase rule (jit_harness phase1_steps=-1)
+    p1 = auto_phase1_steps(prog.max_steps)
 
     @jax.jit
     def fuzz_step(vb, vc, vh, it):
         w = havoc_words(jax.random.fold_in(jax.random.key(0), it),
                         batch)
-        res, bufs, lens = fuzz_batch_pallas(
+        res, bufs, lens = fuzz_batch_pallas_2phase(
             ins, tbl, seed_j, seed_len, w, prog.mem_size,
-            prog.max_steps, prog.n_edges)
+            prog.max_steps, prog.n_edges, phase1_steps=p1)
         statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
                              res.status)
         new_paths, uc, uh, vb2, vc2, vh2 = static_triage(

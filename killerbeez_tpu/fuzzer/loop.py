@@ -168,7 +168,7 @@ class Fuzzer:
         """``done_through`` is the global iteration count as of THIS
         batch — with pipelining, stats.iterations runs ahead of the
         batch being triaged, so logs must not read it.  ``packed`` is
-        the device-side verdict byte (see _pack_verdicts); when set,
+        the device-side verdict byte built by _prefetch; when set,
         the big per-lane arrays never cross to the host unless this
         batch actually has interesting lanes."""
         res = out.result

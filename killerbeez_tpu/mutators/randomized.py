@@ -22,8 +22,15 @@ from .base import Mutator
 class _KeyedMutator(Mutator):
     """Shared plumbing: iteration index -> per-lane key."""
 
+    def _base_key(self) -> jax.Array:
+        """The mutator's PRNG root.  fused_spec hands THIS key to the
+        fused kernel (which folds in iteration indices exactly like
+        _keys), so candidate parity between the fused and unfused
+        paths is anchored to one derivation."""
+        return jax.random.key(int(self.options.get("seed", 0)))
+
     def _keys(self, its: np.ndarray) -> jax.Array:
-        base = jax.random.key(int(self.options.get("seed", 0)))
+        base = self._base_key()
         return jax.vmap(lambda i: jax.random.fold_in(base, i))(
             jnp.asarray(its, dtype=jnp.uint32))
 
@@ -57,8 +64,7 @@ class HavocMutator(_KeyedMutator):
         fold_in(base, absolute_iteration) — EXACTLY _keys — so fused
         candidates are bit-identical to the mutate-then-execute
         pipeline."""
-        base = jax.random.key(int(self.options.get("seed", 0)))
-        return (self.seed_buf, self.seed_len, base,
+        return (self.seed_buf, self.seed_len, self._base_key(),
                 int(self.options["stack_pow2"]))
 
 

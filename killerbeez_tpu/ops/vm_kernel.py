@@ -47,10 +47,14 @@ from ..models.vm import (
 LANE_TILE = 512  # lanes per grid instance (multiple of 128)
 
 
-def _pick_rows(table, idx):
+def _pick_rows(table, idx, rows=None):
     """out[0, t] = table[idx[0, t], t] for table [R, T], idx [1, T]:
-    one-hot over the (small, static) row axis."""
-    rows = jax.lax.broadcasted_iota(jnp.int32, table.shape, 0)
+    one-hot over the (small, static) row axis.  ``rows`` is the
+    precomputed iota — VM-loop callers pass the hoisted copy (Mosaic
+    LICM already hoists in-body iotas on chip, measured neutral; the
+    explicit form documents the invariant and helps interpret mode)."""
+    if rows is None:
+        rows = jax.lax.broadcasted_iota(jnp.int32, table.shape, 0)
     return jnp.sum(jnp.where(rows == idx, table, 0), axis=0,
                    keepdims=True).astype(table.dtype)
 
@@ -77,6 +81,17 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
     nb = table_t.shape[0]
     L = bufs.shape[0]
 
+    # loop-invariant iotas, hoisted (the fetch one-hot alone is
+    # [NI, T]); on-chip this measured neutral — Mosaic's LICM already
+    # lifts them — but it documents the invariant explicitly
+    io_ni = jax.lax.broadcasted_iota(jnp.int32, (ni, t), 0)
+    io_regs = jax.lax.broadcasted_iota(jnp.int32, (N_REGS, t), 0)
+    io_mem = jax.lax.broadcasted_iota(jnp.int32, (mem_size, t), 0)
+    io_buf = jax.lax.broadcasted_iota(jnp.int32, (L, t), 0)
+    io_nb1 = jax.lax.broadcasted_iota(jnp.int32, (nb + 1, t), 0)
+    io_nb = jax.lax.broadcasted_iota(jnp.int32, (nb, t), 0)
+    io_e = jax.lax.broadcasted_iota(jnp.int32, (n_edges + 1, t), 0)
+
     def step(state):
         (pc, regs, mem, prev_loc, status, exit_code, prev_idx,
          counts, path_hash, i, lane_steps) = state
@@ -84,8 +99,7 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
 
         # ---- instruction fetch: transposed one-hot MXU matmul ----
         pcc = jnp.clip(pc, 0, ni - 1)
-        onehot_pc = (jax.lax.broadcasted_iota(jnp.int32, (ni, t), 0)
-                     == pcc).astype(jnp.float32)         # [NI, T]
+        onehot_pc = (io_ni == pcc).astype(jnp.float32)       # [NI, T]
         row = jax.lax.dot(instrs_t, onehot_pc,
                           precision=jax.lax.Precision.HIGHEST)
         row = row.astype(jnp.int32)                      # [4, T]
@@ -99,14 +113,14 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
         cmp_sel = b & 3
         cmp_rb = (b >> 2) & (N_REGS - 1)
 
-        ra = _pick_rows(regs, jnp.clip(a, 0, N_REGS - 1))
-        rb = _pick_rows(regs, jnp.clip(b, 0, N_REGS - 1))
-        ry = _pick_rows(regs, rb_idx)
-        cmp_y = _pick_rows(regs, cmp_rb)
+        ra = _pick_rows(regs, jnp.clip(a, 0, N_REGS - 1), io_regs)
+        rb = _pick_rows(regs, jnp.clip(b, 0, N_REGS - 1), io_regs)
+        ry = _pick_rows(regs, rb_idx, io_regs)
+        cmp_y = _pick_rows(regs, cmp_rb, io_regs)
 
         # LDB
         ldb_ok = (rb >= 0) & (rb < lengths)
-        ldb_val = _pick_rows(bufs, jnp.clip(rb, 0, L - 1))
+        ldb_val = _pick_rows(bufs, jnp.clip(rb, 0, L - 1), io_buf)
         ldb_val = jnp.where(ldb_ok, ldb_val, 0)
 
         x, y = rb, ry
@@ -125,7 +139,7 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
             jnp.zeros_like(ra)) != 0
 
         mem_ok_ld = (rb >= 0) & (rb < mem_size)
-        ldm_val = _pick_rows(mem, jnp.clip(rb, 0, mem_size - 1))
+        ldm_val = _pick_rows(mem, jnp.clip(rb, 0, mem_size - 1), io_mem)
         ldm_val = jnp.where(mem_ok_ld, ldm_val, 0)
         mem_ok_st = (ra >= 0) & (ra < mem_size)
 
@@ -139,14 +153,12 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
             jnp.zeros_like(pc))
         writes_reg = ((op == OP_LDB) | (op == OP_LDI) | (op == OP_ALU) |
                       (op == OP_ADDI) | (op == OP_LEN) | (op == OP_LDM))
-        ridx = jax.lax.broadcasted_iota(jnp.int32, (N_REGS, t), 0)
         wmask = (writes_reg & running) & \
-            (ridx == jnp.clip(a, 0, N_REGS - 1))
+            (io_regs == jnp.clip(a, 0, N_REGS - 1))
         new_regs = jnp.where(wmask, wr_val, regs)
 
         do_store = (op == OP_STM) & mem_ok_st & running
-        midx = jax.lax.broadcasted_iota(jnp.int32, (mem_size, t), 0)
-        smask = do_store & (midx == jnp.clip(ra, 0, mem_size - 1))
+        smask = do_store & (io_mem == jnp.clip(ra, 0, mem_size - 1))
         new_mem = jnp.where(smask, rb, mem)
 
         crashes = (op == OP_CRASH) | \
@@ -163,16 +175,13 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
         cur_loc = a & (MAP_SIZE - 1)
         new_prev = jnp.where(is_block, cur_loc >> 1, prev_loc)
         cur_idx = jnp.clip(b, 0, nb - 1)
-        onehot_prev = (jax.lax.broadcasted_iota(
-            jnp.int32, (nb + 1, t), 0) == prev_idx).astype(jnp.float32)
+        onehot_prev = (io_nb1 == prev_idx).astype(jnp.float32)
         rows_e = jax.lax.dot(table_t, onehot_prev,
                              precision=jax.lax.Precision.HIGHEST)
         # rows_e[cidx, t] = edge index for (prev[t], cidx)   [nb, T]
-        eidx = jnp.sum(jnp.where(
-            jax.lax.broadcasted_iota(jnp.int32, (nb, t), 0) == cur_idx,
-            rows_e, 0), axis=0, keepdims=True).astype(jnp.int32)
-        eiota = jax.lax.broadcasted_iota(jnp.int32, (n_edges + 1, t), 0)
-        emask = (eiota == eidx) & is_block
+        eidx = jnp.sum(jnp.where(io_nb == cur_idx, rows_e, 0),
+                       axis=0, keepdims=True).astype(jnp.int32)
+        emask = (io_e == eidx) & is_block
         new_counts = counts + emask.astype(jnp.int32)
         new_prev_idx = jnp.where(is_block, cur_idx + 1, prev_idx)
         new_hash = jnp.where(
@@ -599,12 +608,25 @@ def fuzz_batch_pallas(instrs, edge_table, seed_buf, seed_len, words,
 # single-phase kernel: finished lanes' fields are final at K, and
 # survivors re-run deterministically.
 
+def auto_phase1_steps(max_steps: int) -> int:
+    """The product's default phase-1 budget: max_steps/8 on deep
+    targets (measured best on the flagship: K=128 of 1024), single
+    phase on shallow ones where a second kernel's ~3.6ms fixed cost
+    can't pay for itself.  jit_harness (phase1_steps=-1) and bench
+    both resolve through here so they can never measure different
+    schedules."""
+    return max_steps // 8 if max_steps >= 256 else 0
+
+
 def fuzz_batch_pallas_2phase(instrs, edge_table, seed_buf, seed_len,
                              words, mem_size, max_steps, n_edges,
                              stack_pow2=4, phase1_steps=0,
                              interpret=False):
     """fuzz_batch_pallas with two-phase tail scheduling.
-    ``phase1_steps`` = 0 or >= max_steps disables phase 2."""
+    ``phase1_steps``: <0 = auto (auto_phase1_steps); 0 or >=
+    max_steps disables phase 2."""
+    if phase1_steps < 0:
+        phase1_steps = auto_phase1_steps(max_steps)
     res1, bufs, lens = fuzz_batch_pallas(
         instrs, edge_table, seed_buf, seed_len, words, mem_size,
         min(phase1_steps, max_steps) if phase1_steps else max_steps,

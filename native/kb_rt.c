@@ -136,7 +136,7 @@ static void kb_register_module(void) {
     if (!strncmp(entry, name, KB_MODTAB_NAME - 2)) {
       /* a full-width match may be a truncated alias of a DIFFERENT
        * long basename, not a re-registration of ours */
-      if (strlen(name) >= KB_MODTAB_NAME - 2)
+      if (strlen(name) > KB_MODTAB_NAME - 2)
         entry[KB_MODTAB_NAME - 1] = 1;
       break;
     }

@@ -365,10 +365,10 @@ def _interpret_pallas(monkeypatch):
     orig_run = vk.run_batch_pallas
     monkeypatch.setattr(
         vk, "fuzz_batch_pallas",
-        lambda *a, **k: orig_fuzz(*a, interpret=True, **k))
+        lambda *a, **k: orig_fuzz(*a, **{**k, "interpret": True}))
     monkeypatch.setattr(
         vk, "run_batch_pallas",
-        lambda *a, **k: orig_run(*a, interpret=True, **k))
+        lambda *a, **k: orig_run(*a, **{**k, "interpret": True}))
     jh._fused_step.clear_cache()
     jh._fused_fuzz_step.clear_cache()
     return (jh._fused_step, jh._fused_fuzz_step)

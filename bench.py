@@ -57,9 +57,13 @@ def bench_host_configs():
     test_bin = os.path.join(REPO, "corpus", "build", "test")
 
     def run_config(n_iters, batch, instr_name, instr_opts, driver_name,
-                   driver_opts, out_dir):
+                   driver_opts, out_dir, warmup=0):
         """Build, run and ALWAYS tear down one host config (a leaked
-        forkserver would hold SHM + CPU for the rest of the bench)."""
+        forkserver would hold SHM + CPU for the rest of the bench).
+        ``warmup`` executes that many iterations first so the timed
+        window measures steady state, not jit compiles (the
+        reference's 'Ran N iterations in S seconds' is likewise a
+        warm loop)."""
         instr = instrumentation_factory(instr_name, instr_opts)
         drv = None
         try:
@@ -70,9 +74,13 @@ def bench_host_configs():
             fz = Fuzzer(drv, output_dir=os.path.join(
                 REPO, "bench_out", out_dir), batch_size=batch,
                 write_findings=False)
+            if warmup:
+                fz.run(warmup)
+            done = fz.stats.iterations
             t0 = time.time()
-            stats = fz.run(n_iters)
-            return n_iters / (time.time() - t0), stats
+            stats = fz.run(done + n_iters)
+            return ((stats.iterations - done) / (time.time() - t0),
+                    stats)
         finally:
             if drv is not None:
                 drv.cleanup()
@@ -88,15 +96,15 @@ def bench_host_configs():
     # config 2: stdin + afl(forkserver) + havoc, single instance
     v, stats = run_config(
         2000, 500, "afl", None, "stdin",
-        json.dumps({"path": test_bin}), "c2")
+        json.dumps({"path": test_bin}), "c2", warmup=500)
     emit(2, "stdin+afl forkserver, 1 instance", v,
          baseline=FORKSERVER_BASELINE, crashes=stats.crashes)
 
     # config 3: TPU-batch mutation + host forkserver pool
     workers = os.cpu_count() or 1
     v, stats = run_config(
-        4096, 4096, "afl", json.dumps({"workers": workers}), "stdin",
-        json.dumps({"path": test_bin}), "c3")
+        8192, 2048, "afl", json.dumps({"workers": workers}), "stdin",
+        json.dumps({"path": test_bin}), "c3", warmup=2048)
     emit(3, f"tpu-batch mutate + forkserver pool x{workers}", v,
          baseline=FORKSERVER_BASELINE, host_cores=workers,
          crashes=stats.crashes)
@@ -265,13 +273,13 @@ step = make_sharded_fuzz_step(prog, mesh, batch_per_device=64, max_len=32)
 state = sharded_state_init(mesh, prog.map_size)
 seed = targets_cgc.tlvstack_vm_seed()
 buf = np.zeros(32, np.uint8); buf[:len(seed)] = np.frombuffer(seed, np.uint8)
-state, st, rets, bufs, lens = step(state, jnp.asarray(buf),
-                                   jnp.int32(len(seed)), jnp.int32(0))
+state, st, rets, uc, uh, ec, bufs, lens = step(
+    state, jnp.asarray(buf), jnp.int32(len(seed)), jnp.int32(0))
 jax.block_until_ready(state.virgin_bits)
 t0 = time.time(); N = 5
 for i in range(1, N + 1):
-    state, st, rets, bufs, lens = step(state, jnp.asarray(buf),
-                                       jnp.int32(len(seed)), jnp.int32(i))
+    state, st, rets, uc, uh, ec, bufs, lens = step(
+        state, jnp.asarray(buf), jnp.int32(len(seed)), jnp.int32(i))
 jax.block_until_ready(state.virgin_bits)
 dt = time.time() - t0
 print(json.dumps({'ok': True, 'execs_per_sec': 64 * 4 * N / dt,

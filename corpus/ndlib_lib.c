@@ -1,0 +1,19 @@
+/* ndlib1 — NONdeterministic shared-library half of the picker
+ * fixture (reference picker/main.c:163-282 scenario: a module whose
+ * coverage varies across repeated runs of the SAME input must be
+ * classified multi-path-same-file and its bitmap bytes masked).
+ * The loop trip count depends on the clock, so hit-count buckets in
+ * THIS module's map partition differ run to run while the main
+ * binary's stay stable. */
+#include <time.h>
+
+int nd_check(const unsigned char *buf, int n) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  int d = 0;
+  int trips = 1 + (int)((ts.tv_nsec >> 6) & 7);
+  for (int i = 0; i < trips; i++) d++;
+  if ((ts.tv_nsec >> 9) & 1) d += 100;
+  if (n > 1 && buf[1] == 'Q') d += 10;
+  return d;
+}

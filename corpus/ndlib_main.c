@@ -1,0 +1,31 @@
+/* ndlib — deterministic main half of the per-module picker fixture:
+ * the main binary's coverage is identical across repeated runs of
+ * one input; all nondeterminism lives in libnd1.so (its own map
+ * partition under KB_MODULES=1). */
+#include <stdio.h>
+#include <unistd.h>
+
+int nd_check(const unsigned char *buf, int n);
+
+static int run_once(const char *path) {
+  unsigned char buf[64];
+  ssize_t n;
+  if (path) {
+    FILE *f = fopen(path, "rb");
+    if (!f) return 1;
+    n = (ssize_t)fread(buf, 1, sizeof(buf), f);
+    fclose(f);
+  } else {
+    n = read(0, buf, sizeof(buf));
+  }
+  if (n < 1) {
+    printf("empty\n");
+    return 0;
+  }
+  printf("nd %d\n", nd_check(buf, (int)n));
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  return run_once(argc > 1 ? argv[1] : 0);
+}

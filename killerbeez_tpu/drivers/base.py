@@ -99,15 +99,17 @@ class Driver:
 
     # -- batched --------------------------------------------------------
 
-    def test_batch(self, n: int, pad_to: Optional[int] = None
-                   ) -> BatchOutcome:
+    def test_batch(self, n: int, pad_to: Optional[int] = None,
+                   prefetch_next: bool = True) -> BatchOutcome:
         """Mutate + execute ``n`` candidates. ``pad_to`` keeps the lane
         dimension shape-stable across tail batches (no XLA recompile):
         device backends get the input tensor padded with copies of
         lane 0 (on-device duplicates are coverage no-ops and nearly
         free), host backends execute only the ``n`` real lanes and pad
         the result arrays instead (a padded lane would cost a real
-        fork+exec). Callers triage only the first ``n`` lanes."""
+        fork+exec). Callers triage only the first ``n`` lanes.
+        ``prefetch_next=False`` (the loop's final batch) skips
+        generating a follow-up batch that would never run."""
         if not self.supports_batch:
             raise RuntimeError(f"{self.name}: batch path unavailable")
         wants_fused = getattr(self.instrumentation, "wants_fused", None)
@@ -144,6 +146,10 @@ class Driver:
             # idempotent per target key; re-binds if a single exec
             # rebuilt the instrumentation's target in between
             self.instrumentation.prepare_host(**self._host_exec_spec())
+            # generate the NEXT batch now: its device->host copies
+            # land while this batch's target processes execute
+            if prefetch_next:
+                self.mutator.prefetch_batch(n)
             result = self.instrumentation.run_batch(bufs, lens,
                                                     pad_to=pad_to)
         if n > 0:

@@ -54,12 +54,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-msd", "--mutator-state-dump",
                    help="dump mutator state to file on exit")
     p.add_argument("-l", "--logging-options", help="logging JSON options")
+    p.add_argument("-fb", "--feedback", type=int, default=0,
+                   help="coverage-guided corpus loop: every N "
+                        "batches, rotate the seed through new-path "
+                        "findings (0 = off)")
     p.add_argument("-dt", "--debug-triage", action="store_true",
                    help="re-run each unique crash once under the "
                         "ptrace debug tier and save signal-level "
                         "details next to the repro (host targets)")
     p.add_argument("-b", "--batch-size", type=int, default=1024,
                    help="candidates per device step (batched backends)")
+    p.add_argument("--mesh",
+                   help='multi-chip campaign over a "dp,mp" device '
+                        "mesh (e.g. --mesh 4,2): candidates shard "
+                        "over dp, coverage maps over mp, findings "
+                        "land in -o exactly like single-chip; "
+                        "requires jit_harness + havoc and -b "
+                        "divisible by dp")
     p.add_argument("--list", action="store_true",
                    help="list components and their options, then exit")
     return p
@@ -97,12 +108,34 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.mutator_state_file:
             mutator.set_state(read_file(args.mutator_state_file).decode())
 
-        driver = driver_factory(args.driver, args.driver_options,
-                                instrumentation, mutator)
+        if args.mesh:
+            from ..parallel.campaign import ShardedCampaignDriver
+            from ..utils.logging import WARNING_MSG
+            if args.driver != "file" or args.driver_options:
+                WARNING_MSG(
+                    "--mesh campaigns deliver candidates on-device; "
+                    "the %r driver%s is ignored", args.driver,
+                    " and -d options" if args.driver_options else "")
+            if args.instrumentation != "jit_harness":
+                print("error: --mesh campaigns need the jit_harness "
+                      "instrumentation", file=sys.stderr)
+                return 2
+            if not hasattr(mutator, "fused_spec"):
+                print("error: --mesh campaigns need the havoc "
+                      "mutator (keyed per-lane candidate streams)",
+                      file=sys.stderr)
+                return 2
+            driver = ShardedCampaignDriver(
+                args.mesh, instrumentation, mutator,
+                batch_size=args.batch_size)
+        else:
+            driver = driver_factory(args.driver, args.driver_options,
+                                    instrumentation, mutator)
 
         fuzzer = Fuzzer(driver, output_dir=args.output,
                         batch_size=args.batch_size,
-                        debug_triage=args.debug_triage)
+                        debug_triage=args.debug_triage,
+                        feedback=args.feedback)
         stats = fuzzer.run(args.iterations)
         INFO_MSG(
             "results: %d crashes (%d unique), %d hangs (%d unique), "

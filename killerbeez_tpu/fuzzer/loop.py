@@ -44,14 +44,25 @@ class FuzzStats:
 class Fuzzer:
     """Drives driver/instrumentation/mutator to completion."""
 
+    #: corpus-feedback cap: rotation cycles at most this many of the
+    #: most recent new-path findings (older ones stay on disk)
+    CORPUS_CAP = 256
+
     def __init__(self, driver: Driver, output_dir: str = "output",
                  batch_size: int = 1024, write_findings: bool = True,
-                 debug_triage: bool = False):
+                 debug_triage: bool = False, feedback: int = 0):
         self.driver = driver
         self.output_dir = output_dir
         self.batch_size = int(batch_size)
         self.write_findings = write_findings
         self.debug_triage = debug_triage
+        #: every `feedback` batches, rotate the mutator seed through
+        #: new-path findings (coverage-guided corpus loop; 0 = off)
+        self.feedback = int(feedback)
+        self._corpus: list = []
+        self._corpus_pos = 0
+        self._base_seed = None
+        self._rotations = 0
         self._dbg = None
         self.stats = FuzzStats()
         self._seen = {k: set() for k in ("crashes", "hangs", "new_paths")}
@@ -141,7 +152,15 @@ class Fuzzer:
             WARNING_MSG("target exec error on iteration %d", s.iterations)
         if new_path > 0:
             s.new_paths += 1
-            self._record("new_paths", buf)
+            recorded = self._record("new_paths", buf)
+            # corpus feedback keeps only EDGE-novel findings (ret 2:
+            # a brand-new edge, not just a new hit-count bucket) —
+            # bucket-only findings are overwhelmingly shallow
+            # variants that dilute the rotation
+            if recorded and self.feedback and new_path == 2:
+                self._corpus.append(buf)
+                if len(self._corpus) > self.CORPUS_CAP:
+                    self._corpus.pop(0)
 
     # -- loops ----------------------------------------------------------
 
@@ -247,22 +266,80 @@ class Fuzzer:
                     fn()
         return packed
 
+    def _rotate_seed(self, mut) -> None:
+        """Coverage-guided corpus feedback (beyond reference parity:
+        the reference's equivalent is operators re-seeding campaigns
+        from new_paths/ by hand or via manager jobs).  Round-robins
+        the mutator seed through recorded new-path findings; seed
+        swaps keep the candidate buffer width so compiled steps never
+        retrace (mutator.set_input(keep_length=True)); findings too
+        long for the buffer are dropped from rotation."""
+        self._rotations += 1
+        if self._rotations % 2 == 0 and self._base_seed is not None:
+            cands = [self._base_seed]     # anchor turn
+        else:
+            cands = None
+        while cands or self._corpus:
+            if cands:
+                cand = cands.pop()
+            else:
+                cand = self._corpus[self._corpus_pos
+                                    % len(self._corpus)]
+                self._corpus_pos += 1
+            try:
+                it = mut.get_current_iteration()
+                mut.set_input(cand, keep_length=True)
+                # keep the walk position monotonic: set_input resets
+                # it, but a re-visited seed must get FRESH candidate
+                # keys, not replay the (seed, iteration) pairs it
+                # already executed
+                mut.iteration = it
+                DEBUG_MSG("feedback: rotated seed to a %d-byte "
+                          "input", len(cand))
+                return
+            except ValueError:       # finding wider than the buffer
+                self._corpus.remove(cand)
+
     def _run_batched(self, n_iterations: int) -> None:
         from collections import deque
         mut = self.driver.mutator
         pending: "deque" = deque()
+        # sharded campaigns execute fixed whole-mesh batches; a tail
+        # smaller than the quantum is skipped with a warning instead
+        # of dying mid-run
+        quantum = getattr(self.driver, "batch_quantum", 1)
+        batches = 0
+        if self.feedback and self._base_seed is None and \
+                getattr(mut, "seed_bytes", None):
+            # the baseline seed anchors the rotation: every other
+            # rotation returns to it so findings ADD exploration
+            # frontiers without halving time on the proven seed
+            self._base_seed = mut.seed_bytes
         try:
             while True:
                 room = min(self._remaining(n_iterations),
                            mut.remaining(), self.batch_size)
                 if room <= 0:
                     break
+                if room < quantum:
+                    WARNING_MSG(
+                        "stopping %d iterations early: the mesh "
+                        "executes whole %d-lane batches (-n should "
+                        "be a multiple of -b)", room, quantum)
+                    break
+                if (self.feedback and self._corpus
+                        and batches and batches % self.feedback == 0):
+                    self._rotate_seed(mut)
+                batches += 1
                 # a smaller tail batch would change tensor shapes and
                 # force a full XLA recompile; the driver pads to
                 # batch_size with duplicate lanes (coverage no-ops)
                 # and we triage only the first `room` real lanes
+                more = min(self._remaining(n_iterations) - room,
+                           mut.remaining() - room) > 0
                 out = self.driver.test_batch(room,
-                                             pad_to=self.batch_size)
+                                             pad_to=self.batch_size,
+                                             prefetch_next=more)
                 self.stats.iterations += room
                 packed = self._prefetch(out)
                 pending.append((out, room, self.stats.iterations,
@@ -277,7 +354,16 @@ class Fuzzer:
 
     def _run_single(self, n_iterations: int) -> None:
         instr = self.driver.instrumentation
+        mut = self.driver.mutator
+        # feedback cadence in execs: `feedback` batches' worth
+        rotate_every = self.feedback * self.batch_size
+        if rotate_every and self._base_seed is None and \
+                getattr(mut, "seed_bytes", None):
+            self._base_seed = mut.seed_bytes
         while self._remaining(n_iterations) > 0:
+            if (rotate_every and self._corpus and self.stats.iterations
+                    and self.stats.iterations % rotate_every == 0):
+                self._rotate_seed(mut)
             result = self.driver.test_next_input()
             if result is None:  # mutator exhausted (reference -2)
                 INFO_MSG("mutator exhausted after %d iterations",

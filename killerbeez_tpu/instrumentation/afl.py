@@ -85,16 +85,24 @@ class AflInstrumentation(Instrumentation):
                                "process (SIGSTOP/SIGCONT loop)",
         "deferred_startup": "1 = target calls __kb_manual_init() "
                             "itself (skip the pre-main forkserver)",
-        "qemu_mode": "1 = run the target under a QEMU user-mode "
-                     "binary given by qemu_path (binary-only targets)",
-        "qemu_path": "path to an instrumented qemu-user binary",
+        "qemu_mode": "1 = binary-only target: run it under the "
+                     "coverage tracer given by qemu_path (default: "
+                     "the bundled kb-trace ptrace single-stepper; "
+                     "any __AFL_SHM_ID-honoring emulator works)",
+        "qemu_path": "emulator/tracer binary for qemu_mode (default "
+                     "native/build/kb-trace)",
         "timeout": "seconds before an exec counts as a hang "
                    "(default 2.0)",
         "mem_limit": "child address-space limit in MB (0 = none)",
         "preload_forkserver": "1 = LD_PRELOAD the forkserver into an "
                               "uninstrumented target",
-        "device_triage": "1 = batched novelty scan on the TPU "
-                         "(default), 0 = numpy on host",
+        "device_triage": "1 = batched novelty scan on the TPU, 0 = "
+                         "numpy on host (the default: host triage of "
+                         "a 64KB map is ~0.26ms/exec; shipping maps "
+                         "to a REMOTE device measured 20x slower — "
+                         "profiling/profile_host.py — enable only "
+                         "with a locally-attached accelerator and "
+                         "large batches)",
         "ignore_bytes_file": "picker-produced JSON mask of "
                              "nondeterministic bitmap bytes to exclude "
                              "from novelty",
@@ -111,17 +119,26 @@ class AflInstrumentation(Instrumentation):
     DEFAULTS = {"use_fork_server": 1, "persistence_max_cnt": 0,
                 "deferred_startup": 0, "qemu_mode": 0, "timeout": 2.0,
                 "mem_limit": 0, "preload_forkserver": 0,
-                "device_triage": 1, "edges": 0, "workers": 1,
+                "device_triage": 0, "edges": 0, "workers": 1,
                 "modules": 0}
 
     def __init__(self, options: Optional[str] = None):
         super().__init__(options)
         if self.options["qemu_mode"]:
             qemu = self.options.get("qemu_path")
-            if not qemu or not os.path.exists(qemu):
+            if not qemu:
+                # bundled default: the ptrace single-step tracer
+                # (built with the other native artifacts on demand)
+                from ..native.build import build_native, kb_trace_path
+                build_native()
+                qemu = kb_trace_path()
+                self.options["qemu_path"] = qemu
+            if not os.path.exists(qemu):
                 raise ValueError(
-                    "qemu_mode needs qemu_path pointing at a qemu-user "
-                    "binary (none is bundled in this image)")
+                    f"qemu_mode: tracer binary {qemu!r} not found "
+                    "(qemu_path must point at an __AFL_SHM_ID-honoring "
+                    "emulator; the bundled default is "
+                    "native/build/kb-trace)")
         self.virgin_bits = np.full(MAP_SIZE, 0xFF, dtype=np.uint8)
         self.virgin_crash = np.full(MAP_SIZE, 0xFF, dtype=np.uint8)
         self.virgin_tmout = np.full(MAP_SIZE, 0xFF, dtype=np.uint8)
@@ -420,6 +437,11 @@ class AflInstrumentation(Instrumentation):
             sl = self.virgin_bits[m * ps:(m + 1) * ps]
             out[name] = int((sl != 0xFF).sum())
         return out
+
+    def module_map_ranges(self):
+        ps = self._partition_size()
+        return [(name, m * ps, (m + 1) * ps)
+                for m, name in enumerate(self.get_module_info())]
 
     def cleanup(self) -> None:
         if self._target is not None:

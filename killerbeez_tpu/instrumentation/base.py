@@ -145,6 +145,12 @@ class Instrumentation:
         """Names of instrumented modules (per-module coverage)."""
         return []
 
+    def module_map_ranges(self):
+        """[(name, lo, hi)] byte ranges of each module's partition in
+        the raw coverage bitmap (picker/per-module mask derivation);
+        None when the backend has no raw bitmap."""
+        return None
+
     def get_edge_pairs(self, module: Optional[str] = None):
         """(from, to, count) records of the last execution (reference
         instrumentation_edge_t lists); None when unsupported."""

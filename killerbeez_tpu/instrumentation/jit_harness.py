@@ -452,6 +452,10 @@ class JitHarnessInstrumentation(Instrumentation):
             out[name] = int((sl != 0xFF).sum())
         return out
 
+    def module_map_ranges(self):
+        return [(name, m * MAP_SIZE, (m + 1) * MAP_SIZE)
+                for m, name in enumerate(self.program.module_names)]
+
     def get_module_edges(self, module: str
                          ) -> Optional[List[Tuple[int, int]]]:
         """get_edges restricted to one module's slot space, with

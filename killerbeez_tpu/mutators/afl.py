@@ -68,8 +68,9 @@ class AflMutator(Mutator):
         self.det_total = sum(s[2] for s in stages)
         del bits
 
-    def set_input(self, input_bytes: bytes) -> None:
-        super().set_input(input_bytes)
+    def set_input(self, input_bytes: bytes,
+                  keep_length: bool = False) -> None:
+        super().set_input(input_bytes, keep_length)
         self._build_stages()
 
     def get_total_iteration_count(self) -> int:

@@ -75,22 +75,36 @@ class Mutator:
 
     # -- seed management ------------------------------------------------
 
-    def _set_seed_buffer(self, input_bytes: bytes) -> None:
+    def _set_seed_buffer(self, input_bytes: bytes,
+                         keep_length: bool = False) -> None:
         if len(input_bytes) == 0:
             raise ValueError(f"{self.name}: empty seed input")
         self.seed_bytes = input_bytes
-        ratio = float(self.options.get("ratio", 2.0))
-        L = max(int(np.ceil(len(input_bytes) * max(ratio, 1.0))), 8)
-        self.max_length = _round_up(L, 8)  # keep maps/hashes word-aligned
+        if keep_length:
+            # corpus-feedback rotation: the candidate tensor width is
+            # part of every compiled step's shape — keep it stable so
+            # a seed swap costs zero recompiles
+            if len(input_bytes) > self.max_length:
+                raise ValueError(
+                    f"{self.name}: seed ({len(input_bytes)}) exceeds "
+                    f"the fixed buffer ({self.max_length})")
+        else:
+            ratio = float(self.options.get("ratio", 2.0))
+            L = max(int(np.ceil(len(input_bytes) * max(ratio, 1.0))), 8)
+            self.max_length = _round_up(L, 8)  # word-aligned maps
         buf = np.zeros(self.max_length, dtype=np.uint8)
         buf[:len(input_bytes)] = np.frombuffer(input_bytes, dtype=np.uint8)
         self.seed_buf = buf
         self.seed_len = len(input_bytes)
 
-    def set_input(self, input_bytes: bytes) -> None:
+    def set_input(self, input_bytes: bytes,
+                  keep_length: bool = False) -> None:
         """Swap the seed (reference set_input, api_mutator.tex:198-214).
-        Resets the walk position."""
-        self._set_seed_buffer(bytes(input_bytes))
+        Resets the walk position.  ``keep_length`` keeps the candidate
+        buffer width (shape-stable for compiled steps; raises if the
+        new seed doesn't fit)."""
+        self._set_seed_buffer(bytes(input_bytes), keep_length)
+        self._stash = None  # prefetched candidates used the old seed
         self.iteration = 0
 
     # -- iteration bookkeeping -----------------------------------------
@@ -135,10 +149,46 @@ class Mutator:
     def advance(self, n: int) -> None:
         self.iteration += n
 
+    #: (start_iteration, n, bufs, lens) generated ahead of time
+    _stash = None
+    #: True when _generate returns LAZY device arrays (generation and
+    #: transfer overlap other work); eager mutators gain nothing from
+    #: prefetch_batch, so it no-ops for them
+    lazy_batches = False
+
+    def prefetch_batch(self, n: int) -> None:
+        """Generate the NEXT ``n`` candidates now and start their
+        device->host copies WITHOUT advancing the walk — host-exec
+        drivers call this before a batch executes so the following
+        mutate_batch costs zero transfer round-trips (the copies land
+        while the target processes run; ~3 RTTs/batch on a tunneled
+        device otherwise)."""
+        if self.remaining() < n or not self.batch_capable \
+                or not self.lazy_batches:
+            return
+        its = self.peek_iterations(n)
+        bufs, lens = self._generate(its)
+        for arr in (bufs, lens):
+            fn = getattr(arr, "copy_to_host_async", None)
+            if fn is not None:
+                fn()
+        self._stash = (int(its[0]), n, bufs, lens)
+
     def mutate_batch(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
         """Generate the next ``n`` candidates and advance the walk.
         Raises if a finite walk has fewer than ``n`` left — callers
         clamp with ``remaining()``."""
+        if self._stash is not None:
+            start, sn, bufs, lens = self._stash
+            self._stash = None
+            if start == self.iteration and sn == n:
+                self.iteration += n
+                if isinstance(bufs, np.ndarray):
+                    return (np.asarray(bufs, dtype=np.uint8),
+                            np.asarray(lens, dtype=np.int32))
+                import jax.numpy as jnp
+                return bufs.astype(jnp.uint8), lens.astype(jnp.int32)
+            # stale (seed swapped / walk moved): fall through
         its = self.peek_iterations(n)
         bufs, lens = self._generate(its)
         self.iteration += n

@@ -55,7 +55,10 @@ class ManagerMutator(Mutator):
 
     # -- seed handling: parts ------------------------------------------
 
-    def _set_seed_buffer(self, input_bytes: bytes) -> None:
+    def _set_seed_buffer(self, input_bytes: bytes,
+                         keep_length: bool = False) -> None:
+        # keep_length is meaningless for multi-part seeds (each child
+        # sizes its own part buffer); accepted for vtable parity
         try:
             parts = decode_mem_array(input_bytes.decode("ascii"))
             assert isinstance(parts, list) and parts

@@ -22,6 +22,8 @@ from .base import Mutator
 class _KeyedMutator(Mutator):
     """Shared plumbing: iteration index -> per-lane key."""
 
+    lazy_batches = True  # _generate returns lazy device arrays
+
     def _base_key(self) -> jax.Array:
         """The mutator's PRNG root.  fused_spec hands THIS key to the
         fused kernel (which folds in iteration indices exactly like

@@ -25,9 +25,10 @@ _lock = threading.Lock()
 _built = False
 _build_error: Optional[str] = None
 
-_ARTIFACTS = ("libkbexec.so", "kb_rt.o", "libkbpreload.so", "kb-cc")
+_ARTIFACTS = ("libkbexec.so", "kb_rt.o", "libkbpreload.so", "kb-cc",
+              "kb-trace")
 _SOURCES = ("kb_exec.cpp", "kb_rt.c", "kb_preload.c", "kb_cc.c",
-            "kb_protocol.h", "Makefile")
+            "kb_trace.c", "kb_protocol.h", "Makefile")
 
 
 def _stale() -> bool:
@@ -95,3 +96,9 @@ def preload_path() -> str:
 
 def kb_cc_path() -> str:
     return _artifact("kb-cc")
+
+
+def kb_trace_path() -> str:
+    """The bundled binary-only tracer (the QEMU-mode tier's default
+    emulator: forkserver + per-PC SHM coverage over ptrace)."""
+    return _artifact("kb-trace")

@@ -8,9 +8,11 @@ virgin-map union rides an all-gather + AND-fold (bitwise AND has no
 direct psum; De Morgan over a 64KB array is one cheap gather).
 """
 
+from .campaign import ShardedCampaignDriver, parse_mesh_spec
 from .distributed import (
     ShardedFuzzState, make_mesh, make_sharded_fuzz_step, sharded_state_init,
 )
 
 __all__ = ["make_mesh", "make_sharded_fuzz_step", "sharded_state_init",
-           "ShardedFuzzState"]
+           "ShardedFuzzState", "ShardedCampaignDriver",
+           "parse_mesh_spec"]

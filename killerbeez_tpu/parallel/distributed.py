@@ -35,7 +35,9 @@ from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING, MAP_SIZE
 from ..models.vm import Program, _run_batch_impl
 from ..ops.coverage import classify_counts, simplify_trace
 from ..ops.mutate_core import havoc_at
-from ..ops.sparse_coverage import first_occurrence, stream_hash
+from ..ops.sparse_coverage import (
+    _first_occurrence_multi, stream_hash,
+)
 from ..ops.static_triage import counts_by_slot, make_static_maps
 
 
@@ -112,19 +114,27 @@ def _gather_and_fold(v_local, axis):
 
 def make_sharded_fuzz_step(program: Program, mesh: Mesh,
                            batch_per_device: int, max_len: int,
-                           stack_pow2: int = 4):
+                           stack_pow2: int = 4, engine: str = "xla",
+                           interpret: bool = False, seed: int = 0):
     """Build the jitted multi-chip fuzz step.
 
     Returns ``step(state, seed_buf, seed_len, base_it) ->
-    (state', statuses[B], new_paths[B], candidates[B, L], lengths[B])``
-    where B = batch_per_device * n_dp, candidates dp-sharded, virgin
-    maps mp-sharded. ``base_it`` is the global iteration counter the
-    per-lane PRNG keys fold in.
+    (state', statuses[B], new_paths[B], uc[B], uh[B], exit_codes[B],
+    candidates[B, L], lengths[B])`` where B = batch_per_device *
+    n_dp, candidates dp-sharded, virgin maps mp-sharded. ``base_it``
+    is the global iteration counter the per-lane PRNG keys fold in.
+
+    ``engine``: "xla" (batched one-hot engine), "pallas" (VMEM VM
+    kernel under shard_map), or "pallas_fused" (mutation fused into
+    the kernel).  ``interpret`` routes pallas through interpret mode
+    (CPU-mesh tests).  ``seed`` is the campaign PRNG root.
     """
     n_dp = mesh.shape["dp"]
     n_mp = mesh.shape["mp"]
     if program.map_size % n_mp:
         raise ValueError("mp must divide the program's map size")
+    if engine not in ("xla", "pallas", "pallas_fused"):
+        raise ValueError(f"unknown engine {engine!r}")
     slice_size = program.map_size // n_mp
     instrs = jnp.asarray(program.instrs)
     edge_table = jnp.asarray(program.edge_table)
@@ -133,6 +143,26 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
     eidx_all = jnp.asarray(eidx_np)
     outside_all = jnp.asarray(outside_np)
     u_max = u_loc_np.shape[1]
+
+    def _exec_pallas(bufs, lens):
+        """Local-batch pallas execution (padded to the lane tile
+        with dup-lane-0 coverage no-ops, sliced back)."""
+        from ..ops.vm_kernel import LANE_TILE, run_batch_pallas
+        b = bufs.shape[0]
+        pad = (-b) % LANE_TILE
+        if pad:
+            bufs = jnp.concatenate(
+                [bufs, jnp.repeat(bufs[:1], pad, axis=0)], axis=0)
+            lens = jnp.concatenate([lens, jnp.repeat(lens[:1], pad)])
+        res = run_batch_pallas(instrs, edge_table, bufs, lens,
+                               program.mem_size, program.max_steps,
+                               program.n_edges, interpret=interpret)
+        if pad:
+            res = res._replace(
+                status=res.status[:b], exit_code=res.exit_code[:b],
+                counts=res.counts[:b], steps=res.steps[:b],
+                path_hash=res.path_hash[:b])
+        return res
 
     def local_step(vb, vc, vh, seed_buf, seed_len, base_it):
         # ---- which shard am I ----
@@ -145,19 +175,50 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         # ---- mutate: per-global-lane keys (mesh-shape independent) ----
         lane = (dp_i.astype(jnp.uint32) * batch_per_device
                 + jnp.arange(batch_per_device, dtype=jnp.uint32))
-        base = jax.random.key(0)
+        base = jax.random.key(seed)
         keys = jax.vmap(
             lambda l: jax.random.fold_in(
                 jax.random.fold_in(base, base_it.astype(jnp.uint32)), l)
         )(lane)
-        bufs, lens = jax.vmap(
-            lambda k: havoc_at(seed_buf, seed_len, k,
-                               stack_pow2=stack_pow2))(keys)
-
-        # ---- execute (batched one-hot engine) ----
-        res = _run_batch_impl(instrs, edge_table, bufs, lens,
-                              program.mem_size, program.max_steps,
-                              program.n_edges, False)
+        if engine == "pallas_fused":
+            # mutation AND execution in one kernel per dp shard
+            from ..ops.vm_kernel import (
+                LANE_TILE, fuzz_batch_pallas, havoc_words_for_keys,
+            )
+            pad = (-batch_per_device) % LANE_TILE
+            if pad:
+                keys_p = jnp.concatenate(
+                    [keys, jnp.repeat(keys[:1], pad, axis=0)], axis=0)
+            else:
+                keys_p = keys
+            words = havoc_words_for_keys(keys_p, stack_pow2)
+            sb = seed_buf
+            if sb.shape[-1] < max_len:
+                sb = jnp.pad(sb, (0, max_len - sb.shape[-1]))
+            res, bufs, lens = fuzz_batch_pallas(
+                instrs, edge_table, sb, seed_len, words,
+                program.mem_size, program.max_steps, program.n_edges,
+                stack_pow2=stack_pow2, interpret=interpret)
+            if pad:
+                res = res._replace(
+                    status=res.status[:batch_per_device],
+                    exit_code=res.exit_code[:batch_per_device],
+                    counts=res.counts[:batch_per_device],
+                    steps=res.steps[:batch_per_device],
+                    path_hash=res.path_hash[:batch_per_device])
+                bufs = bufs[:batch_per_device]
+                lens = lens[:batch_per_device]
+        else:
+            bufs, lens = jax.vmap(
+                lambda k: havoc_at(seed_buf, seed_len, k,
+                                   stack_pow2=stack_pow2))(keys)
+            if engine == "pallas":
+                res = _exec_pallas(bufs, lens)
+            else:
+                res = _run_batch_impl(instrs, edge_table, bufs, lens,
+                                      program.mem_size,
+                                      program.max_steps,
+                                      program.n_edges, False)
         statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
                              res.status)
 
@@ -169,26 +230,40 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
 
         # ---- local novelty (vs my virgin slice, gathered at my
         # u-slots; padded columns read 0 = never novel) ----
-        vloc = jnp.where(u_loc < slice_size,
-                         vb[jnp.clip(u_loc, 0, slice_size - 1)],
-                         jnp.uint8(0))
-        new_count = jnp.any((cls & vloc[None, :]) != 0, axis=1)
-        new_tuple = jnp.any((cls != 0) & (vloc[None, :] == 0xFF),
-                            axis=1)
-        local_ret = jnp.where(new_tuple, 2,
-                              jnp.where(new_count, 1, 0)).astype(jnp.int32)
-        # a lane is new if ANY map shard saw novelty: max over mp
-        rets = jax.lax.pmax(local_ret, "mp")
+        def novelty(virgin, classes):
+            vloc = jnp.where(u_loc < slice_size,
+                             virgin[jnp.clip(u_loc, 0, slice_size - 1)],
+                             jnp.uint8(0))
+            new_count = jnp.any((classes & vloc[None, :]) != 0, axis=1)
+            new_tuple = jnp.any((classes != 0) &
+                                (vloc[None, :] == 0xFF), axis=1)
+            local = jnp.where(new_tuple, 2,
+                              jnp.where(new_count, 1, 0)
+                              ).astype(jnp.int32)
+            # a lane is new if ANY map shard saw novelty: max over mp
+            return jax.lax.pmax(local, "mp")
+
+        crash = statuses == FUZZ_CRASH
+        hang = statuses == FUZZ_HANG
+        rets = novelty(vb, cls)
+        crash_rets = novelty(vc, simp)
+        hang_rets = novelty(vh, simp)
 
         # in-batch dedup by full-map hash: shard hashes combined by
         # psum; first occurrence within my dp shard's batch (sort-
         # based — the pairwise matrix is O(B^2) and dominates beyond
-        # B~8k, sparse_coverage.first_occurrence)
+        # B~8k, sparse_coverage.first_occurrence).  NOTE the dedup is
+        # per-dp-shard: two chips hitting the same new path in the
+        # same step BOTH report it (the dp virgin AND-fold makes this
+        # self-correct next step — persistence-style over-report,
+        # never under-report; pinned by tests, see docs/USAGE.md)
         slice_hash = stream_hash(cls.astype(jnp.uint32))
         full_hash = jax.lax.psum(slice_hash, "mp")
-        first = first_occurrence(
-            full_hash, jnp.ones((batch_per_device,), bool))
+        first, first_crash, first_hang = _first_occurrence_multi(
+            full_hash, crash, hang)
         rets = jnp.where(first, rets, 0)
+        uc = first_crash & (crash_rets > 0)
+        uh = first_hang & (hang_rets > 0)
 
         # ---- virgin updates: clear my slice with new lanes' bits
         # (scatter at my u-slots; crash/hang maps also clear the
@@ -204,8 +279,6 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
             out = virgin & ~outside_mask
             return out.at[u_loc].set(cur & ~seen_u, mode="drop")
 
-        crash = statuses == FUZZ_CRASH
-        hang = statuses == FUZZ_HANG
         zero_out = jnp.zeros_like(outside)
         vb2 = clear(vb, fold_new(cls, rets > 0), zero_out)
         vc2 = clear(vc, fold_new(simp, crash),
@@ -217,13 +290,14 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         vb2 = _gather_and_fold(vb2, "dp")
         vc2 = _gather_and_fold(vc2, "dp")
         vh2 = _gather_and_fold(vh2, "dp")
-        return vb2, vc2, vh2, statuses, rets, bufs, lens
+        return (vb2, vc2, vh2, statuses, rets, uc, uh,
+                res.exit_code, bufs, lens)
 
     sharded = shard_map(
         local_step, mesh=mesh,
         in_specs=(P("mp"), P("mp"), P("mp"), P(), P(), P()),
         out_specs=(P("mp"), P("mp"), P("mp"), P("dp"), P("dp"),
-                   P("dp", None), P("dp")),
+                   P("dp"), P("dp"), P("dp"), P("dp", None), P("dp")),
         check_vma=False,
     )
 
@@ -241,10 +315,12 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         if seed_buf.shape[-1] < max_len:  # trace-time pad to max_len
             seed_buf = jnp.pad(seed_buf,
                                (0, max_len - seed_buf.shape[-1]))
-        vb, vc, vh, statuses, rets, bufs, lens = sharded(
+        (vb, vc, vh, statuses, rets, uc, uh, exit_codes, bufs,
+         lens) = sharded(
             state.virgin_bits, state.virgin_crash, state.virgin_tmout,
             seed_buf, seed_len, base_it)
         new_state = ShardedFuzzState(vb, vc, vh, state.step + 1)
-        return new_state, statuses, rets, bufs, lens
+        return (new_state, statuses, rets, uc, uh, exit_codes, bufs,
+                lens)
 
     return step

@@ -117,11 +117,33 @@ def main(argv: Optional[List[str]] = None) -> int:
             "nondeterministic_bytes": int(mask.sum()),
             "ignore_bytes": encode_array(mask),
         }
+        # per-module report (reference picker/main.c:163-282 walks
+        # modules): classification + partition-LOCAL ignore mask per
+        # module; the top-level full-map mask stays the
+        # ignore_bytes_file consumption format
+        ranges = instrumentation.module_map_ranges()
+        if ranges and len(ranges) > 1:  # single module: the top-level
+            # fields ARE the per-module report; don't duplicate 64KB
+            mods: Dict[str, object] = {}
+            for name, lo, hi in ranges:
+                sub = traces[:, :, lo:hi]
+                sub_mask = derive_ignore_mask(sub)
+                mods[name] = {
+                    "classification": classify_target(sub),
+                    "nondeterministic_bytes": int(sub_mask.sum()),
+                    "ignore_bytes": encode_array(sub_mask),
+                    "range": [int(lo), int(hi)],
+                }
+            report["modules"] = mods
         write_buffer_to_file(args.output,
                              json.dumps(report).encode())
         INFO_MSG("target is %s; %d nondeterministic bitmap bytes -> %s",
                  report["classification"],
                  report["nondeterministic_bytes"], args.output)
+        for name, m in (report.get("modules") or {}).items():
+            INFO_MSG("  module %s: %s, %d nondeterministic bytes",
+                     name, m["classification"],
+                     m["nondeterministic_bytes"])
         driver.cleanup()
         instrumentation.cleanup()
         return 0

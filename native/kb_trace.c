@@ -1,0 +1,302 @@
+/* kb_trace — binary-only coverage tracer (the QEMU-mode tier).
+ *
+ * The reference fuzzes uninstrumented binaries by running them under
+ * a patched QEMU user-mode emulator that logs translated-block edges
+ * into the AFL SHM bitmap and acts as the forkserver
+ * (SURVEY.md §2.5, reference afl_progs/qemu_mode/ +
+ * afl-qemu-cpu-inl.h semantics).  This is the same capability built
+ * on ptrace instead of an emulator: kb_trace IS the forkserver
+ * (protocol in kb_protocol.h, fds 198/199), forks the target under
+ * PTRACE_TRACEME, single-steps it, and hashes every program-counter
+ * transition into the __AFL_SHM_ID bitmap with the AFL edge
+ * encoding (cur ^ prev, prev = cur >> 1).
+ *
+ * Trade-offs vs the reference's QEMU tier, documented honestly:
+ *   + zero target cooperation: works on any ELF the kernel can run,
+ *     no compile-time instrumentation, no emulator build;
+ *   + real syscalls/signals (no emulation gaps);
+ *   - single-stepping costs ~2 context switches per instruction —
+ *     orders slower than QEMU block translation; this tier is for
+ *     triage and coverage of small binary-only targets, not
+ *     throughput fuzzing (the jit_harness/afl tiers are);
+ *   - per-instruction (not per-block) granularity: slot density is
+ *     higher than compiled-in edge logging; within-tier novelty is
+ *     consistent, cross-tier maps are not comparable.
+ *
+ * ASLR: the child runs under ADDR_NO_RANDOMIZE, so PCs (and
+ * therefore bitmap slots) are stable across execs of one campaign —
+ * the property coverage merging needs.
+ *
+ * Usage: kb_trace TARGET [ARGS...]  (the fuzzer prepends this via
+ * the afl instrumentation's qemu_mode/qemu_path options).
+ */
+#define _GNU_SOURCE
+#include <elf.h>
+#include <errno.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/personality.h>
+#include <sys/ptrace.h>
+#include <sys/shm.h>
+#include <sys/types.h>
+#include <sys/uio.h>
+#include <sys/user.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#define KB_FORKSERVER_IMPL_NOT_USED /* we implement our own loop */
+#include "kb_protocol.h"
+
+static unsigned char kb_local_map[KB_SHM_TOTAL];
+static unsigned char *kb_map = kb_local_map;
+
+/* Guard against runaway children when no fuzzer is attached to kill
+ * them (the fuzzer's own hang timeout is the primary mechanism). */
+#define KB_MAX_STEPS (1u << 26)
+
+static void kb_attach_shm(void) {
+  const char *id_str = getenv(KB_SHM_ENV);
+  if (!id_str) return;
+  void *addr = shmat(atoi(id_str), NULL, 0);
+  if (addr != (void *)-1) kb_map = (unsigned char *)addr;
+}
+
+static uintptr_t kb_read_pc(pid_t pid) {
+#if defined(__x86_64__)
+  struct user_regs_struct regs;
+  if (ptrace(PTRACE_GETREGS, pid, NULL, &regs) != 0) return 0;
+  return (uintptr_t)regs.rip;
+#elif defined(__aarch64__)
+  struct user_regs_struct regs;
+  struct iovec iov = {&regs, sizeof regs};
+  if (ptrace(PTRACE_GETREGSET, pid, (void *)NT_PRSTATUS, &iov) != 0)
+    return 0;
+  return (uintptr_t)regs.pc;
+#else
+#error "kb_trace: unsupported architecture"
+#endif
+}
+
+/* Same PC mixer as kb_rt.c's compiled-in hook — per-instruction here
+ * instead of per-edge-callback there. */
+static inline unsigned kb_slot(uintptr_t pc) {
+  uintptr_t h = pc;
+  h ^= h >> 33;
+  h *= 0xff51afd7ed558ccdULL;
+  h ^= h >> 29;
+  return (unsigned)(h & (KB_MAP_SIZE - 1));
+}
+
+/* ---- skip-to-entry: the dynamic loader + libc init are millions of
+ * instructions; stepping them per exec cost ~8s.  Plant a breakpoint
+ * at the target ELF's entry point, PTRACE_CONT to it at full speed,
+ * and single-step only from there (QEMU's translation cache plays
+ * the same role for the reference's tier).  Any failure falls back
+ * to stepping everything. ---- */
+
+static uintptr_t kb_image_base(pid_t pid, const char *real) {
+  char mp[64], line[512];
+  snprintf(mp, sizeof mp, "/proc/%d/maps", (int)pid);
+  FILE *f = fopen(mp, "r");
+  uintptr_t base = 0;
+  while (f && fgets(line, sizeof line, f)) {
+    unsigned long lo, hi;
+    char path[384];
+    path[0] = 0;
+    if (sscanf(line, "%lx-%lx %*s %*s %*s %*s %383s",
+               &lo, &hi, path) >= 2 && !strcmp(path, real)) {
+      base = lo;
+      break; /* lowest mapping of the image */
+    }
+  }
+  if (f) fclose(f);
+  return base;
+}
+
+static uintptr_t kb_entry_addr(pid_t pid, const char *target) {
+  char real[512];
+  if (!realpath(target, real)) return 0;
+  FILE *f = fopen(real, "rb");
+  if (!f) return 0;
+  Elf64_Ehdr eh;
+  size_t n = fread(&eh, 1, sizeof eh, f);
+  fclose(f);
+  if (n != sizeof eh || memcmp(eh.e_ident, ELFMAG, SELFMAG) != 0 ||
+      eh.e_ident[EI_CLASS] != ELFCLASS64)
+    return 0;
+  if (eh.e_type == ET_EXEC) return (uintptr_t)eh.e_entry;
+  if (eh.e_type != ET_DYN) return 0;
+  uintptr_t base = kb_image_base(pid, real);
+  return base ? base + (uintptr_t)eh.e_entry : 0;
+}
+
+#if defined(__x86_64__)
+#define KB_BP_WORD(orig) (((orig) & ~0xFFUL) | 0xCCUL) /* int3 */
+#define KB_BP_PC_REWIND 1 /* int3 leaves pc past the trap byte */
+#elif defined(__aarch64__)
+#define KB_BP_WORD(orig) \
+  (((orig) & ~0xFFFFFFFFUL) | 0xD4200000UL) /* brk #0 */
+#define KB_BP_PC_REWIND 0
+#endif
+
+static void kb_set_pc(pid_t pid, uintptr_t pc) {
+#if defined(__x86_64__)
+  struct user_regs_struct regs;
+  if (ptrace(PTRACE_GETREGS, pid, NULL, &regs) != 0) return;
+  regs.rip = pc;
+  ptrace(PTRACE_SETREGS, pid, NULL, &regs);
+#elif defined(__aarch64__)
+  struct user_regs_struct regs;
+  struct iovec iov = {&regs, sizeof regs};
+  if (ptrace(PTRACE_GETREGSET, pid, (void *)NT_PRSTATUS, &iov) != 0)
+    return;
+  regs.pc = pc;
+  ptrace(PTRACE_SETREGSET, pid, (void *)NT_PRSTATUS, &iov);
+#endif
+}
+
+/* Returns 0 if the child is stopped and ready for stepping (at entry
+ * or, on any fallback, wherever it already was), or sets *status_out
+ * and returns 1 if the child terminated while getting there. */
+static int kb_run_to_entry(pid_t pid, const char *target,
+                           int *status_out) {
+  errno = 0;
+  uintptr_t entry = kb_entry_addr(pid, target);
+  if (!entry) return 0;
+  long orig = ptrace(PTRACE_PEEKTEXT, pid, (void *)entry, NULL);
+  if (orig == -1 && errno) return 0;
+  if (ptrace(PTRACE_POKETEXT, pid, (void *)entry,
+             (void *)KB_BP_WORD((unsigned long)orig)) != 0)
+    return 0;
+  if (ptrace(PTRACE_CONT, pid, NULL, NULL) != 0) return 0;
+  int status;
+  if (waitpid(pid, &status, 0) < 0) return 0;
+  if (WIFEXITED(status) || WIFSIGNALED(status)) {
+    *status_out = status;
+    return 1;
+  }
+  /* restore the original word and re-aim the pc at the entry */
+  ptrace(PTRACE_POKETEXT, pid, (void *)entry, (void *)orig);
+  if (WSTOPSIG(status) == SIGTRAP && KB_BP_PC_REWIND)
+    kb_set_pc(pid, entry);
+  return 0;
+}
+
+static pid_t kb_spawn(char **argv) {
+  pid_t pid = fork();
+  if (pid < 0) return -1;
+  if (pid == 0) {
+    close(KB_FORKSRV_FD);
+    close(KB_STATUS_FD);
+    personality(ADDR_NO_RANDOMIZE); /* stable PCs -> stable slots */
+    if (ptrace(PTRACE_TRACEME, 0, NULL, NULL) != 0) _exit(124);
+    execvp(argv[0], argv);
+    _exit(125); /* exec failed */
+  }
+  /* child stops with SIGTRAP at the execvp boundary */
+  int status;
+  if (waitpid(pid, &status, 0) < 0 || !WIFSTOPPED(status)) {
+    if (pid > 0) kill(pid, SIGKILL);
+    return -1;
+  }
+  return pid;
+}
+
+/* Single-step `pid` to completion, filling the bitmap.  Returns the
+ * final wait status (exit or fatal signal). */
+static int kb_step_loop(pid_t pid, const char *target) {
+  unsigned prev = 0;
+  int status = 0;
+  int deliver = 0;
+  if (kb_run_to_entry(pid, target, &status)) return status;
+  for (unsigned n = 0; n < KB_MAX_STEPS; n++) {
+    if (ptrace(PTRACE_SINGLESTEP, pid, NULL,
+               (void *)(uintptr_t)deliver) != 0) {
+      /* child vanished (e.g. fuzzer SIGKILLed it on hang timeout) */
+      waitpid(pid, &status, 0);
+      return status;
+    }
+    if (waitpid(pid, &status, 0) < 0) return status;
+    if (WIFEXITED(status) || WIFSIGNALED(status)) return status;
+    if (!WIFSTOPPED(status)) return status;
+    int sig = WSTOPSIG(status);
+    if (sig == SIGTRAP) {
+      deliver = 0;
+      unsigned cur = kb_slot(kb_read_pc(pid));
+      kb_map[cur ^ prev]++;
+      prev = cur >> 1;
+    } else {
+      /* deliver the real signal; default dispositions (SIGSEGV...)
+       * then terminate the child and we report that status */
+      deliver = sig;
+    }
+  }
+  kill(pid, SIGKILL); /* runaway: no fuzzer attached to time it out */
+  waitpid(pid, &status, 0);
+  return status;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s target [args...]\n", argv[0]);
+    return 2;
+  }
+  kb_attach_shm();
+
+  uint32_t hello = KB_HELLO;
+  if (write(KB_STATUS_FD, &hello, 4) != 4) {
+    /* no fuzzer attached: trace one run, report coverage, propagate */
+    pid_t pid = kb_spawn(argv + 1);
+    if (pid < 0) return 2;
+    int status = kb_step_loop(pid, argv[1]);
+    unsigned touched = 0;
+    for (unsigned i = 0; i < KB_MAP_SIZE; i++) touched += kb_map[i] != 0;
+    fprintf(stderr, "kb_trace: %u bitmap slots touched\n", touched);
+    if (WIFSIGNALED(status)) {
+      raise(WTERMSIG(status));
+      return 128 + WTERMSIG(status);
+    }
+    return WIFEXITED(status) ? WEXITSTATUS(status) : 2;
+  }
+
+  pid_t child = -1;
+  for (;;) {
+    unsigned char cmd;
+    if (read(KB_FORKSRV_FD, &cmd, 1) != 1) _exit(0);
+    switch (cmd) {
+      case KB_CMD_EXIT:
+        if (child > 0) kill(child, SIGKILL);
+        _exit(0);
+
+      case KB_CMD_FORK:
+      case KB_CMD_FORK_RUN: {
+        child = kb_spawn(argv + 1);
+        int32_t pid32 = (int32_t)child;
+        if (write(KB_STATUS_FD, &pid32, 4) != 4) _exit(1);
+        if (child < 0) _exit(1);
+        break;
+      }
+
+      case KB_CMD_RUN:
+        /* stepping happens under GET_STATUS (the fuzzer's wait
+         * point); the child stays stopped until then */
+        break;
+
+      case KB_CMD_GET_STATUS: {
+        int32_t st32 = -1;
+        if (child > 0) {
+          st32 = (int32_t)kb_step_loop(child, argv[1]);
+          child = -1;
+        }
+        if (write(KB_STATUS_FD, &st32, 4) != 4) _exit(1);
+        break;
+      }
+
+      default:
+        _exit(2);
+    }
+  }
+}

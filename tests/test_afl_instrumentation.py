@@ -6,6 +6,7 @@ one-bit-away seed, state round-trip and merge).
 """
 
 import json
+import os
 
 import numpy as np
 import pytest
@@ -156,6 +157,14 @@ def test_no_forkserver_mode(corpus_bin):
     instr.cleanup()
 
 
-def test_qemu_mode_gated():
+def test_qemu_mode_defaults_to_bundled_tracer():
+    """qemu_mode without qemu_path resolves to the bundled kb-trace
+    binary-only tracer (built on demand); an explicit nonexistent
+    path still fails loudly."""
+    instr = instrumentation_factory("afl", json.dumps({"qemu_mode": 1}))
+    assert instr.options["qemu_path"].endswith("kb-trace")
+    assert os.path.exists(instr.options["qemu_path"])
+    instr.cleanup()
     with pytest.raises(ValueError, match="qemu"):
-        instrumentation_factory("afl", json.dumps({"qemu_mode": 1}))
+        instrumentation_factory("afl", json.dumps(
+            {"qemu_mode": 1, "qemu_path": "/nonexistent"}))

@@ -154,3 +154,18 @@ def test_end_to_end_job_lifecycle(server, corpus_bin, tmp_path):
     assert "description" in info
     _, full = req(server, f"/api/job/{job['id']}")
     assert full["status"] == "done"
+
+
+def test_verify_repro_marks_network_findings_unverified():
+    """VERDICT weak #5 pinned: a network-delivered crash cannot be
+    replayed without the live session — its result row must carry an
+    explicit verified=None marker (with the reason), never silently
+    omit verification."""
+    from killerbeez_tpu.manager.worker import verify_repro
+    job = {"instrumentation": "return_code",
+           "driver": "network_server",
+           "driver_opts": json.dumps({"path": "/bin/true",
+                                      "port": 7000})}
+    info = verify_repro(job, b"\x01\x02\x03")
+    assert info["verified"] is None
+    assert "not replayable" in info["reason"]

@@ -210,20 +210,48 @@ def test_afl_workers_option(corpus_bin):
     instr.cleanup()
 
 
-@pytest.mark.skipif(not os.environ.get("KB_QEMU_PATH"),
-                    reason="set KB_QEMU_PATH to an instrumented "
-                           "qemu-user binary to exercise qemu mode")
-def test_qemu_mode(corpus_bin):
-    """Binary-only targets via qemu-user (reference afl_progs
-    qemu_mode): the emulator is prepended to argv and coverage flows
-    through the same SHM contract. Gated: no qemu is bundled in this
-    image (docs/ARCHITECTURE.md out-of-scope note)."""
+def test_qemu_mode_binary_only_coverage(corpus_bin):
+    """Binary-only targets (reference afl_progs qemu_mode): with
+    qemu_mode=1 the UNINSTRUMENTED test-plain binary runs under the
+    bundled kb-trace ptrace tracer, which acts as the forkserver and
+    fills the __AFL_SHM_ID bitmap from single-stepped PCs — crash
+    classification AND coverage novelty with zero target
+    cooperation.  Any other __AFL_SHM_ID-honoring emulator plugs in
+    via qemu_path."""
     from killerbeez_tpu.instrumentation.factory import (
         instrumentation_factory,
     )
-    qemu = os.environ["KB_QEMU_PATH"]
     instr = instrumentation_factory("afl", json.dumps(
-        {"qemu_mode": 1, "qemu_path": qemu, "use_fork_server": 0}))
+        {"qemu_mode": 1}))  # qemu_path defaults to bundled kb-trace
+    try:
+        instr.enable(b"zzzz", cmd_line=corpus_bin("test-plain"))
+        assert instr.get_fuzz_result() == FUZZ_NONE
+        assert instr.is_new_path() > 0        # first exec: coverage
+        first_cov = instr.coverage_bytes()
+        assert first_cov > 100                # real per-PC bitmap
+        instr.enable(b"zzzz", cmd_line=corpus_bin("test-plain"))
+        assert instr.is_new_path() == 0       # same path: nothing new
+        instr.enable(b"ABCD", cmd_line=corpus_bin("test-plain"))
+        assert instr.get_fuzz_result() == FUZZ_CRASH
+        assert instr.last_unique_crash()
+        assert instr.is_new_path() > 0        # crash path differs
+        assert instr.coverage_bytes() > first_cov
+        instr.enable(b"ABCD", cmd_line=corpus_bin("test-plain"))
+        assert instr.get_fuzz_result() == FUZZ_CRASH
+        assert not instr.last_unique_crash()  # same crash shape
+    finally:
+        instr.cleanup()
+
+
+def test_qemu_mode_plain_exec(corpus_bin):
+    """qemu_mode with use_fork_server=0: one tracer process per exec
+    (the reference's -Q without forkserver); verdicts still come
+    from the traced child's status."""
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    instr = instrumentation_factory("afl", json.dumps(
+        {"qemu_mode": 1, "use_fork_server": 0}))
     try:
         instr.enable(b"ABCD", cmd_line=corpus_bin("test-plain"))
         assert instr.get_fuzz_result() == FUZZ_CRASH
@@ -231,3 +259,12 @@ def test_qemu_mode(corpus_bin):
         assert instr.get_fuzz_result() == FUZZ_NONE
     finally:
         instr.cleanup()
+
+
+def test_qemu_mode_rejects_missing_tracer():
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    with pytest.raises(ValueError, match="qemu_mode"):
+        instrumentation_factory("afl", json.dumps(
+            {"qemu_mode": 1, "qemu_path": "/nonexistent/qemu"}))

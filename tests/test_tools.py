@@ -230,3 +230,36 @@ def test_minimize_consumes_pair_files(tmp_path):
     kept, covered = minimize_edge_files([a, b, c], pairs=True)
     assert set(kept) == {a, c}
     assert covered == 4
+
+
+def test_picker_per_module_masks(corpus_bin, tmp_path):
+    """Reference picker walks modules (picker/main.c:163-282): the
+    ndlib fixture's main binary is deterministic while its kb-cc
+    shared library branches on the clock — the per-module report
+    must flag ONLY the library partition, with partition-local
+    masks."""
+    seed = str(tmp_path / "seed")
+    with open(seed, "wb") as f:
+        f.write(b"NQxx")
+    out = str(tmp_path / "mods.json")
+    assert picker_main([
+        "file", "afl", seed, "-o", out, "-n", "6",
+        "-i", '{"modules": 1}',
+        "-d", json.dumps({"path": corpus_bin("ndlib"),
+                          "arguments": "@@"})]) == 0
+    report = json.load(open(out))
+    mods = report["modules"]
+    lib = next(v for k, v in mods.items() if "libnd1" in k)
+    main_mod = next(v for k, v in mods.items() if "ndlib" in k)
+    assert lib["classification"] == "multi_path_same_file"
+    assert lib["nondeterministic_bytes"] > 0
+    assert main_mod["classification"] in ("single_path",
+                                          "path_per_file")
+    assert main_mod["nondeterministic_bytes"] == 0
+    # partition-local mask width and placement
+    lo, hi = lib["range"]
+    assert decode_array(lib["ignore_bytes"]).shape == (hi - lo,)
+    # the full-map mask's nonzero bytes all fall inside lib's range
+    full = decode_array(report["ignore_bytes"])
+    nz = np.flatnonzero(full)
+    assert len(nz) and (nz >= lo).all() and (nz < hi).all()

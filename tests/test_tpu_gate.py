@@ -1,0 +1,137 @@
+"""TPU-hardware-gated Pallas regression tests (round-2 verdict item
+4): all other kernel tests run in interpret mode on CPU, so a
+Mosaic-lowering regression — the most fragile artifact in the repo —
+would pass CI green.  These tests re-drive the real lowering whenever
+a TPU is reachable and SKIP (visibly) when it is not.
+
+The suite's conftest pins this process to a virtual CPU mesh, so the
+on-chip checks run in a clean subprocess with the test platform
+forcing stripped; the subprocess reports JSON on its last stdout
+line.
+
+Checks (the documented pre-commit ritual for kernel changes):
+  (a) run_batch_pallas and fuzz_batch_pallas COMPILE on the chip;
+  (b) bit-parity vs the XLA engine across every result field;
+  (c) a conservative throughput floor on the flagship target, so a
+      pathological-but-compiling regression (e.g. a relayout in the
+      step loop) still fails loudly.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# conservative: the flagship product path measures ~1.1M execs/s on a
+# v5e chip; tunnel jitter and compile-cache misses included, anything
+# under this floor means the kernel regressed, not the environment
+FLOOR_EXECS_PER_SEC = 150_000.0
+
+_SUBPROCESS_CODE = r"""
+import json, sys, time
+import jax
+try:
+    devs = jax.devices()
+except Exception as e:
+    print(json.dumps({"skip": f"no devices: {e}"})); sys.exit(0)
+if not devs or devs[0].platform != "tpu":
+    print(json.dumps({"skip": f"no TPU ({devs and devs[0].platform})"}))
+    sys.exit(0)
+
+import numpy as np
+import jax.numpy as jnp
+from killerbeez_tpu.models import targets, targets_cgc
+from killerbeez_tpu.models.vm import _run_batch_impl
+from killerbeez_tpu.ops.vm_kernel import (
+    LANE_TILE, fuzz_batch_pallas_2phase, havoc_words, run_batch_pallas,
+)
+
+prog = targets.get_target("tlvstack_vm")
+seed = targets_cgc.tlvstack_vm_seed()
+L = max(8, ((len(seed) + 7) // 8) * 8)
+sb = np.zeros(L, np.uint8); sb[:len(seed)] = np.frombuffer(seed, np.uint8)
+ins, tbl = jnp.asarray(prog.instrs), jnp.asarray(prog.edge_table)
+sbj, slj = jnp.asarray(sb), jnp.int32(len(seed))
+FIELDS = ("status", "exit_code", "counts", "steps", "path_hash")
+
+# (a)+(b) fused kernel (two-phase, the product default) vs XLA engine
+B = 4 * LANE_TILE
+words = havoc_words(jax.random.fold_in(jax.random.key(0), 42), B)
+res, bufs, lens = fuzz_batch_pallas_2phase(
+    ins, tbl, sbj, slj, words, prog.mem_size, prog.max_steps,
+    prog.n_edges, phase1_steps=-1)
+ref = _run_batch_impl(ins, tbl, bufs, lens, prog.mem_size,
+                      prog.max_steps, prog.n_edges, False)
+for f in FIELDS:
+    a, b = np.asarray(getattr(ref, f)), np.asarray(getattr(res, f))
+    if not np.array_equal(a, b):
+        print(json.dumps({"error": f"fused kernel parity: {f} diverged "
+                          f"({int((a != b).sum())} lanes)"}))
+        sys.exit(0)
+
+# (b) plain VM kernel parity on the same mutants
+out = run_batch_pallas(ins, tbl, bufs, lens, prog.mem_size,
+                       prog.max_steps, prog.n_edges)
+for f in FIELDS:
+    a, b = np.asarray(getattr(ref, f)), np.asarray(getattr(out, f))
+    if not np.array_equal(a, b):
+        print(json.dumps({"error": f"vm kernel parity: {f} diverged"}))
+        sys.exit(0)
+
+# (c) throughput floor, steady-state (compiles are already cached)
+Bf = 16384
+wsteps = 10
+ws = [havoc_words(jax.random.fold_in(jax.random.key(0), i), Bf)
+      for i in range(wsteps + 1)]
+jax.block_until_ready(ws)
+r = fuzz_batch_pallas_2phase(ins, tbl, sbj, slj, ws[0], prog.mem_size,
+                             prog.max_steps, prog.n_edges,
+                             phase1_steps=-1)
+jax.block_until_ready(r[0].status)
+t0 = time.time()
+for i in range(1, wsteps + 1):
+    r = fuzz_batch_pallas_2phase(ins, tbl, sbj, slj, ws[i],
+                                 prog.mem_size, prog.max_steps,
+                                 prog.n_edges, phase1_steps=-1)
+jax.block_until_ready(r[0].status)
+rate = Bf * wsteps / (time.time() - t0)
+print(json.dumps({"ok": True, "execs_per_sec": rate,
+                  "device": str(devs[0])}))
+"""
+
+
+def _run_on_chip():
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS",)}
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, "-c", _SUBPROCESS_CODE], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=560)
+    last = (r.stdout.strip().splitlines() or ["{}"])[-1]
+    try:
+        return json.loads(last), r
+    except json.JSONDecodeError:
+        return {"error": f"no report (rc={r.returncode}): "
+                         f"{r.stderr[-400:]}"}, r
+
+
+def test_pallas_kernels_on_real_tpu():
+    report, proc = _run_on_chip()
+    if "skip" in report:
+        pytest.skip(f"no TPU reachable: {report['skip']}")
+    assert "error" not in report, report.get("error")
+    assert report.get("ok"), f"on-chip run failed: {proc.stderr[-400:]}"
+    assert report["execs_per_sec"] >= FLOOR_EXECS_PER_SEC, (
+        f"fused kernel at {report['execs_per_sec']:.0f} execs/s — "
+        f"below the {FLOOR_EXECS_PER_SEC:.0f} regression floor "
+        f"on {report['device']}")

@@ -1,15 +1,19 @@
 """Benchmark — the BASELINE.md reproduction matrix.
 
-Emits one JSON line per config (configs 1-5 from BASELINE.md), then
-the headline line LAST (the driver records the final line):
+Emits one JSON line per config, then the headline line LAST (the
+driver records the final line):
 
-  1 host file+return_code+bit_flip sanity (reference ~180 execs/s)
-  2 host stdin+afl forkserver, single instance (reference ~1k)
-  3 TPU-batch mutation + host forkserver pool (afl workers=N)
-  4 fused on-device path (jit_harness) on the toy `test` target
-  5 multichip CPU-mesh correctness smoke (virtual 8-device mesh)
-  H fused on-device path on the CGC-grade flagship (tlvstack_vm,
-    110 blocks) — the headline metric
+  1  host file+return_code+bit_flip sanity (reference ~180 execs/s)
+  2  host stdin+afl forkserver, single instance (reference ~1k;
+     steady state after warmup)
+  3  TPU-batch mutation + host forkserver pool (afl workers=N)
+  4  fused on-device path on the toy `test` target
+  5  multichip CPU-mesh correctness smoke (virtual 8-device mesh)
+  4b flagship tlvstack_vm on the XLA engine (pallas-less floor)
+  4c imgparse_vm, fused pallas + two-phase
+  4d the PRODUCT CLI loop (file+jit_harness+havoc, pallas_fused)
+  H  fused pallas + two-phase on the CGC-grade flagship
+     (tlvstack_vm, 110 blocks) — the headline metric
 
 Native configs degrade to {"skipped": ...} rows when the host
 toolchain or corpus build is unavailable.
@@ -273,12 +277,12 @@ step = make_sharded_fuzz_step(prog, mesh, batch_per_device=64, max_len=32)
 state = sharded_state_init(mesh, prog.map_size)
 seed = targets_cgc.tlvstack_vm_seed()
 buf = np.zeros(32, np.uint8); buf[:len(seed)] = np.frombuffer(seed, np.uint8)
-state, st, rets, uc, uh, ec, bufs, lens = step(
+state, st, rets, uc, uh, ec, bufs, lens, _c = step(
     state, jnp.asarray(buf), jnp.int32(len(seed)), jnp.int32(0))
 jax.block_until_ready(state.virgin_bits)
 t0 = time.time(); N = 5
 for i in range(1, N + 1):
-    state, st, rets, uc, uh, ec, bufs, lens = step(
+    state, st, rets, uc, uh, ec, bufs, lens, _c = step(
         state, jnp.asarray(buf), jnp.int32(len(seed)), jnp.int32(i))
 jax.block_until_ready(state.virgin_bits)
 dt = time.time() - t0
@@ -336,7 +340,10 @@ def main():
              error=str(e)[:200])
 
     try:
-        vc_, st = bench_cli_product("tlvstack_vm", 16384, 20,
+        # 32k lanes/batch: fewer host round-trips per exec — the
+        # tunnel's RTT fluctuates and this is the config least
+        # hostage to it (939k measured healthy, ~400k degraded)
+        vc_, st = bench_cli_product("tlvstack_vm", 32768, 20,
                                     targets_cgc.tlvstack_vm_seed())
         emit("4d", "PRODUCT CLI loop (file+jit_harness+havoc, "
              "pallas_fused) on tlvstack_vm", vc_,

@@ -100,7 +100,7 @@ class Driver:
     # -- batched --------------------------------------------------------
 
     def test_batch(self, n: int, pad_to: Optional[int] = None,
-                   prefetch_next: bool = True) -> BatchOutcome:
+                   prefetch_next=True) -> BatchOutcome:
         """Mutate + execute ``n`` candidates. ``pad_to`` keeps the lane
         dimension shape-stable across tail batches (no XLA recompile):
         device backends get the input tensor padded with copies of
@@ -108,8 +108,9 @@ class Driver:
         free), host backends execute only the ``n`` real lanes and pad
         the result arrays instead (a padded lane would cost a real
         fork+exec). Callers triage only the first ``n`` lanes.
-        ``prefetch_next=False`` (the loop's final batch) skips
-        generating a follow-up batch that would never run."""
+        ``prefetch_next``: size of the FOLLOWING batch (host drivers
+        pre-generate exactly that many lanes during this batch's
+        execs); 0/False skips, True means "same as n"."""
         if not self.supports_batch:
             raise RuntimeError(f"{self.name}: batch path unavailable")
         wants_fused = getattr(self.instrumentation, "wants_fused", None)
@@ -149,7 +150,8 @@ class Driver:
             # generate the NEXT batch now: its device->host copies
             # land while this batch's target processes execute
             if prefetch_next:
-                self.mutator.prefetch_batch(n)
+                self.mutator.prefetch_batch(
+                    n if prefetch_next is True else int(prefetch_next))
             result = self.instrumentation.run_batch(bufs, lens,
                                                     pad_to=pad_to)
         if n > 0:

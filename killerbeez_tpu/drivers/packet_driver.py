@@ -47,7 +47,7 @@ class PacketDriver(Driver):
         return self.mutator is not None and self.mutator.batch_capable
 
     def test_batch(self, n: int, pad_to: Optional[int] = None,
-                   prefetch_next: bool = True) -> BatchOutcome:
+                   prefetch_next=True) -> BatchOutcome:
         """Batch-mutate ``n`` packet sequences, deliver them one
         connection at a time, and assemble host-side result arrays
         (statuses/novelty from the instrumentation after each run).

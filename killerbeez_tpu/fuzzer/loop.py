@@ -182,6 +182,25 @@ class Fuzzer:
             return 2**62 - self.stats.iterations
         return n_iterations - self.stats.iterations
 
+    @staticmethod
+    def _compact_rows(compact):
+        """{batch_lane: report_row} for a CompactReport, or None when
+        the report overflowed (caller falls back to a full pull).
+        ``count`` is a scalar (single-chip: valid rows are the first
+        count) or a per-dp-shard vector (mesh campaigns: each shard
+        owns a cap-row block of the report, lane ids are global)."""
+        counts = np.asarray(compact.count).reshape(-1)
+        idx = np.asarray(compact.idx)
+        cap = len(idx) // len(counts)
+        if (counts > cap).any():
+            return None
+        rows = {}
+        for s, c in enumerate(counts):
+            for j in range(int(c)):
+                r = s * cap + j
+                rows[int(idx[r])] = r
+        return rows
+
     def _triage_batch(self, out, room: int, done_through: int,
                       packed=None) -> None:
         """``done_through`` is the global iteration count as of THIS
@@ -206,14 +225,10 @@ class Fuzzer:
         if len(interesting):
             rows = None
             if out.compact is not None:
-                count = int(np.asarray(out.compact.count))
-                if count <= len(np.asarray(out.compact.idx)):
-                    # in-step compaction already gathered these lanes
-                    # (and only these — same flags, padding excluded)
-                    idx = np.asarray(out.compact.idx)[:count]
+                rows = self._compact_rows(out.compact)
+                if rows is not None:
                     inputs = np.asarray(out.compact.bufs)
                     lengths = np.asarray(out.compact.lens)
-                    rows = {int(g): r for r, g in enumerate(idx)}
             if rows is None:                 # full pull (host results,
                 inputs = np.asarray(out.inputs)   # or compact overflow)
                 lengths = np.asarray(out.lengths)
@@ -233,10 +248,11 @@ class Fuzzer:
     # latency (severe over remote-tunnel devices) overlaps compute
     # (SURVEY hard part: "double-buffer batches, async dispatch").
     # Depth is sized for a remote-tunnel device: D2H RTT is ~150ms
-    # regardless of size while a 16k-lane step is ~25ms, so ~6+
-    # batches must be in flight for the prefetched copies (below) to
-    # land before their triage turn.
-    PIPELINE_DEPTH = 8
+    # (observed spiking to ~1s under load) regardless of size, while
+    # a 16k-lane step is ~25ms — enough batches must be in flight for
+    # the prefetched copies (below) to land before their triage turn.
+    # The cost of extra depth is just per-batch handles + drain time.
+    PIPELINE_DEPTH = 24
 
     @staticmethod
     def _prefetch(out):
@@ -298,7 +314,8 @@ class Fuzzer:
                           "input", len(cand))
                 return
             except ValueError:       # finding wider than the buffer
-                self._corpus.remove(cand)
+                if cand in self._corpus:
+                    self._corpus.remove(cand)  # anchor isn't in it
 
     def _run_batched(self, n_iterations: int) -> None:
         from collections import deque
@@ -308,6 +325,11 @@ class Fuzzer:
         # smaller than the quantum is skipped with a warning instead
         # of dying mid-run
         quantum = getattr(self.driver, "batch_quantum", 1)
+        # corpus feedback rotates on TRIAGED findings: the pipeline
+        # may not run further ahead than the rotation cadence or the
+        # corpus is always stale/empty at rotation time
+        depth = min(self.PIPELINE_DEPTH, self.feedback) \
+            if self.feedback else self.PIPELINE_DEPTH
         batches = 0
         if self.feedback and self._base_seed is None and \
                 getattr(mut, "seed_bytes", None):
@@ -335,16 +357,19 @@ class Fuzzer:
                 # force a full XLA recompile; the driver pads to
                 # batch_size with duplicate lanes (coverage no-ops)
                 # and we triage only the first `room` real lanes
-                more = min(self._remaining(n_iterations) - room,
-                           mut.remaining() - room) > 0
+                # the NEXT batch's size, so host drivers prefetch
+                # exactly what will be requested (a full-size stash
+                # before a smaller tail would be discarded as stale)
+                nxt = min(self._remaining(n_iterations) - room,
+                          mut.remaining() - room, self.batch_size)
                 out = self.driver.test_batch(room,
                                              pad_to=self.batch_size,
-                                             prefetch_next=more)
+                                             prefetch_next=max(nxt, 0))
                 self.stats.iterations += room
                 packed = self._prefetch(out)
                 pending.append((out, room, self.stats.iterations,
                                 packed))
-                if len(pending) >= self.PIPELINE_DEPTH:
+                if len(pending) >= depth:
                     self._triage_batch(*pending.popleft())
         finally:
             # findings in already-executed batches must survive an

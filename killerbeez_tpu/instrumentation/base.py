@@ -39,11 +39,14 @@ class CompactReport(NamedTuple):
     lanes, gathered IN the jitted step so triage never pulls the full
     [B, L] tensor across a slow device->host link.  ``count`` is the
     true number of interesting lanes — when it exceeds capacity the
-    consumer falls back to a full transfer for that batch."""
+    consumer falls back to a full transfer for that batch.  Mesh
+    campaigns shard the report: ``count`` becomes a per-dp-shard
+    vector and each shard owns a capacity-row block (lane ids stay
+    global); Fuzzer._compact_rows handles both layouts."""
     idx: np.ndarray           # int32[C] lane numbers (valid: first count)
     bufs: np.ndarray          # uint8[C, L] candidate bytes of those lanes
     lens: np.ndarray          # int32[C]
-    count: np.ndarray         # int32 scalar
+    count: np.ndarray         # int32 scalar (or int32[n_dp], sharded)
 
 
 class Instrumentation:

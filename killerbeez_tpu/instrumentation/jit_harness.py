@@ -104,21 +104,10 @@ def _fused_step(instrs, edge_table, u_slots, seg_id, inputs, lengths,
     (coverage no-ops) and results sliced back."""
     from ..models.vm import _run_batch_impl  # batched one-hot engine
     if engine == "pallas":
-        from ..ops.vm_kernel import LANE_TILE, run_batch_pallas
-        b = inputs.shape[0]
-        pad = (-b) % LANE_TILE
-        if pad:
-            inputs = jnp.concatenate(
-                [inputs, jnp.repeat(inputs[:1], pad, axis=0)], axis=0)
-            lengths = jnp.concatenate(
-                [lengths, jnp.repeat(lengths[:1], pad)])
-        res = run_batch_pallas(instrs, edge_table, inputs, lengths,
-                               mem_size, max_steps, n_edges)
-        if pad:
-            res = res._replace(
-                status=res.status[:b], exit_code=res.exit_code[:b],
-                counts=res.counts[:b], steps=res.steps[:b],
-                path_hash=res.path_hash[:b])
+        from ..ops.vm_kernel import run_batch_pallas_padded
+        res = run_batch_pallas_padded(instrs, edge_table, inputs,
+                                      lengths, mem_size, max_steps,
+                                      n_edges)
     else:
         res = _run_batch_impl(instrs, edge_table, inputs, lengths,
                               mem_size, max_steps, n_edges, False)

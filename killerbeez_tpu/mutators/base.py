@@ -79,7 +79,6 @@ class Mutator:
                          keep_length: bool = False) -> None:
         if len(input_bytes) == 0:
             raise ValueError(f"{self.name}: empty seed input")
-        self.seed_bytes = input_bytes
         if keep_length:
             # corpus-feedback rotation: the candidate tensor width is
             # part of every compiled step's shape — keep it stable so
@@ -94,6 +93,10 @@ class Mutator:
             self.max_length = _round_up(L, 8)  # word-aligned maps
         buf = np.zeros(self.max_length, dtype=np.uint8)
         buf[:len(input_bytes)] = np.frombuffer(input_bytes, dtype=np.uint8)
+        # assigned only after validation: a rejected keep_length swap
+        # must not leave seed_bytes describing a seed the buffers
+        # don't (state dumps would serialize the wrong walk)
+        self.seed_bytes = input_bytes
         self.seed_buf = buf
         self.seed_len = len(input_bytes)
 

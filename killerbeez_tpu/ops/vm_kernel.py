@@ -328,6 +328,37 @@ def run_batch_pallas(instrs, edge_table, inputs, lengths, mem_size,
                     edge_ids=None)
 
 
+def _slice_vmresult(res: VMResult, b: int) -> VMResult:
+    return res._replace(
+        status=res.status[:b], exit_code=res.exit_code[:b],
+        counts=res.counts[:b], steps=res.steps[:b],
+        path_hash=res.path_hash[:b])
+
+
+def run_batch_pallas_padded(instrs, edge_table, inputs, lengths,
+                            mem_size, max_steps, n_edges,
+                            interpret=False, skip=None) -> VMResult:
+    """run_batch_pallas for ANY batch size: pads to a LANE_TILE
+    multiple and slices results back.  Padded lanes are skip-masked
+    when a skip vector is given, else duplicate lane 0 (coverage
+    no-ops either way).  The shared pad/unpad used by the jit_harness
+    engine and the sharded step."""
+    b = inputs.shape[0]
+    pad = (-b) % LANE_TILE
+    if pad:
+        inputs = jnp.concatenate(
+            [inputs, jnp.repeat(inputs[:1], pad, axis=0)], axis=0)
+        lengths = jnp.concatenate(
+            [lengths, jnp.repeat(lengths[:1], pad)])
+        if skip is not None:
+            skip = jnp.concatenate(
+                [skip, jnp.ones((pad,), skip.dtype)])
+    res = run_batch_pallas(instrs, edge_table, inputs, lengths,
+                           mem_size, max_steps, n_edges,
+                           interpret=interpret, skip=skip)
+    return _slice_vmresult(res, b) if pad else res
+
+
 # --------------------------------------------------------------------
 # Fused mutate + execute: the whole fuzz candidate lifecycle in VMEM
 # --------------------------------------------------------------------

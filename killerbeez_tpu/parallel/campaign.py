@@ -27,7 +27,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..drivers.base import BatchOutcome, Driver
-from ..instrumentation.base import BatchResult
+from ..instrumentation.base import BatchResult, CompactReport
 from ..utils.logging import INFO_MSG
 from .distributed import (
     ShardedFuzzState, make_mesh, make_sharded_fuzz_step,
@@ -117,7 +117,7 @@ class ShardedCampaignDriver(Driver):
         return self.batch_per_device * self.mesh.shape["dp"]
 
     def test_batch(self, n: int, pad_to: Optional[int] = None,
-                   prefetch_next: bool = True) -> BatchOutcome:
+                   prefetch_next=True) -> BatchOutcome:
         b = self.batch_per_device * self.mesh.shape["dp"]
         if n != b:
             raise ValueError(
@@ -128,9 +128,9 @@ class ShardedCampaignDriver(Driver):
         base_it = int(its[0]) // b  # step counter, resume-stable
         seed_buf = jnp.asarray(mut.seed_buf)
         (self.state, statuses, rets, uc, uh, exit_codes, bufs,
-         lens) = self._step(self.state, seed_buf,
-                            jnp.int32(mut.seed_len),
-                            jnp.int32(base_it))
+         lens, compact) = self._step(self.state, seed_buf,
+                                     jnp.int32(mut.seed_len),
+                                     jnp.int32(base_it))
         mut.advance(n)
         # expose the sharded maps through the instrumentation so
         # get_state()/merge()/coverage_bytes() see campaign coverage
@@ -146,7 +146,8 @@ class ShardedCampaignDriver(Driver):
             result=BatchResult(statuses=statuses, new_paths=rets,
                                unique_crashes=uc, unique_hangs=uh,
                                exit_codes=exit_codes),
-            inputs=bufs, lengths=lens)
+            inputs=bufs, lengths=lens,
+            compact=CompactReport(*compact))
 
     def test_input(self, buf: bytes) -> int:
         """Single-input repro path: run through the instrumentation's

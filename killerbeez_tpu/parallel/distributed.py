@@ -115,19 +115,27 @@ def _gather_and_fold(v_local, axis):
 def make_sharded_fuzz_step(program: Program, mesh: Mesh,
                            batch_per_device: int, max_len: int,
                            stack_pow2: int = 4, engine: str = "xla",
-                           interpret: bool = False, seed: int = 0):
+                           interpret: bool = False, seed: int = 0,
+                           compact_cap: int = 1024):
     """Build the jitted multi-chip fuzz step.
 
     Returns ``step(state, seed_buf, seed_len, base_it) ->
     (state', statuses[B], new_paths[B], uc[B], uh[B], exit_codes[B],
-    candidates[B, L], lengths[B])`` where B = batch_per_device *
-    n_dp, candidates dp-sharded, virgin maps mp-sharded. ``base_it``
-    is the global iteration counter the per-lane PRNG keys fold in.
+    candidates[B, L], lengths[B], compact)`` where B =
+    batch_per_device * n_dp, candidates dp-sharded, virgin maps
+    mp-sharded, and ``compact`` = (idx, bufs, lens, counts) is the
+    per-shard interesting-lane report. ``base_it`` is the global
+    iteration counter the per-lane PRNG keys fold in.
 
     ``engine``: "xla" (batched one-hot engine), "pallas" (VMEM VM
     kernel under shard_map), or "pallas_fused" (mutation fused into
     the kernel).  ``interpret`` routes pallas through interpret mode
     (CPU-mesh tests).  ``seed`` is the campaign PRNG root.
+
+    The step also returns a per-dp-shard compaction of interesting
+    lanes (idx/bufs/lens blocks of ``compact_cap`` rows per shard +
+    per-shard counts) so campaign triage reads a small report
+    instead of the full candidate tensor.
     """
     n_dp = mesh.shape["dp"]
     n_mp = mesh.shape["mp"]
@@ -147,22 +155,10 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
     def _exec_pallas(bufs, lens):
         """Local-batch pallas execution (padded to the lane tile
         with dup-lane-0 coverage no-ops, sliced back)."""
-        from ..ops.vm_kernel import LANE_TILE, run_batch_pallas
-        b = bufs.shape[0]
-        pad = (-b) % LANE_TILE
-        if pad:
-            bufs = jnp.concatenate(
-                [bufs, jnp.repeat(bufs[:1], pad, axis=0)], axis=0)
-            lens = jnp.concatenate([lens, jnp.repeat(lens[:1], pad)])
-        res = run_batch_pallas(instrs, edge_table, bufs, lens,
-                               program.mem_size, program.max_steps,
-                               program.n_edges, interpret=interpret)
-        if pad:
-            res = res._replace(
-                status=res.status[:b], exit_code=res.exit_code[:b],
-                counts=res.counts[:b], steps=res.steps[:b],
-                path_hash=res.path_hash[:b])
-        return res
+        from ..ops.vm_kernel import run_batch_pallas_padded
+        return run_batch_pallas_padded(
+            instrs, edge_table, bufs, lens, program.mem_size,
+            program.max_steps, program.n_edges, interpret=interpret)
 
     def local_step(vb, vc, vh, seed_buf, seed_len, base_it):
         # ---- which shard am I ----
@@ -290,14 +286,28 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         vb2 = _gather_and_fold(vb2, "dp")
         vc2 = _gather_and_fold(vc2, "dp")
         vh2 = _gather_and_fold(vh2, "dp")
+
+        # ---- in-step compaction (per dp shard): gather interesting
+        # lanes' candidate bytes here so campaign triage never pulls
+        # the full [B, L] tensor to the host (jit_harness
+        # _fused_fuzz_step does the same for single-chip) ----
+        flags = (statuses != 0) | (rets > 0)
+        (sel,) = jnp.nonzero(flags, size=compact_cap, fill_value=0)
+        sel_bufs = jnp.take(bufs, sel, axis=0)
+        sel_lens = jnp.take(lens, sel)
+        # global lane ids so the host maps report rows -> batch lanes
+        sel_idx = (sel + dp_i * batch_per_device).astype(jnp.int32)
+        count = jnp.sum(flags).astype(jnp.int32).reshape(1)
         return (vb2, vc2, vh2, statuses, rets, uc, uh,
-                res.exit_code, bufs, lens)
+                res.exit_code, bufs, lens,
+                sel_idx, sel_bufs, sel_lens, count)
 
     sharded = shard_map(
         local_step, mesh=mesh,
         in_specs=(P("mp"), P("mp"), P("mp"), P(), P(), P()),
         out_specs=(P("mp"), P("mp"), P("mp"), P("dp"), P("dp"),
-                   P("dp"), P("dp"), P("dp"), P("dp", None), P("dp")),
+                   P("dp"), P("dp"), P("dp"), P("dp", None), P("dp"),
+                   P("dp"), P("dp", None), P("dp"), P("dp")),
         check_vma=False,
     )
 
@@ -316,11 +326,11 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
             seed_buf = jnp.pad(seed_buf,
                                (0, max_len - seed_buf.shape[-1]))
         (vb, vc, vh, statuses, rets, uc, uh, exit_codes, bufs,
-         lens) = sharded(
+         lens, sel_idx, sel_bufs, sel_lens, counts) = sharded(
             state.virgin_bits, state.virgin_crash, state.virgin_tmout,
             seed_buf, seed_len, base_it)
         new_state = ShardedFuzzState(vb, vc, vh, state.step + 1)
         return (new_state, statuses, rets, uc, uh, exit_codes, bufs,
-                lens)
+                lens, (sel_idx, sel_bufs, sel_lens, counts))
 
     return step

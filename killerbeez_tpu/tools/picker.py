@@ -42,11 +42,21 @@ CLASS_MULTI_PATH_SAME_FILE = "multi_path_same_file"
 
 def collect_traces(driver, instrumentation, seeds: List[bytes],
                    num_iterations: int = 5) -> np.ndarray:
-    """uint8[n_seeds, n_runs, MAP_SIZE] of classified bitmaps."""
+    """uint8[n_seeds, n_runs, MAP_SIZE] of classified bitmaps.
+
+    The seeds x N-runs matrix executes as ONE batch through the C
+    exec backend when the driver can describe a host-exec spec
+    (stdin/file targets — the reference picker's nested loops,
+    picker/main.c:163-227, collapsed into a single dispatch across
+    the instance pool); other drivers fall back to per-exec calls."""
     if not hasattr(instrumentation, "last_trace"):
         raise ValueError(
             f"{instrumentation.name} does not expose raw bitmaps "
             "(picker needs an afl-style instrumentation)")
+    batched = _collect_batched(driver, instrumentation, seeds,
+                               num_iterations)
+    if batched is not None:
+        return batched
     rows = []
     for seed in seeds:
         runs = []
@@ -58,6 +68,35 @@ def collect_traces(driver, instrumentation, seeds: List[bytes],
             runs.append(COUNT_CLASS_LOOKUP[trace])
         rows.append(np.stack(runs))
     return np.stack(rows)
+
+
+def _collect_batched(driver, instrumentation, seeds: List[bytes],
+                     num_iterations: int):
+    """One exec-backend batch for the whole seeds x runs matrix;
+    None when this driver/instrumentation pair can't batch host
+    execs (network drivers, device backends)."""
+    try:
+        spec = driver._host_exec_spec()
+        instrumentation.prepare_host(**spec)
+        target = instrumentation._target
+    except (NotImplementedError, AttributeError, KeyError):
+        return None
+    if target is None or not hasattr(target, "run_batch"):
+        return None
+    L = max(max(len(s) for s in seeds), 1)
+    n = len(seeds) * num_iterations
+    inputs = np.zeros((n, L), dtype=np.uint8)
+    lens = np.zeros(n, dtype=np.int32)
+    for i, seed in enumerate(seeds):
+        for r in range(num_iterations):
+            row = i * num_iterations + r
+            inputs[row, :len(seed)] = np.frombuffer(seed, np.uint8)
+            lens[row] = len(seed)
+    _, bitmaps = target.run_batch(inputs, lens, want_bitmaps=True)
+    if bitmaps is None:
+        return None
+    cls = COUNT_CLASS_LOOKUP[bitmaps]
+    return cls.reshape(len(seeds), num_iterations, -1)
 
 
 def derive_ignore_mask(traces: np.ndarray) -> np.ndarray:

@@ -35,7 +35,7 @@ def run_steps(n_dp, n_mp, n_steps=6, bpd=32):
     sb, sl = seed_arrays()
     all_status, all_rets = [], []
     for it in range(n_steps):
-        state, statuses, rets, uc, uh, ec, bufs, lens = step(
+        state, statuses, rets, uc, uh, ec, bufs, lens, _c = step(
             state, sb, sl, jnp.int32(it))
         all_status.append(np.asarray(statuses))
         all_rets.append(np.asarray(rets))
@@ -151,7 +151,7 @@ def test_sharded_step_multimodule_program():
     state = sharded_state_init(mesh, prog.map_size)
     sb, sl = seed_arrays(seed=b"LXLX", L=8)
     for it in range(4):
-        state, statuses, rets, uc, uh, ec, bufs, lens = step(
+        state, statuses, rets, uc, uh, ec, bufs, lens, _c = step(
             state, sb, sl, jnp.int32(it))
     vb = np.asarray(state.virgin_bits)
     assert vb.shape == (2 * ONE_MAP,)
@@ -173,7 +173,7 @@ def test_sharded_step_unique_crash_flags():
     sb, sl = seed_arrays()
     total_uc = 0
     for it in range(6):
-        state, statuses, rets, uc, uh, ec, bufs, lens = step(
+        state, statuses, rets, uc, uh, ec, bufs, lens, _c = step(
             state, sb, sl, jnp.int32(it))
         statuses, uc = np.asarray(statuses), np.asarray(uc)
         assert (~uc | (statuses == FUZZ_CRASH)).all()  # uc => crash
@@ -316,7 +316,7 @@ def test_sharded_fused_engine_matches_xla():
             prog, mesh, batch_per_device=16, max_len=16,
             engine=engine, interpret=True)
         state = sharded_state_init(mesh, prog.map_size)
-        state, st, rets, uc, uh, ec, bufs, lens = step(
+        state, st, rets, uc, uh, ec, bufs, lens, _c = step(
             state, sb, sl, jnp.int32(0))
         outs[engine] = (np.asarray(st), np.asarray(rets),
                         np.asarray(bufs), np.asarray(lens),

@@ -263,3 +263,23 @@ def test_picker_per_module_masks(corpus_bin, tmp_path):
     full = decode_array(report["ignore_bytes"])
     nz = np.flatnonzero(full)
     assert len(nz) and (nz >= lo).all() and (nz < hi).all()
+
+
+def test_picker_batched_matches_single_exec(corpus_bin, tmp_path):
+    """The one-batch seeds x runs matrix must classify identically to
+    the per-exec fallback path (deterministic target)."""
+    from killerbeez_tpu.tools.picker import collect_traces
+    instr = instrumentation_factory("afl", None)
+    drv = driver_factory("stdin", json.dumps(
+        {"path": corpus_bin("test")}), instr, None)
+    seeds = [b"zzzz", b"ABzz"]
+    batched = collect_traces(drv, instr, seeds, 3)
+    # force the fallback by hiding the host-exec spec
+    orig = drv._host_exec_spec
+    drv._host_exec_spec = lambda: (_ for _ in ()).throw(
+        NotImplementedError())
+    single = collect_traces(drv, instr, seeds, 3)
+    drv._host_exec_spec = orig
+    np.testing.assert_array_equal(batched, single)
+    drv.cleanup()
+    instr.cleanup()

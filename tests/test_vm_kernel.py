@@ -72,7 +72,8 @@ def test_jit_harness_pallas_engine(tmp_path):
     # interpret-mode monkeypatch: CI has no TPU to compile for
     import killerbeez_tpu.ops.vm_kernel as vk
     orig = vk.run_batch_pallas
-    vk_run = lambda *a, **k: orig(*a, interpret=True, **k)  # noqa: E731
+    vk_run = lambda *a, **k: orig(  # noqa: E731
+        *a, **{**k, "interpret": True})
     import killerbeez_tpu.instrumentation.jit_harness as jh
     jh._fused_step.clear_cache()
     try:

@@ -51,6 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="dump instrumentation state to file on exit")
     p.add_argument("-msf", "--mutator-state-file",
                    help="load mutator state from file")
+    p.add_argument("-ms", "--mutator-state",
+                   help="load mutator state from an inline string "
+                        "(reference -ms; -msf for a file)")
     p.add_argument("-msd", "--mutator-state-dump",
                    help="dump mutator state to file on exit")
     p.add_argument("-l", "--logging-options", help="logging JSON options")
@@ -105,7 +108,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 read_file(args.instrumentation_state_file).decode())
 
         mutator = mutator_factory(args.mutator, args.mutator_options, seed)
-        if args.mutator_state_file:
+        if args.mutator_state:
+            mutator.set_state(args.mutator_state)
+        elif args.mutator_state_file:
             mutator.set_state(read_file(args.mutator_state_file).decode())
 
         if args.mesh:

@@ -158,7 +158,8 @@ def _fused_fuzz_step(instrs, edge_table, u_slots, seg_id, seed_buf,
     b = bufs.shape[0]
     flags = ((statuses != FUZZ_NONE) | (new_paths > 0)) & \
         (jnp.arange(b) < n_real)
-    (sel_idx,) = jnp.nonzero(flags, size=COMPACT_CAP, fill_value=0)
+    (sel_idx,) = jnp.nonzero(flags, size=min(COMPACT_CAP, b),
+                             fill_value=0)
     sel_bufs = jnp.take(bufs, sel_idx, axis=0)
     sel_lens = jnp.take(lens, sel_idx)
     count = jnp.sum(flags).astype(jnp.int32)

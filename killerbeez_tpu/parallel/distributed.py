@@ -143,6 +143,10 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         raise ValueError("mp must divide the program's map size")
     if engine not in ("xla", "pallas", "pallas_fused"):
         raise ValueError(f"unknown engine {engine!r}")
+    # a shard can never report more interesting lanes than it runs —
+    # a bigger cap would make the "compact" report LARGER than the
+    # full tensor for small shards
+    compact_cap = min(compact_cap, batch_per_device)
     slice_size = program.map_size // n_mp
     instrs = jnp.asarray(program.instrs)
     edge_table = jnp.asarray(program.edge_table)
@@ -196,12 +200,8 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
                 program.mem_size, program.max_steps, program.n_edges,
                 stack_pow2=stack_pow2, interpret=interpret)
             if pad:
-                res = res._replace(
-                    status=res.status[:batch_per_device],
-                    exit_code=res.exit_code[:batch_per_device],
-                    counts=res.counts[:batch_per_device],
-                    steps=res.steps[:batch_per_device],
-                    path_hash=res.path_hash[:batch_per_device])
+                from ..ops.vm_kernel import _slice_vmresult
+                res = _slice_vmresult(res, batch_per_device)
                 bufs = bufs[:batch_per_device]
                 lens = lens[:batch_per_device]
         else:

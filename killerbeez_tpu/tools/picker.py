@@ -83,15 +83,15 @@ def _collect_batched(driver, instrumentation, seeds: List[bytes],
         return None
     if target is None or not hasattr(target, "run_batch"):
         return None
-    L = max(max(len(s) for s in seeds), 1)
-    n = len(seeds) * num_iterations
-    inputs = np.zeros((n, L), dtype=np.uint8)
-    lens = np.zeros(n, dtype=np.int32)
-    for i, seed in enumerate(seeds):
-        for r in range(num_iterations):
-            row = i * num_iterations + r
-            inputs[row, :len(seed)] = np.frombuffer(seed, np.uint8)
-            lens[row] = len(seed)
+    # determinism analysis must run every repeat of a seed through
+    # ONE forkserver instance: across pool workers, address-space
+    # differences would read as target nondeterminism (the reference
+    # picker is likewise single-instance)
+    if hasattr(target, "targets"):
+        target = target.targets[0]
+    from ..mutators.base import pack_byte_rows
+    inputs, lens = pack_byte_rows(
+        [s for s in seeds for _ in range(num_iterations)])
     _, bitmaps = target.run_batch(inputs, lens, want_bitmaps=True)
     if bitmaps is None:
         return None

@@ -502,3 +502,52 @@ def test_corpus_feedback_rotation_mechanism(tmp_path):
                  batch_size=256, write_findings=False)
     fz2.run(4096)
     assert instr.coverage_bytes() >= 0.75 * instr2.coverage_bytes()
+
+
+def test_cli_inline_mutator_state(tmp_path):
+    """Reference -ms parity: mutator state as an inline string (the
+    same JSON -msf reads from a file)."""
+    seed_path = tmp_path / "seed"
+    seed_path.write_bytes(SEED)
+    out = tmp_path / "out"
+    common = ["file", "jit_harness", "bit_flip", "-i",
+              '{"target": "test"}', "-sf", str(seed_path),
+              "-o", str(out)]
+    rc = cli_main(common + ["-n", "16",
+                            "-msd", str(tmp_path / "m.json")])
+    assert rc == 0
+    state = (tmp_path / "m.json").read_text()
+    rc = cli_main(common + ["-n", "16", "-ms", state])
+    assert rc == 0
+    assert len(os.listdir(out / "crashes")) == 1  # found after resume
+
+
+MUTATOR_SWEEP = ["bit_flip", "arithmetic", "interesting_value",
+                 "havoc", "nop", "ni", "zzuf", "honggfuzz", "afl",
+                 "dictionary"]
+
+
+@pytest.mark.parametrize("mutator", MUTATOR_SWEEP)
+@pytest.mark.parametrize("driver", ["file", "stdin"])
+def test_mutator_sweep_runs_clean(mutator, driver, tmp_path, caplog):
+    """The reference smoke test's mutator sweep (smoke_test.sh:
+    204-213): every mutator x {file, stdin} drivers completes a short
+    run with nonzero iterations, no exec errors, and no WARNING+
+    log lines."""
+    import logging
+    mopts = None
+    if mutator == "dictionary":
+        mopts = json.dumps({"tokens": ["ABCD", "zz"]})
+    instr = instrumentation_factory("jit_harness",
+                                    '{"target": "test"}')
+    mut = mutator_factory(mutator, mopts, SEED)
+    drv = driver_factory(driver, None, instr, mut)
+    fz = Fuzzer(drv, output_dir=str(tmp_path / "o"), batch_size=8,
+                write_findings=False)
+    with caplog.at_level(logging.WARNING, logger="killerbeez"):
+        stats = fz.run(16)
+    assert stats.iterations > 0
+    assert stats.errors == 0
+    warnings = [r for r in caplog.records
+                if r.levelno >= logging.WARNING]
+    assert not warnings, [r.getMessage() for r in warnings]

@@ -504,6 +504,164 @@ def imgparse_vm() -> Program:
     return a.build(block_seed=0x16C)
 
 
+# rledec_vm memory map (mem_size=80):
+#   [0..63]  output buffer (OUT_CAP=64)   [64..79] scratch "heap"
+_RLE_CAP = 64
+
+
+@register_target("rledec_vm")
+def rledec_vm() -> Program:
+    """KBVM port of corpus/rledec.c: "RLE2" [out_len u16le] then
+    run / literal / back-reference tokens decoded into a 64-byte
+    output region (the C build uses 1024; the VM scales the cap to
+    its memory, same bug shape).
+
+    The planted bug is the classic decompressor CVE: output space is
+    accounted with a SIGNED 16-bit budget instead of checking the
+    cursor, and the reject only fires while the cursor still LOOKS
+    in-bounds (`op + cnt <= CAP`) — the first token that both
+    exhausts the budget and crosses the buffer end slips through,
+    and the emit loop walks past the output region: bytes 64..79
+    silently corrupt scratch (the C version's heap walk), then the
+    cursor leaves VM memory entirely and the lane crashes.
+
+    Registers: r1=ip r2=out-cursor r3=budget r4,r5=scratch
+    r6=tok/byte/dist r7=cnt.  Exit codes mirror the C returns
+    (1=short/bad magic, 2=out_len too big, 3=truncated token,
+    4=zero count, 5=budget reject, 6=bad distance, 7=bad token,
+    8=stream ended without 0x03).
+    """
+    a = Assembler("rledec_vm", mem_size=80, max_steps=1024)
+
+    def budget_reject(tag: str) -> None:
+        """budget -= cnt (signed-16 wrap); reject ONLY when negative
+        AND op+cnt <= CAP — the conditioned check from the C."""
+        a.alu("sub", 3, 3, 7)
+        a.ldi(5, 0xFFFF)
+        a.alu("and", 3, 3, 5)                     # short truncation
+        a.ldi(4, 15)
+        a.alu("shr", 5, 3, 4)                     # sign bit
+        a.br("eq", 5, 0, f"bgt_ok_{tag}")         # budget >= 0
+        a.block()                                 # negative budget
+        a.alu("add", 4, 2, 7)                     # op + cnt
+        a.ldi(5, _RLE_CAP + 1)
+        a.br("lt", 4, 5, "x5")                    # looks in-bounds
+        a.block()                                 # ESCAPE: overflow
+        a.label(f"bgt_ok_{tag}")
+        a.block()
+
+    a.block()                                     # entry
+    a.load_len(4)
+    a.ldi(5, 6)
+    a.br("lt", 4, 5, "x1")
+    a.block()
+    a.expect_byte(4, 5, 0, ord("R"), "x1")
+    a.expect_byte(4, 5, 1, ord("L"), "x1")
+    a.expect_byte(4, 5, 2, ord("E"), "x1")
+    a.expect_byte(4, 5, 3, ord("2"), "x1")
+    a.ldi(4, 4)                                   # out_len = LE16
+    a.ldb(4, 4)
+    a.ldi(5, 5)
+    a.ldb(5, 5)
+    a.ldi(7, 8)
+    a.alu("shl", 5, 5, 7)
+    a.alu("or", 3, 4, 5)                          # budget = out_len
+    a.ldi(5, _RLE_CAP + 1)
+    a.br("ge", 3, 5, "x2")
+    a.block()
+    a.ldi(1, 6)                                   # ip = 6
+    a.ldi(2, 0)                                   # op = 0
+
+    a.label("loop")
+    a.block()
+    a.load_len(4)
+    a.br("ge", 1, 4, "x8")                        # stream ran out
+    a.block()                                     # fetch token
+    a.ldb(6, 1)
+    a.addi(1, 1, 1)
+    a.ldi(5, 0x03)
+    a.br("eq", 6, 5, "done")
+    a.block()
+    a.load_len(4)
+    a.br("ge", 1, 4, "x3")
+    a.block()                                     # fetch count
+    a.ldb(7, 1)
+    a.addi(1, 1, 1)
+    a.br("eq", 7, 0, "x4")
+    a.block()
+    a.ldi(5, 0x00)
+    a.br("eq", 6, 5, "t_run")
+    a.ldi(5, 0x01)
+    a.br("eq", 6, 5, "t_lit")
+    a.ldi(5, 0x02)
+    a.br("eq", 6, 5, "t_bref")
+    a.jmp("x7")
+
+    a.label("t_run")                              # emit byte n times
+    a.block()
+    a.load_len(4)
+    a.br("ge", 1, 4, "x3")
+    a.block()
+    a.ldb(6, 1)                                   # fill byte
+    a.addi(1, 1, 1)
+    budget_reject("run")
+    a.label("run_emit")
+    a.block()                                     # per-byte hit count
+    a.br("eq", 7, 0, "loop")
+    a.stm(2, 6)                                   # out[op] = byte
+    a.addi(2, 2, 1)
+    a.addi(7, 7, -1)
+    a.jmp("run_emit")
+
+    a.label("t_lit")                              # verbatim copy
+    a.block()
+    a.alu("add", 5, 1, 7)
+    a.load_len(4)
+    a.br("lt", 4, 5, "x3")                        # ip + cnt > len
+    a.block()
+    budget_reject("lit")
+    a.label("lit_emit")
+    a.block()
+    a.br("eq", 7, 0, "loop")
+    a.ldb(6, 1)
+    a.stm(2, 6)
+    a.addi(1, 1, 1)
+    a.addi(2, 2, 1)
+    a.addi(7, 7, -1)
+    a.jmp("lit_emit")
+
+    a.label("t_bref")                             # back-reference
+    a.block()
+    a.load_len(4)
+    a.br("ge", 1, 4, "x3")
+    a.block()
+    a.ldb(6, 1)                                   # dist
+    a.addi(1, 1, 1)
+    a.br("eq", 6, 0, "x6")
+    a.block()
+    a.br("lt", 2, 6, "x6")                        # dist > op
+    a.block()
+    budget_reject("bref")
+    a.label("bref_emit")
+    a.block()
+    a.br("eq", 7, 0, "loop")
+    a.alu("sub", 5, 2, 6)                         # src = op - dist
+    a.ldm(4, 5)
+    a.stm(2, 4)
+    a.addi(2, 2, 1)
+    a.addi(7, 7, -1)
+    a.jmp("bref_emit")
+
+    a.label("done")
+    a.block()
+    a.halt(0)
+    for code in (1, 2, 3, 4, 5, 6, 7, 8):
+        a.label(f"x{code}")
+        a.block()
+        a.halt(code)
+    return a.build(block_seed=0x41E)
+
+
 # --------------------------------------------------------------------
 # Seeds and crash reproducers (tests + bench starting corpus)
 # --------------------------------------------------------------------
@@ -553,7 +711,30 @@ def imgparse_vm_crash() -> bytes:
     return out
 
 
+def rledec_vm_seed() -> bytes:
+    """Byte-identical to the native seed (corpus/seeds.py
+    rledec_seed): every token type, 16 bytes emitted, budget exact."""
+    out = b"RLE2" + (16).to_bytes(2, "little")
+    out += bytes([0x00, 8, ord("A")])             # run of 8 'A'
+    out += bytes([0x01, 4]) + b"abcd"             # literal
+    out += bytes([0x02, 4, 4])                    # back-reference
+    out += bytes([0x03])
+    return out
+
+
+def rledec_vm_crash() -> bytes:
+    """Budget down to 4, then a 60-byte run: budget goes negative
+    AND the cursor crosses the cap, so the conditioned reject never
+    fires — the emit loop walks off the output region (the native
+    repro's shape, scaled to the VM's 64-byte cap)."""
+    out = b"RLE2" + (64).to_bytes(2, "little")
+    out += bytes([0x00, 60, ord("A")])            # budget 4, op 60
+    out += bytes([0x00, 60, ord("B")])            # escapes the check
+    return out
+
+
 VM_SEEDS = {
     "tlvstack_vm": (tlvstack_vm_seed, tlvstack_vm_crash),
     "imgparse_vm": (imgparse_vm_seed, imgparse_vm_crash),
+    "rledec_vm": (rledec_vm_seed, rledec_vm_crash),
 }

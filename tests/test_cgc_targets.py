@@ -51,6 +51,7 @@ def test_vm_block_scale():
     counts so coverage doesn't saturate in one batch."""
     assert targets.get_target("tlvstack_vm").n_blocks >= 100
     assert targets.get_target("imgparse_vm").n_blocks >= 30
+    assert targets.get_target("rledec_vm").n_blocks >= 30
 
 
 def test_vm_seed_covers_many_blocks():

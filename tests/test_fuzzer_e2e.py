@@ -529,12 +529,13 @@ MUTATOR_SWEEP = ["bit_flip", "arithmetic", "interesting_value",
 
 @pytest.mark.parametrize("mutator", MUTATOR_SWEEP)
 @pytest.mark.parametrize("driver", ["file", "stdin"])
-def test_mutator_sweep_runs_clean(mutator, driver, tmp_path, caplog):
+def test_mutator_sweep_runs_clean(mutator, driver, tmp_path, capfd):
     """The reference smoke test's mutator sweep (smoke_test.sh:
     204-213): every mutator x {file, stdin} drivers completes a short
-    run with nonzero iterations, no exec errors, and no WARNING+
-    log lines."""
-    import logging
+    run with nonzero iterations, no exec errors, and no WARNING/ERROR
+    log lines (the framework logs to its own stderr stream, so the
+    capture is at the fd level; CRITICAL is the legitimate finding
+    stream and is allowed)."""
     mopts = None
     if mutator == "dictionary":
         mopts = json.dumps({"tokens": ["ABCD", "zz"]})
@@ -544,10 +545,11 @@ def test_mutator_sweep_runs_clean(mutator, driver, tmp_path, caplog):
     drv = driver_factory(driver, None, instr, mut)
     fz = Fuzzer(drv, output_dir=str(tmp_path / "o"), batch_size=8,
                 write_findings=False)
-    with caplog.at_level(logging.WARNING, logger="killerbeez"):
-        stats = fz.run(16)
+    capfd.readouterr()                      # drop setup noise
+    stats = fz.run(16)
+    err = capfd.readouterr().err
     assert stats.iterations > 0
     assert stats.errors == 0
-    warnings = [r for r in caplog.records
-                if r.levelno >= logging.WARNING]
-    assert not warnings, [r.getMessage() for r in warnings]
+    bad = [ln for ln in err.splitlines()
+           if " - WARNING - " in ln or " - ERROR - " in ln]
+    assert not bad, bad

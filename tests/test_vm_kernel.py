@@ -28,7 +28,7 @@ def _mutant_batch(prog_name, rng, B, L):
 
 
 @pytest.mark.parametrize("name", ["test", "tlvstack_vm", "imgparse_vm",
-                                  "hang", "libtest"])
+                                  "rledec_vm", "hang", "libtest"])
 def test_pallas_matches_xla_engine(name, rng):
     prog = targets.get_target(name)
     B, L = LANE_TILE, 32

@@ -366,7 +366,7 @@ def main():
     print(json.dumps({
         "metric": "execs/sec/chip on tlvstack_vm (110-block CGC-grade "
                   f"target; {engine_used} havoc+KBVM+static-edge "
-                  "triage)",
+                  "triage, two-phase tail scheduling)",
         "value": round(vH, 1),
         "unit": "execs/sec",
         "vs_baseline": round(vH / FORKSERVER_BASELINE, 2),

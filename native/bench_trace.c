@@ -1,0 +1,80 @@
+/* Micro-benchmark: drive a kb_protocol forkserver (kb-trace or any
+ * target runtime) in a tight loop and report execs/s.  Used by
+ * docs/HOST_TIER.md's qemu-tier numbers.
+ * Usage: bench-trace N -- forkserver-argv...
+ * (children's stdin = $BT_STDIN if set, else /dev/null)
+ */
+#define _GNU_SOURCE
+#include <fcntl.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/shm.h>
+#include <sys/time.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include "kb_protocol.h"
+
+static double now(void) {
+  struct timeval tv;
+  gettimeofday(&tv, NULL);
+  return tv.tv_sec + tv.tv_usec * 1e-6;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4 || strcmp(argv[2], "--")) {
+    fprintf(stderr, "usage: %s N -- forkserver argv...\n", argv[0]);
+    return 2;
+  }
+  int n = atoi(argv[1]);
+  int shm = shmget(IPC_PRIVATE, KB_SHM_TOTAL, IPC_CREAT | 0600);
+  char env[32];
+  snprintf(env, sizeof env, "%d", shm);
+  setenv(KB_SHM_ENV, env, 1);
+  int cmd_pipe[2], st_pipe[2];
+  if (pipe(cmd_pipe) || pipe(st_pipe)) return 2;
+  /* open stdin in the parent so the loop below can rewind the shared
+   * description per exec, the way the fuzzer's staging does */
+  const char *in = getenv("BT_STDIN");
+  int infd = in ? open(in, O_RDONLY) : -1;
+  pid_t fs = fork();
+  if (fs == 0) {
+    dup2(cmd_pipe[0], KB_FORKSRV_FD);
+    dup2(st_pipe[1], KB_STATUS_FD);
+    int devnull = open("/dev/null", O_RDWR);
+    dup2(infd >= 0 ? infd : devnull, 0);
+    dup2(devnull, 1);
+    execv(argv[3], argv + 3);
+    _exit(125);
+  }
+  close(cmd_pipe[0]);
+  close(st_pipe[1]);
+  uint32_t hello;
+  if (read(st_pipe[0], &hello, 4) != 4 || hello != KB_HELLO) {
+    fprintf(stderr, "no hello\n");
+    return 2;
+  }
+  unsigned char fork_cmd = KB_CMD_FORK_RUN, status_cmd = KB_CMD_GET_STATUS;
+  int32_t pid32, st32;
+  double t0 = now();
+  for (int i = 0; i < n; i++) {
+    if (infd >= 0) lseek(infd, 0, SEEK_SET);
+    if (write(cmd_pipe[1], &fork_cmd, 1) != 1) return 3;
+    if (read(st_pipe[0], &pid32, 4) != 4) return 3;
+    if (write(cmd_pipe[1], &status_cmd, 1) != 1) return 3;
+    if (read(st_pipe[0], &st32, 4) != 4) return 3;
+  }
+  double dt = now() - t0;
+  printf("%d execs in %.3fs = %.0f execs/s (%.2f ms/exec)\n", n, dt,
+         n / dt, dt / n * 1e3);
+  unsigned char exit_cmd = KB_CMD_EXIT;
+  write(cmd_pipe[1], &exit_cmd, 1);
+  waitpid(fs, NULL, 0);
+  unsigned char *map = shmat(shm, NULL, 0);
+  unsigned touched = 0;
+  for (unsigned i = 0; i < KB_MAP_SIZE; i++) touched += map[i] != 0;
+  printf("%u slots touched\n", touched);
+  shmctl(shm, IPC_RMID, NULL);
+  return 0;
+}

@@ -7,21 +7,46 @@
  * afl-qemu-cpu-inl.h semantics).  This is the same capability built
  * on ptrace instead of an emulator: kb_trace IS the forkserver
  * (protocol in kb_protocol.h, fds 198/199), forks the target under
- * PTRACE_TRACEME, single-steps it, and hashes every program-counter
- * transition into the __AFL_SHM_ID bitmap with the AFL edge
- * encoding (cur ^ prev, prev = cur >> 1).
+ * PTRACE_TRACEME and records block-level control flow into the
+ * __AFL_SHM_ID bitmap with the AFL edge encoding
+ * (cur ^ prev, prev = cur >> 1).
+ *
+ * Coverage engine (x86_64): BLOCK-granular, not instruction-granular.
+ *   - PTRACE_SINGLEBLOCK (DEBUGCTL.BTF branch-step) stops the child
+ *     only at branch targets, one stop per basic block executed —
+ *     the same granularity QEMU's translated-block hook gives the
+ *     reference tier (afl-qemu-cpu-inl.h: one log call per TB).
+ *   - Stepping is confined to the main executable's x-ranges.  When
+ *     control leaves the image (library/loader code), the tracer
+ *     plants an int3 at the call's return address (validated by a
+ *     preceding-CALL byte check) and PTRACE_CONTs, so libc runs at
+ *     full native speed; on the first excursion it also breaks on
+ *     any in-image function pointers riding the SysV argument
+ *     registers — that is how main() itself is caught when
+ *     _start -> __libc_start_main(main, ...) leaves the image.
+ *   - The dynamic loader is skipped the same way (entry breakpoint),
+ *     and in forkserver mode a fork-template parked at main() mints
+ *     each exec's child via an injected clone() — the reference QEMU
+ *     forkserver's fork-at-first-translated-block play — so
+ *     steady-state execs skip execve + dynamic loading entirely.
+ *   This turned ~0.2s/exec (per-instruction stepping, round 3) into
+ *   low-single-digit ms/exec — measured numbers in docs/HOST_TIER.md.
  *
  * Trade-offs vs the reference's QEMU tier, documented honestly:
  *   + zero target cooperation: works on any ELF the kernel can run,
  *     no compile-time instrumentation, no emulator build;
  *   + real syscalls/signals (no emulation gaps);
- *   - single-stepping costs ~2 context switches per instruction —
- *     orders slower than QEMU block translation; this tier is for
- *     triage and coverage of small binary-only targets, not
- *     throughput fuzzing (the jit_harness/afl tiers are);
- *   - per-instruction (not per-block) granularity: slot density is
- *     higher than compiled-in edge logging; within-tier novelty is
- *     consistent, cross-tier maps are not comparable.
+ *   - coverage is main-image-only (the reference's default
+ *     AFL_INST_LIBS=0 has the same scope); library-internal paths
+ *     and callbacks invoked from library code via non-argument
+ *     function pointers are not traced;
+ *   - block boundaries come from the hardware branch trap, so slot
+ *     identities differ from compiled-in edge logging; within-tier
+ *     novelty is consistent, cross-tier maps are not comparable.
+ *
+ * Fallback engine: per-instruction PTRACE_SINGLESTEP over everything
+ * (the round-3 engine) on non-x86 hosts, when the kernel rejects
+ * PTRACE_SINGLEBLOCK, or when KB_TRACE_STEP=1 is set.
  *
  * ASLR: the child runs under ADDR_NO_RANDOMIZE, so PCs (and
  * therefore bitmap slots) are stable across execs of one campaign —
@@ -33,6 +58,7 @@
 #define _GNU_SOURCE
 #include <elf.h>
 #include <errno.h>
+#include <limits.h>
 #include <signal.h>
 #include <stdint.h>
 #include <stdio.h>
@@ -57,6 +83,11 @@ static unsigned char *kb_map = kb_local_map;
  * them (the fuzzer's own hang timeout is the primary mechanism). */
 #define KB_MAX_STEPS (1u << 26)
 
+/* A stop signal with no terminating disposition (SIGSTOP group-stop,
+ * or a handled signal whose handler re-raises) would otherwise be
+ * re-delivered forever; bound identical consecutive stops. */
+#define KB_MAX_STALL 16384
+
 static void kb_attach_shm(void) {
   const char *id_str = getenv(KB_SHM_ENV);
   if (!id_str) return;
@@ -80,7 +111,7 @@ static uintptr_t kb_read_pc(pid_t pid) {
 #endif
 }
 
-/* Same PC mixer as kb_rt.c's compiled-in hook — per-instruction here
+/* Same PC mixer as kb_rt.c's compiled-in hook — per-block here
  * instead of per-edge-callback there. */
 static inline unsigned kb_slot(uintptr_t pc) {
   uintptr_t h = pc;
@@ -90,23 +121,75 @@ static inline unsigned kb_slot(uintptr_t pc) {
   return (unsigned)(h & (KB_MAP_SIZE - 1));
 }
 
-/* ---- skip-to-entry: the dynamic loader + libc init are millions of
- * instructions; stepping them per exec cost ~8s.  Plant a breakpoint
- * at the target ELF's entry point, PTRACE_CONT to it at full speed,
- * and single-step only from there (QEMU's translation cache plays
- * the same role for the reference's tier).  Any failure falls back
- * to stepping everything. ---- */
+static unsigned kb_prev; /* rolling AFL edge state, reset per exec */
+
+static FILE *kb_log; /* KB_TRACE_LOG=path: per-exec PC stream dump */
+
+static inline void kb_record(uintptr_t pc) {
+  unsigned cur = kb_slot(pc);
+  if (kb_log) fprintf(kb_log, "%lx\n", (unsigned long)pc);
+  kb_map[cur ^ kb_prev]++;
+  kb_prev = cur >> 1;
+}
+
+/* ---- main-image executable ranges (block mode steps only inside
+ * these; everything else runs under PTRACE_CONT at native speed) */
+
+typedef struct {
+  uintptr_t lo, hi;
+} kb_range;
+#define KB_MAX_XR 16
+static kb_range kb_xr[KB_MAX_XR];
+static int kb_nxr;
+
+static int kb_in_image(uintptr_t pc) {
+  for (int i = 0; i < kb_nxr; i++)
+    if (pc >= kb_xr[i].lo && pc < kb_xr[i].hi) return 1;
+  return 0;
+}
+
+static int kb_load_xranges(pid_t pid, const char *target) {
+  static char real[PATH_MAX], line[PATH_MAX + 128], path[PATH_MAX];
+  char mp[64];
+  /* ADDR_NO_RANDOMIZE pins the layout, so the ranges from the first
+   * exec hold for every later fork of the same target: parse once. */
+  if (kb_nxr) return kb_nxr;
+  if (!realpath(target, real)) return 0;
+  snprintf(mp, sizeof mp, "/proc/%d/maps", (int)pid);
+  FILE *f = fopen(mp, "r");
+  while (f && fgets(line, sizeof line, f)) {
+    unsigned long lo, hi;
+    char perms[8];
+    path[0] = 0;
+    if (sscanf(line, "%lx-%lx %7s %*s %*s %*s %4095s",
+               &lo, &hi, perms, path) >= 3 &&
+        strchr(perms, 'x') && !strcmp(path, real) &&
+        kb_nxr < KB_MAX_XR) {
+      kb_xr[kb_nxr].lo = lo;
+      kb_xr[kb_nxr].hi = hi;
+      kb_nxr++;
+    }
+  }
+  if (f) fclose(f);
+  return kb_nxr;
+}
+
+/* ---- skip-to-entry: the dynamic loader is millions of
+ * instructions; plant a breakpoint at the target ELF's entry point,
+ * PTRACE_CONT to it at full speed, and trace only from there (QEMU's
+ * translation cache plays the same role for the reference's tier).
+ * Any failure falls back to tracing everything. ---- */
 
 static uintptr_t kb_image_base(pid_t pid, const char *real) {
-  char mp[64], line[512];
+  static char line[PATH_MAX + 128], path[PATH_MAX];
+  char mp[64];
   snprintf(mp, sizeof mp, "/proc/%d/maps", (int)pid);
   FILE *f = fopen(mp, "r");
   uintptr_t base = 0;
   while (f && fgets(line, sizeof line, f)) {
     unsigned long lo, hi;
-    char path[384];
     path[0] = 0;
-    if (sscanf(line, "%lx-%lx %*s %*s %*s %*s %383s",
+    if (sscanf(line, "%lx-%lx %*s %*s %*s %*s %4095s",
                &lo, &hi, path) >= 2 && !strcmp(path, real)) {
       base = lo;
       break; /* lowest mapping of the image */
@@ -117,7 +200,9 @@ static uintptr_t kb_image_base(pid_t pid, const char *real) {
 }
 
 static uintptr_t kb_entry_addr(pid_t pid, const char *target) {
-  char real[512];
+  static uintptr_t cached; /* stable: ADDR_NO_RANDOMIZE, one target */
+  static char real[PATH_MAX];
+  if (cached) return cached;
   if (!realpath(target, real)) return 0;
   FILE *f = fopen(real, "rb");
   if (!f) return 0;
@@ -127,10 +212,10 @@ static uintptr_t kb_entry_addr(pid_t pid, const char *target) {
   if (n != sizeof eh || memcmp(eh.e_ident, ELFMAG, SELFMAG) != 0 ||
       eh.e_ident[EI_CLASS] != ELFCLASS64)
     return 0;
-  if (eh.e_type == ET_EXEC) return (uintptr_t)eh.e_entry;
+  if (eh.e_type == ET_EXEC) return cached = (uintptr_t)eh.e_entry;
   if (eh.e_type != ET_DYN) return 0;
   uintptr_t base = kb_image_base(pid, real);
-  return base ? base + (uintptr_t)eh.e_entry : 0;
+  return cached = base ? base + (uintptr_t)eh.e_entry : 0;
 }
 
 #if defined(__x86_64__)
@@ -158,13 +243,124 @@ static void kb_set_pc(pid_t pid, uintptr_t pc) {
 #endif
 }
 
-/* Returns 0 if the child is stopped and ready for stepping (at entry
+/* ---- re-entry breakpoints (block mode): int3s planted in the
+ * child's image so control returning from an untraced library
+ * excursion hands the stop back to the tracer.  Per-exec table (the
+ * child's text is fresh each fork). ---- */
+
+typedef struct {
+  uintptr_t addr;
+  long orig;
+} kb_bp;
+#define KB_MAX_BP 64
+static kb_bp kb_bps[KB_MAX_BP];
+static int kb_nbps;
+
+static int kb_bp_find(uintptr_t addr) {
+  for (int i = 0; i < kb_nbps; i++)
+    if (kb_bps[i].addr == addr) return i;
+  return -1;
+}
+
+static void kb_bp_plant(pid_t pid, uintptr_t addr) {
+  if (!kb_in_image(addr) || kb_nbps >= KB_MAX_BP ||
+      kb_bp_find(addr) >= 0)
+    return;
+  errno = 0;
+  long orig = ptrace(PTRACE_PEEKTEXT, pid, (void *)addr, NULL);
+  if (orig == -1 && errno) return;
+  if (ptrace(PTRACE_POKETEXT, pid, (void *)addr,
+             (void *)KB_BP_WORD((unsigned long)orig)) != 0)
+    return;
+  kb_bps[kb_nbps].addr = addr;
+  kb_bps[kb_nbps].orig = orig;
+  kb_nbps++;
+}
+
+/* Restore the original word at addr if we have a breakpoint there;
+ * returns 1 if one was armed. */
+static int kb_bp_clear(pid_t pid, uintptr_t addr) {
+  int i = kb_bp_find(addr);
+  if (i < 0) return 0;
+  ptrace(PTRACE_POKETEXT, pid, (void *)kb_bps[i].addr,
+         (void *)kb_bps[i].orig);
+  kb_bps[i] = kb_bps[--kb_nbps];
+  return 1;
+}
+
+#if defined(__x86_64__)
+/* A genuine return address is preceded by a CALL: E8 rel32 (5 bytes)
+ * or an FF /2 indirect form (2-7 bytes).  Rejecting non-CALL-preceded
+ * stack words keeps us from planting int3 mid-instruction off stale
+ * stack data when the image is left via `ret` (callback returning to
+ * its library caller). */
+static int kb_looks_like_retaddr(pid_t pid, uintptr_t r) {
+  errno = 0;
+  unsigned long w =
+      (unsigned long)ptrace(PTRACE_PEEKTEXT, pid, (void *)(r - 8), NULL);
+  if (errno) return 0;
+  unsigned char b[8];
+  memcpy(b, &w, 8);
+  if (b[3] == 0xE8) return 1; /* call rel32 at r-5 */
+  for (int k = 2; k <= 7; k++)
+    /* call r/m64 is FF /2: opcode at r-k, ModRM reg field == 2 */
+    if (b[8 - k] == 0xFF && ((b[8 - k + 1] >> 3) & 7) == 2) return 1;
+  return 0;
+}
+
+/* main()'s address, learned on the first exec: at the
+ * _start -> __libc_start_main(main, ...) excursion, main rides rdi.
+ * Later execs start tracing THERE instead of at the ELF entry —
+ * skipping the csu init blocks, and teardown too, because the
+ * ret-from-main excursion plants no breakpoints so the child just
+ * runs to exit at native speed.  Stable across execs
+ * (ADDR_NO_RANDOMIZE). */
+static uintptr_t kb_main_addr;
+
+/* main() may only be learned before the first recorded exec (warm-up
+ * or one-shot); learning mid-campaign would flip later execs from
+ * traced-from-entry to traced-from-main and make identical inputs
+ * produce different maps. */
+static int kb_allow_learn = 1;
+
+/* The child just branched out of the image (library/loader call).
+ * Arrange to regain control when it comes back: break on the call's
+ * return address, and — first excursion of a learning (traced-from-
+ * entry) exec, which is _start -> __libc_start_main(main, ...) — on
+ * any in-image function pointers riding the argument registers,
+ * which is how main()/init are delivered to libc. */
+static void kb_plant_excursion_bps(pid_t pid, int first) {
+  struct user_regs_struct regs;
+  if (ptrace(PTRACE_GETREGS, pid, NULL, &regs) != 0) return;
+  /* [rsp] is the return address for a call/PLT-jmp excursion; the
+   * lazy-resolver shape (push link_map; push reloc; jmp resolver)
+   * buries it at [rsp+16] — accept the first stack word that looks
+   * like a genuine in-image return address. */
+  for (int d = 0; d <= 2; d++) {
+    errno = 0;
+    unsigned long ret = (unsigned long)ptrace(
+        PTRACE_PEEKDATA, pid, (void *)(regs.rsp + 8ul * d), NULL);
+    if (!errno && kb_in_image(ret) && kb_looks_like_retaddr(pid, ret)) {
+      kb_bp_plant(pid, ret);
+      break;
+    }
+  }
+  if (first) {
+    unsigned long cand[6] = {regs.rdi, regs.rsi, regs.rdx,
+                             regs.rcx, regs.r8,  regs.r9};
+    for (int i = 0; i < 6; i++)
+      if (kb_in_image(cand[i])) kb_bp_plant(pid, cand[i]);
+    if (kb_allow_learn && kb_in_image(regs.rdi))
+      kb_main_addr = regs.rdi;
+  }
+}
+#endif /* __x86_64__ */
+
+/* Returns 0 if the child is stopped and ready for stepping (at addr
  * or, on any fallback, wherever it already was), or sets *status_out
  * and returns 1 if the child terminated while getting there. */
-static int kb_run_to_entry(pid_t pid, const char *target,
-                           int *status_out) {
+static int kb_run_to(pid_t pid, uintptr_t entry, int *status_out) {
   errno = 0;
-  uintptr_t entry = kb_entry_addr(pid, target);
   if (!entry) return 0;
   long orig = ptrace(PTRACE_PEEKTEXT, pid, (void *)entry, NULL);
   if (orig == -1 && errno) return 0;
@@ -173,7 +369,7 @@ static int kb_run_to_entry(pid_t pid, const char *target,
     return 0;
   if (ptrace(PTRACE_CONT, pid, NULL, NULL) != 0) return 0;
   int status;
-  if (waitpid(pid, &status, 0) < 0) return 0;
+  if (waitpid(pid, &status, __WALL) < 0) return 0;
   if (WIFEXITED(status) || WIFSIGNALED(status)) {
     *status_out = status;
     return 1;
@@ -192,51 +388,366 @@ static pid_t kb_spawn(char **argv) {
     close(KB_FORKSRV_FD);
     close(KB_STATUS_FD);
     personality(ADDR_NO_RANDOMIZE); /* stable PCs -> stable slots */
+    /* lazy PLT binding would bounce the first call of every import
+     * through the dynamic resolver, whose stack frame hides the
+     * caller's return address from the excursion breakpoint logic
+     * (the child would escape tracing there); bind everything up
+     * front instead.  Template mode pays this once. */
+    putenv((char *)"LD_BIND_NOW=1");
     if (ptrace(PTRACE_TRACEME, 0, NULL, NULL) != 0) _exit(124);
     execvp(argv[0], argv);
     _exit(125); /* exec failed */
   }
   /* child stops with SIGTRAP at the execvp boundary */
   int status;
-  if (waitpid(pid, &status, 0) < 0 || !WIFSTOPPED(status)) {
+  if (waitpid(pid, &status, __WALL) < 0 || !WIFSTOPPED(status)) {
     if (pid > 0) kill(pid, SIGKILL);
     return -1;
   }
   return pid;
 }
 
-/* Single-step `pid` to completion, filling the bitmap.  Returns the
- * final wait status (exit or fatal signal). */
+/* Watchdog for the startup runs (warm-up, template parking): kills
+ * the guarded child if it hangs before reaching its stop point. */
+static volatile pid_t kb_guard_pid;
+
+static void kb_guard_alarm(int sig) {
+  (void)sig;
+  if (kb_guard_pid > 0) kill(kb_guard_pid, SIGKILL);
+}
+
+/* ---- fork-template (x86_64): the reference's QEMU tier starts its
+ * forkserver at the first translated block inside the emulated
+ * target (afl-qemu-cpu-inl.h semantics), so steady-state execs pay
+ * one fork, not execve + dynamic loading.  Same play here with pure
+ * ptrace: keep one "template" child stopped at main, and mint each
+ * exec's child by injecting a clone() syscall into it —
+ * CLONE_PARENT (the new child is OURS to waitpid) | CLONE_PTRACE
+ * (it is born traced by us), exit_signal 0 (no SIGCHLD floods the
+ * stopped template).  The clone starts at a planted int3, gets its
+ * text and registers restored, and is then traced from main like
+ * any other child.  Any failure falls back to plain spawn. ---- */
+#if defined(__x86_64__)
+#define KB_SYS_CLONE 56
+#define KB_CLONE_FLAGS (0x00008000UL /*CLONE_PARENT*/ | \
+                        0x00002000UL /*CLONE_PTRACE*/)
+
+static pid_t kb_template;
+static struct user_regs_struct kb_tmpl_regs;
+static long kb_tmpl_word;
+
+static void kb_template_drop(void) {
+  if (kb_template > 0) {
+    kill(kb_template, SIGKILL);
+    waitpid(kb_template, NULL, __WALL);
+  }
+  kb_template = 0;
+}
+
+static void kb_template_setup(char **argv) {
+  int status;
+  if (!kb_main_addr) return;
+  pid_t pid = kb_spawn(argv);
+  if (pid < 0) return;
+  kb_guard_pid = pid;
+  alarm(5);
+  int died = kb_run_to(pid, kb_main_addr, &status);
+  alarm(0);
+  kb_guard_pid = 0;
+  if (died) return; /* died (or was reaped by the guard) pre-main */
+  if (kb_read_pc(pid) != kb_main_addr ||
+      ptrace(PTRACE_GETREGS, pid, NULL, &kb_tmpl_regs) != 0) {
+    kill(pid, SIGKILL);
+    waitpid(pid, NULL, __WALL);
+    return;
+  }
+  errno = 0;
+  kb_tmpl_word = ptrace(PTRACE_PEEKTEXT, pid, (void *)kb_main_addr, NULL);
+  if (kb_tmpl_word == -1 && errno) {
+    kill(pid, SIGKILL);
+    waitpid(pid, NULL, __WALL);
+    return;
+  }
+  kb_template = pid;
+}
+
+/* Mint one child from the template.  Returns its pid stopped at
+ * kb_main_addr with clean text, or -1 (caller falls back to spawn). */
+static pid_t kb_template_fork(void) {
+  if (kb_template <= 0) return -1;
+  uintptr_t a = kb_main_addr;
+  /* gadget: syscall; int3 — the clone child runs into the int3 */
+  unsigned long gadget =
+      ((unsigned long)kb_tmpl_word & ~0xFFFFFFUL) | 0xCC050FUL;
+  if (ptrace(PTRACE_POKETEXT, kb_template, (void *)a, (void *)gadget) != 0)
+    goto dead;
+  {
+    struct user_regs_struct r = kb_tmpl_regs;
+    r.rip = a;
+    r.rax = KB_SYS_CLONE;
+    r.rdi = KB_CLONE_FLAGS;
+    r.rsi = 0; /* child_stack NULL: share the CoW stack like fork */
+    r.rdx = 0;
+    r.r10 = 0;
+    r.r8 = 0;
+    if (ptrace(PTRACE_SETREGS, kb_template, NULL, &r) != 0) goto dead;
+  }
+  {
+    int st, tries;
+    for (tries = 0; tries < 64; tries++) {
+      if (ptrace(PTRACE_SINGLESTEP, kb_template, NULL, NULL) != 0)
+        goto dead;
+      if (waitpid(kb_template, &st, __WALL) < 0) goto dead;
+      if (!WIFSTOPPED(st)) {
+        kb_template = 0; /* template died; nothing to clean up */
+        return -1;
+      }
+      if (WSTOPSIG(st) == SIGTRAP) break; /* syscall retired */
+      /* stray pending signal: suppress and retry the step */
+    }
+    if (tries == 64) goto dead;
+  }
+  {
+    struct user_regs_struct r2;
+    pid_t child;
+    int st2;
+    if (ptrace(PTRACE_GETREGS, kb_template, NULL, &r2) != 0) goto dead;
+    child = (pid_t)(long)r2.rax;
+    /* park the template back at main with original text */
+    ptrace(PTRACE_POKETEXT, kb_template, (void *)a, (void *)kb_tmpl_word);
+    ptrace(PTRACE_SETREGS, kb_template, NULL, &kb_tmpl_regs);
+    if (child <= 0) return -1;
+    if (waitpid(child, &st2, __WALL) < 0) return -1;
+    if (!WIFSTOPPED(st2)) return -1; /* died before the int3?! */
+    if (ptrace(PTRACE_POKETEXT, child, (void *)a,
+               (void *)kb_tmpl_word) != 0 ||
+        ptrace(PTRACE_SETREGS, child, NULL, &kb_tmpl_regs) != 0) {
+      kill(child, SIGKILL);
+      waitpid(child, NULL, __WALL);
+      return -1;
+    }
+    return child;
+  }
+dead:
+  kb_template_drop();
+  return -1;
+}
+#endif /* __x86_64__ */
+
+static unsigned kb_dbg_stops, kb_dbg_excursions;
+static unsigned kb_dbg_tforks, kb_dbg_spawns;
+
+/* Fallback engine: single-step `pid` to completion over everything,
+ * per-instruction edges (non-x86 hosts, SINGLEBLOCK-less kernels,
+ * KB_TRACE_STEP=1).  Returns the final wait status. */
 static int kb_step_loop(pid_t pid, const char *target) {
-  unsigned prev = 0;
   int status = 0;
-  int deliver = 0;
-  if (kb_run_to_entry(pid, target, &status)) return status;
+  int deliver = 0, stall = 0, last_sig = 0;
+  uintptr_t last_pc = 0;
+  kb_prev = 0;
+  if (kb_run_to(pid, kb_entry_addr(pid, target), &status)) return status;
   for (unsigned n = 0; n < KB_MAX_STEPS; n++) {
     if (ptrace(PTRACE_SINGLESTEP, pid, NULL,
                (void *)(uintptr_t)deliver) != 0) {
       /* child vanished (e.g. fuzzer SIGKILLed it on hang timeout) */
-      waitpid(pid, &status, 0);
+      waitpid(pid, &status, __WALL);
       return status;
     }
-    if (waitpid(pid, &status, 0) < 0) return status;
+    if (waitpid(pid, &status, __WALL) < 0) return status;
     if (WIFEXITED(status) || WIFSIGNALED(status)) return status;
     if (!WIFSTOPPED(status)) return status;
     int sig = WSTOPSIG(status);
     if (sig == SIGTRAP) {
       deliver = 0;
-      unsigned cur = kb_slot(kb_read_pc(pid));
-      kb_map[cur ^ prev]++;
-      prev = cur >> 1;
+      stall = 0;
+      kb_dbg_stops++;
+      kb_record(kb_read_pc(pid));
     } else {
       /* deliver the real signal; default dispositions (SIGSEGV...)
-       * then terminate the child and we report that status */
-      deliver = sig;
+       * then terminate the child and we report that status.
+       * SIGSTOP has no terminating disposition — re-delivering it
+       * just re-stops the child every step; suppress it, and bound
+       * any identical repeating stop (handler that re-raises). */
+      uintptr_t pc = kb_read_pc(pid);
+      if (sig == last_sig && pc == last_pc) {
+        if (++stall > KB_MAX_STALL) break;
+      } else {
+        stall = 0;
+        last_sig = sig;
+        last_pc = pc;
+      }
+      deliver = sig == SIGSTOP ? 0 : sig;
     }
   }
   kill(pid, SIGKILL); /* runaway: no fuzzer attached to time it out */
-  waitpid(pid, &status, 0);
+  waitpid(pid, &status, __WALL);
   return status;
+}
+
+#if defined(__x86_64__)
+/* An int3 stop (planted breakpoint) reports si_code SI_KERNEL or
+ * TRAP_BRKPT; a branch/single-step stop reports TRAP_TRACE.  A
+ * branch-step stop can legitimately land one byte past an armed
+ * breakpoint that was never executed — without this check it would
+ * be mis-rewound onto the byte before it. */
+static int kb_stopped_on_int3(pid_t pid) {
+  siginfo_t si;
+  if (ptrace(PTRACE_GETSIGINFO, pid, NULL, &si) != 0) return 1;
+  return si.si_code != TRAP_TRACE;
+}
+
+/* Block engine: branch-granular stepping inside the main image,
+ * native-speed PTRACE_CONT over everything else.  Returns the final
+ * wait status, or -2 meaning "SINGLEBLOCK unsupported, child still
+ * stopped at entry untouched — use the step loop". */
+static int kb_block_loop(pid_t pid, const char *target) {
+  int status = 0;
+  int deliver = 0, stall = 0, last_sig = 0, excursions = 0;
+  uintptr_t last_pc = 0;
+  kb_prev = 0;
+  kb_nbps = 0;
+  if (!kb_load_xranges(pid, target)) return -2;
+  int from_entry = kb_main_addr == 0;
+  uintptr_t start =
+      from_entry ? kb_entry_addr(pid, target) : kb_main_addr;
+  /* template-forked children are already parked at start */
+  uintptr_t pc = kb_read_pc(pid);
+  if (pc != start) {
+    if (kb_run_to(pid, start, &status)) return status;
+    pc = kb_read_pc(pid); /* == start, or wherever run_to fell back */
+  }
+  for (unsigned n = 0; n < KB_MAX_STEPS; n++) {
+    int stepping = kb_in_image(pc);
+    kb_dbg_stops++;
+    if (!stepping) {
+      kb_dbg_excursions++;
+      kb_plant_excursion_bps(pid, from_entry && excursions++ == 0);
+    }
+    long req = stepping ? PTRACE_SINGLEBLOCK : PTRACE_CONT;
+    if (ptrace(req, pid, NULL, (void *)(uintptr_t)deliver) != 0) {
+      if (n == 0 && req == PTRACE_SINGLEBLOCK &&
+          (errno == EIO || errno == EINVAL || errno == ENOSYS))
+        return -2; /* kernel lacks branch-step: fall back untouched */
+      waitpid(pid, &status, __WALL); /* vanished (hang-timeout kill) */
+      return status;
+    }
+    deliver = 0;
+    if (waitpid(pid, &status, __WALL) < 0) return status;
+    if (!WIFSTOPPED(status)) return status;
+    int sig = WSTOPSIG(status);
+    if (sig == SIGTRAP) {
+      stall = 0;
+      uintptr_t pc2 = kb_read_pc(pid);
+      if (kb_bp_find(pc2 - KB_BP_PC_REWIND) >= 0 &&
+          kb_stopped_on_int3(pid)) {
+        /* re-entry breakpoint: rewind over the int3 and resume
+         * block-stepping from the block it guards */
+        pc = pc2 - KB_BP_PC_REWIND;
+        kb_bp_clear(pid, pc);
+        kb_set_pc(pid, pc);
+        kb_record(pc);
+      } else if (req == PTRACE_SINGLEBLOCK) {
+        if (kb_in_image(pc2)) {
+          /* branch-step stop at a block head; if a pending re-entry
+           * bp sits exactly here, disarm it before it executes */
+          kb_bp_clear(pid, pc2);
+          kb_record(pc2);
+        }
+        /* else: left the image; next iteration plants + CONTs */
+        pc = pc2;
+      } else {
+        deliver = SIGTRAP; /* target's own int3/trap under CONT */
+        pc = pc2;
+      }
+    } else {
+      pc = kb_read_pc(pid);
+      if (sig == last_sig && pc == last_pc) {
+        if (++stall > KB_MAX_STALL) break;
+      } else {
+        stall = 0;
+        last_sig = sig;
+        last_pc = pc;
+      }
+      deliver = sig == SIGSTOP ? 0 : sig;
+    }
+  }
+  kill(pid, SIGKILL); /* runaway: no fuzzer attached to time it out */
+  waitpid(pid, &status, __WALL);
+  return status;
+}
+#endif /* __x86_64__ */
+
+
+/* Diagnostic engine (KB_TRACE_OFF=1): no coverage at all, just run
+ * the child to completion delivering signals — isolates the ptrace
+ * fork/exec floor when profiling the tracer itself. */
+static int kb_null_loop(pid_t pid) {
+  int status = 0;
+  int deliver = 0;
+  for (;;) {
+    if (ptrace(PTRACE_CONT, pid, NULL, (void *)(uintptr_t)deliver) != 0) {
+      waitpid(pid, &status, __WALL);
+      return status;
+    }
+    if (waitpid(pid, &status, __WALL) < 0) return status;
+    if (!WIFSTOPPED(status)) return status;
+    deliver = WSTOPSIG(status) == SIGSTOP ? 0 : WSTOPSIG(status);
+  }
+}
+
+static int kb_opt_off, kb_opt_step; /* KB_TRACE_OFF / KB_TRACE_STEP */
+
+static int kb_env_flag(const char *name) {
+  const char *e = getenv(name);
+  return e && e[0] && e[0] != '0';
+}
+
+/* Trace `pid` to completion with the best available engine. */
+static int kb_trace_child(pid_t pid, const char *target) {
+  if (kb_opt_off) return kb_null_loop(pid);
+#if defined(__x86_64__)
+  if (!kb_opt_step) {
+    int st = kb_block_loop(pid, target);
+    if (st != -2) return st;
+    kb_opt_step = 1; /* unsupported here; don't retry every exec */
+  }
+#endif
+  return kb_step_loop(pid, target);
+}
+
+/* ---- startup warm-up (forkserver mode): one throwaway exec, its
+ * coverage diverted to a scratch map, that learns the image ranges
+ * and main()'s address BEFORE any real exec.  Every recorded exec
+ * then traces from main via the template, so identical inputs
+ * produce identical maps — without this, exec 1 (traced from the
+ * ELF entry) and exec 2+ (traced from main) would differ and the
+ * second exec of a seed would look novel.  Stdin is the fuzzer's
+ * not-yet-staged (empty) input file: reads hit EOF, and the fuzzer
+ * re-stages + rewinds the shared description before every real
+ * exec, so nothing is consumed.  An alarm bounds targets that hang
+ * before exiting (the learned ranges survive the kill). */
+static void kb_warmup(char **argv) {
+  static unsigned char scratch[KB_SHM_TOTAL];
+  unsigned char *saved = kb_map;
+  pid_t pid = kb_spawn(argv);
+  if (pid < 0) return;
+  kb_map = scratch;
+  kb_guard_pid = pid;
+  alarm(5);
+  kb_trace_child(pid, argv[0]);
+  alarm(0);
+  kb_guard_pid = 0;
+  kb_map = saved;
+  kb_allow_learn = 0; /* from here on the trace start is frozen */
+  /* the warm-up child shares our stdin description; if the fuzzer
+   * had already staged the first input (forkserver starts lazily on
+   * the first exec), the warm-up consumed it — rewind.  ESPIPE on
+   * non-seekable stdin is harmless. */
+  lseek(0, 0, SEEK_SET);
+  if (kb_log) {
+    fprintf(kb_log, "--- warmup\n");
+    fflush(kb_log);
+  }
 }
 
 int main(int argc, char **argv) {
@@ -245,21 +756,38 @@ int main(int argc, char **argv) {
     return 2;
   }
   kb_attach_shm();
+  {
+    const char *lp = getenv("KB_TRACE_LOG");
+    if (lp) kb_log = fopen(lp, "a");
+  }
+  kb_opt_off = kb_env_flag("KB_TRACE_OFF");
+  kb_opt_step = kb_env_flag("KB_TRACE_STEP");
 
   uint32_t hello = KB_HELLO;
   if (write(KB_STATUS_FD, &hello, 4) != 4) {
     /* no fuzzer attached: trace one run, report coverage, propagate */
     pid_t pid = kb_spawn(argv + 1);
     if (pid < 0) return 2;
-    int status = kb_step_loop(pid, argv[1]);
+    int status = kb_trace_child(pid, argv[1]);
     unsigned touched = 0;
     for (unsigned i = 0; i < KB_MAP_SIZE; i++) touched += kb_map[i] != 0;
     fprintf(stderr, "kb_trace: %u bitmap slots touched\n", touched);
+    if (getenv("KB_TRACE_DEBUG"))
+      fprintf(stderr, "kb_trace: %u stops, %u excursions\n",
+              kb_dbg_stops, kb_dbg_excursions);
     if (WIFSIGNALED(status)) {
       raise(WTERMSIG(status));
       return 128 + WTERMSIG(status);
     }
     return WIFEXITED(status) ? WEXITSTATUS(status) : 2;
+  }
+
+  signal(SIGALRM, kb_guard_alarm);
+  if (!kb_opt_off && !kb_opt_step) {
+    kb_warmup(argv + 1);
+#if defined(__x86_64__)
+    if (!getenv("KB_TRACE_NOFORK")) kb_template_setup(argv + 1);
+#endif
   }
 
   pid_t child = -1;
@@ -268,12 +796,29 @@ int main(int argc, char **argv) {
     if (read(KB_FORKSRV_FD, &cmd, 1) != 1) _exit(0);
     switch (cmd) {
       case KB_CMD_EXIT:
+#if defined(__x86_64__)
+        kb_template_drop();
+#endif
         if (child > 0) kill(child, SIGKILL);
+        if (getenv("KB_TRACE_DEBUG"))
+          fprintf(stderr,
+                  "kb_trace: %u stops, %u excursions, %u tforks, "
+                  "%u spawns\n",
+                  kb_dbg_stops, kb_dbg_excursions, kb_dbg_tforks,
+                  kb_dbg_spawns);
         _exit(0);
 
       case KB_CMD_FORK:
       case KB_CMD_FORK_RUN: {
-        child = kb_spawn(argv + 1);
+        child = -1;
+#if defined(__x86_64__)
+        child = kb_template_fork();
+        if (child > 0) kb_dbg_tforks++;
+#endif
+        if (child < 0) {
+          child = kb_spawn(argv + 1);
+          kb_dbg_spawns++;
+        }
         int32_t pid32 = (int32_t)child;
         if (write(KB_STATUS_FD, &pid32, 4) != 4) _exit(1);
         if (child < 0) _exit(1);
@@ -288,8 +833,12 @@ int main(int argc, char **argv) {
       case KB_CMD_GET_STATUS: {
         int32_t st32 = -1;
         if (child > 0) {
-          st32 = (int32_t)kb_step_loop(child, argv[1]);
+          st32 = (int32_t)kb_trace_child(child, argv[1]);
           child = -1;
+          if (kb_log) {
+            fprintf(kb_log, "---\n");
+            fflush(kb_log);
+          }
         }
         if (write(KB_STATUS_FD, &st32, 4) != 4) _exit(1);
         break;

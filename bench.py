@@ -81,37 +81,38 @@ def bench_host_configs():
             if warmup:
                 fz.run(warmup)
             done = fz.stats.iterations
+            warm_crashes = fz.stats.crashes  # exclude warmup findings
             t0 = time.time()
             stats = fz.run(done + n_iters)
             return ((stats.iterations - done) / (time.time() - t0),
-                    stats)
+                    stats, stats.crashes - warm_crashes)
         finally:
             if drv is not None:
                 drv.cleanup()
             instr.cleanup()
 
     # config 1: file + return_code + bit_flip -n 20 (smoke_test.sh:41-70)
-    v, stats = run_config(
+    v, stats, _ = run_config(
         20, 20, "return_code", None, "file",
         json.dumps({"path": test_bin, "arguments": "@@"}), "c1")
     emit(1, "file+return_code+bit_flip 20 iters", v, baseline=180.0,
          iterations=stats.iterations)
 
     # config 2: stdin + afl(forkserver) + havoc, single instance
-    v, stats = run_config(
+    v, stats, crashes = run_config(
         2000, 500, "afl", None, "stdin",
         json.dumps({"path": test_bin}), "c2", warmup=500)
     emit(2, "stdin+afl forkserver, 1 instance", v,
-         baseline=FORKSERVER_BASELINE, crashes=stats.crashes)
+         baseline=FORKSERVER_BASELINE, crashes=crashes)
 
     # config 3: TPU-batch mutation + host forkserver pool
     workers = os.cpu_count() or 1
-    v, stats = run_config(
+    v, stats, crashes = run_config(
         8192, 2048, "afl", json.dumps({"workers": workers}), "stdin",
         json.dumps({"path": test_bin}), "c3", warmup=2048)
     emit(3, f"tpu-batch mutate + forkserver pool x{workers}", v,
          baseline=FORKSERVER_BASELINE, host_cores=workers,
-         crashes=stats.crashes)
+         crashes=crashes)
 
 
 
@@ -190,7 +191,8 @@ def bench_device_fused(target, batch, steps, seed):
         make_static_maps, static_triage,
     )
     from killerbeez_tpu.ops.vm_kernel import (
-        auto_phase1_steps, fuzz_batch_pallas_2phase, havoc_words,
+        auto_phase1_steps, dot_modes, fuzz_batch_pallas_2phase,
+        havoc_words,
     )
 
     prog = targets.get_target(target)
@@ -208,7 +210,8 @@ def bench_device_fused(target, batch, steps, seed):
                         batch)
         res, bufs, lens = fuzz_batch_pallas_2phase(
             ins, tbl, seed_j, seed_len, w, prog.mem_size,
-            prog.max_steps, prog.n_edges, phase1_steps=p1)
+            prog.max_steps, prog.n_edges, phase1_steps=p1,
+            dots=dot_modes(prog.instrs, prog.n_edges))
         statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
                              res.status)
         new_paths, uc, uh, vb2, vc2, vh2 = static_triage(
@@ -366,7 +369,8 @@ def main():
     print(json.dumps({
         "metric": "execs/sec/chip on tlvstack_vm (110-block CGC-grade "
                   f"target; {engine_used} havoc+KBVM+static-edge "
-                  "triage, two-phase tail scheduling)",
+                  "triage, two-phase tail scheduling, exact-bf16 MXU "
+                  "dots)",
         "value": round(vH, 1),
         "unit": "execs/sec",
         "vs_baseline": round(vH / FORKSERVER_BASELINE, 2),

@@ -236,7 +236,22 @@ class Fuzzer:
                 uc = np.asarray(res.unique_crashes)
                 uh = np.asarray(res.unique_hangs)
             for i in interesting:
-                r = rows[int(i)] if rows is not None else i
+                if rows is not None:
+                    r = rows.get(int(i))
+                    if r is None:
+                        # device-side interesting predicate drifted
+                        # from the host one; don't lose the rest of
+                        # the pipelined drain — fall back to the full
+                        # candidate tensors for this batch
+                        WARNING_MSG(
+                            "compact report missing lane %d; pulling "
+                            "full batch", int(i))
+                        inputs = np.asarray(out.inputs)
+                        lengths = np.asarray(out.lengths)
+                        rows = None
+                        r = i
+                else:
+                    r = i
                 buf = inputs[r, :int(lengths[r])].tobytes()
                 self._triage_lane(int(statuses[i]), int(new_paths[i]),
                                   buf, bool(uc[i]), bool(uh[i]))

@@ -92,10 +92,10 @@ def _triage_counts(counts, statuses, u_slots, seg_id, vb, vc, vh,
 
 
 @partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
-                                   "exact", "engine"))
+                                   "exact", "engine", "dots"))
 def _fused_step(instrs, edge_table, u_slots, seg_id, inputs, lengths,
                 vb, vc, vh, mem_size, max_steps, n_edges, exact,
-                engine="xla"):
+                engine="xla", dots=("f32", "f32")):
     """mutated batch -> VM exec -> static-edge triage, one XLA program.
 
     ``engine="pallas"`` runs the VM loop in the Pallas VMEM-resident
@@ -107,7 +107,7 @@ def _fused_step(instrs, edge_table, u_slots, seg_id, inputs, lengths,
         from ..ops.vm_kernel import run_batch_pallas_padded
         res = run_batch_pallas_padded(instrs, edge_table, inputs,
                                       lengths, mem_size, max_steps,
-                                      n_edges)
+                                      n_edges, dots=dots)
     else:
         res = _run_batch_impl(instrs, edge_table, inputs, lengths,
                               mem_size, max_steps, n_edges, False)
@@ -125,11 +125,11 @@ COMPACT_CAP = 1024
 
 @partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
                                    "exact", "stack_pow2",
-                                   "phase1_steps"))
+                                   "phase1_steps", "dots"))
 def _fused_fuzz_step(instrs, edge_table, u_slots, seg_id, seed_buf,
                      seed_len, base_key, its, n_real, vb, vc, vh,
                      mem_size, max_steps, n_edges, exact, stack_pow2,
-                     phase1_steps=0):
+                     phase1_steps=0, dots=("f32", "f32")):
     """The flagship product path: per-lane PRNG keys, havoc mutation
     AND VM execution in one program (mutate+exec share a single
     pallas_call, ops/vm_kernel.fuzz_batch_pallas) followed by
@@ -148,7 +148,7 @@ def _fused_fuzz_step(instrs, edge_table, u_slots, seg_id, seed_buf,
     res, bufs, lens = fuzz_batch_pallas_2phase(
         instrs, edge_table, seed_buf, seed_len, words, mem_size,
         max_steps, n_edges, stack_pow2=stack_pow2,
-        phase1_steps=phase1_steps)
+        phase1_steps=phase1_steps, dots=dots)
     statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG, res.status)
     new_paths, uc, uh, vb2, vc2, vh2 = _triage_counts(
         res.counts, statuses, u_slots, seg_id, vb, vc, vh, exact)
@@ -211,7 +211,9 @@ class JitHarnessInstrumentation(Instrumentation):
                 'engine must be "xla", "pallas" or "pallas_fused"')
         self.engine = self.options["engine"]
         self._fuse_warned = False
-        from ..ops.vm_kernel import auto_phase1_steps
+        from ..ops.vm_kernel import auto_phase1_steps, dot_modes
+        # exactness-guarded MXU dtypes, decided once per program
+        self._dots = dot_modes(prog.instrs, prog.n_edges)
         p1 = int(self.options["phase1_steps"])
         self.phase1_steps = auto_phase1_steps(self.program.max_steps) \
             if p1 < 0 else p1
@@ -282,7 +284,8 @@ class JitHarnessInstrumentation(Instrumentation):
             inputs, lengths, self.virgin_bits,
             self.virgin_crash, self.virgin_tmout, self.program.mem_size,
             self.program.max_steps, self.program.n_edges, self.exact,
-            "pallas" if self.engine == "pallas_fused" else self.engine)
+            "pallas" if self.engine == "pallas_fused" else self.engine,
+            self._dots)
         self.virgin_bits, self.virgin_crash, self.virgin_tmout = vb, vc, vh
         self.total_execs += int(inputs.shape[0])
         if self.options.get("edges"):
@@ -345,7 +348,7 @@ class JitHarnessInstrumentation(Instrumentation):
             self.virgin_bits, self.virgin_crash, self.virgin_tmout,
             self.program.mem_size, self.program.max_steps,
             self.program.n_edges, self.exact, stack_pow2,
-            self.phase1_steps)
+            self.phase1_steps, self._dots)
         self.virgin_bits, self.virgin_crash, self.virgin_tmout = vb, vc, vh
         # count REQUESTED lanes, not the LANE_TILE-rounded padding:
         # keeps total_execs (and state export/merge) identical across

@@ -273,7 +273,10 @@ class ExecTarget:
                 out.append(name.decode(errors="replace"))
                 flag = ct.string_at(
                     ptr + i * KB_MODTAB_NAME + KB_MODTAB_NAME - 1, 1)
-                if flag != b"\x00":
+                # bit 0 = partition aliases multiple modules; bit 1
+                # is kb_rt's "name truncated" bookkeeping, not by
+                # itself a degradation
+                if flag[0] & 1:
                     degraded.append(out[-1])
         if degraded and not getattr(self, "_modtab_warned", False):
             self._modtab_warned = True

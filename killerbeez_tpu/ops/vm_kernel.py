@@ -46,6 +46,33 @@ from ..models.vm import (
 
 LANE_TILE = 512  # lanes per grid instance (multiple of 128)
 
+# MXU dtype modes for the two one-hot "gathers".  The round-3 kernel
+# ran both as Precision.HIGHEST f32 dots -- a 6-pass decomposition on
+# the MXU that dominated the whole step (measured 1.9us -> 0.45us per
+# 512-lane tile-step when replaced).  Because a one-hot operand makes
+# every dot output a SINGLE product, the results are exact (no
+# accumulation rounding) whenever the data-side values are exactly
+# representable in bf16, i.e. |v| <= 256:
+#   * edge dot: edge ids <= n_edges, guarded n_edges < 255;
+#   * fetch dot: instruction words live in [-2^16, 2^16): split into
+#     hi/lo bytes (two independent bf16 dots, both limbs < 256 exact,
+#     f32 accumulators) and recombine (rhi << 8) + rlo.
+# dot_modes() picks the fast modes iff the guards hold; "f32" keeps
+# the round-3 behavior.  Parity is enforced bit-for-bit by the
+# engine-equivalence tests either way.
+DEFAULT_DOTS = ("f32", "f32")
+
+
+def dot_modes(instrs, n_edges):
+    """(fetch_mode, edge_mode) for a CONCRETE program -- callers that
+    jit their step compute this once at setup time and pass it as a
+    static argument."""
+    a = np.asarray(instrs)
+    fetch = "bf16x2" if (int(a.min()) >= -(1 << 16)
+                         and int(a.max()) < (1 << 16)) else "f32"
+    edge = "bf16" if int(n_edges) < 255 else "f32"
+    return (fetch, edge)
+
 
 def _pick_rows(table, idx, rows=None):
     """out[0, t] = table[idx[0, t], t] for table [R, T], idx [1, T]:
@@ -68,18 +95,29 @@ def _chain(pairs, default):
 
 
 def _vm_loop(instrs_t, table_t, bufs, lengths, z,
-             mem_size, max_steps, n_edges, status0=None):
+             mem_size, max_steps, n_edges, status0=None,
+             dots=DEFAULT_DOTS):
     """The VM step loop shared by the plain and fused kernels: takes
     lane-last [L, T] candidate bytes + [1, T] lengths, returns the
     final carry tuple.  ``z`` is a loaded [1, T] zeros row (see the
     carry-layout note in state0).  ``status0`` overrides the initial
     per-lane status (two-phase scheduling marks already-finished
     lanes FUZZ_NONE so their tiles exit the while-loop immediately);
-    it must be load-derived like everything else."""
+    it must be load-derived like everything else.  The program
+    tables arrive RAW int32; ``dots`` selects the MXU dtypes (see
+    the DEFAULT_DOTS note)."""
     t = bufs.shape[1]
     ni = instrs_t.shape[1]
     nb = table_t.shape[0]
     L = bufs.shape[0]
+    fetch_mode, edge_mode = dots
+    if fetch_mode == "bf16x2":
+        ins_lo = (instrs_t & 0xFF).astype(jnp.bfloat16)
+        ins_hi = (instrs_t >> 8).astype(jnp.bfloat16)
+    else:
+        ins_f = instrs_t.astype(jnp.float32)
+    table_f = table_t.astype(
+        jnp.bfloat16 if edge_mode == "bf16" else jnp.float32)
 
     # loop-invariant iotas, hoisted (the fetch one-hot alone is
     # [NI, T]); on-chip this measured neutral — Mosaic's LICM already
@@ -99,10 +137,18 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
 
         # ---- instruction fetch: transposed one-hot MXU matmul ----
         pcc = jnp.clip(pc, 0, ni - 1)
-        onehot_pc = (io_ni == pcc).astype(jnp.float32)       # [NI, T]
-        row = jax.lax.dot(instrs_t, onehot_pc,
-                          precision=jax.lax.Precision.HIGHEST)
-        row = row.astype(jnp.int32)                      # [4, T]
+        if fetch_mode == "bf16x2":
+            onehot_pc = (io_ni == pcc).astype(jnp.bfloat16)  # [NI, T]
+            rlo = jax.lax.dot(ins_lo, onehot_pc,
+                              preferred_element_type=jnp.float32)
+            rhi = jax.lax.dot(ins_hi, onehot_pc,
+                              preferred_element_type=jnp.float32)
+            row = (rhi.astype(jnp.int32) << 8) + rlo.astype(jnp.int32)
+        else:
+            onehot_pc = (io_ni == pcc).astype(jnp.float32)   # [NI, T]
+            row = jax.lax.dot(ins_f, onehot_pc,
+                              precision=jax.lax.Precision.HIGHEST)
+            row = row.astype(jnp.int32)                  # [4, T]
         op = row[0:1, :]
         a = row[1:2, :]
         b = row[2:3, :]
@@ -175,9 +221,14 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
         cur_loc = a & (MAP_SIZE - 1)
         new_prev = jnp.where(is_block, cur_loc >> 1, prev_loc)
         cur_idx = jnp.clip(b, 0, nb - 1)
-        onehot_prev = (io_nb1 == prev_idx).astype(jnp.float32)
-        rows_e = jax.lax.dot(table_t, onehot_prev,
-                             precision=jax.lax.Precision.HIGHEST)
+        if edge_mode == "bf16":
+            onehot_prev = (io_nb1 == prev_idx).astype(jnp.bfloat16)
+            rows_e = jax.lax.dot(table_f, onehot_prev,
+                                 preferred_element_type=jnp.float32)
+        else:
+            onehot_prev = (io_nb1 == prev_idx).astype(jnp.float32)
+            rows_e = jax.lax.dot(table_f, onehot_prev,
+                                 precision=jax.lax.Precision.HIGHEST)
         # rows_e[cidx, t] = edge index for (prev[t], cidx)   [nb, T]
         eidx = jnp.sum(jnp.where(io_nb == cur_idx, rows_e, 0),
                        axis=0, keepdims=True).astype(jnp.int32)
@@ -226,11 +277,11 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
 
 def _vm_kernel(instrs_t_ref, table_t_ref, bufs_ref, lens_ref, zero_ref,
                status_ref, exit_ref, counts_ref, steps_ref, hash_ref,
-               *, mem_size, max_steps, n_edges):
-    instrs_t = instrs_t_ref[...].astype(jnp.float32)     # [4, NI]
-    table_t = table_t_ref[...].astype(jnp.float32)       # [nb, nb+1]
-    final = _vm_loop(instrs_t, table_t, bufs_ref[...], lens_ref[...],
-                     zero_ref[...], mem_size, max_steps, n_edges)
+               *, mem_size, max_steps, n_edges, dots):
+    final = _vm_loop(instrs_t_ref[...], table_t_ref[...],
+                     bufs_ref[...], lens_ref[...],
+                     zero_ref[...], mem_size, max_steps, n_edges,
+                     dots=dots)
     status_ref[...] = final[4]
     exit_ref[...] = final[5]
     counts_ref[...] = final[7]
@@ -241,18 +292,17 @@ def _vm_kernel(instrs_t_ref, table_t_ref, bufs_ref, lens_ref, zero_ref,
 def _vm_kernel_skip(instrs_t_ref, table_t_ref, bufs_ref, lens_ref,
                     skip_ref, zero_ref,
                     status_ref, exit_ref, counts_ref, steps_ref,
-                    hash_ref, *, mem_size, max_steps, n_edges):
+                    hash_ref, *, mem_size, max_steps, n_edges, dots):
     """_vm_kernel with a per-lane skip mask: skipped lanes start
     FUZZ_NONE, so a tile of all-skipped lanes exits its while-loop
     after zero iterations — the phase-2 half of two-phase scheduling
     pays only for tiles that contain real survivors."""
-    instrs_t = instrs_t_ref[...].astype(jnp.float32)
-    table_t = table_t_ref[...].astype(jnp.float32)
     skip = skip_ref[...]                                 # [1, T] 0/1
     status0 = (1 - skip) * FUZZ_RUNNING + zero_ref[...]
-    final = _vm_loop(instrs_t, table_t, bufs_ref[...], lens_ref[...],
+    final = _vm_loop(instrs_t_ref[...], table_t_ref[...],
+                     bufs_ref[...], lens_ref[...],
                      zero_ref[...], mem_size, max_steps, n_edges,
-                     status0=status0)
+                     status0=status0, dots=dots)
     status_ref[...] = final[4]
     exit_ref[...] = final[5]
     counts_ref[...] = final[7]
@@ -261,10 +311,10 @@ def _vm_kernel_skip(instrs_t_ref, table_t_ref, bufs_ref, lens_ref,
 
 
 @partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
-                                   "interpret"))
+                                   "interpret", "dots"))
 def run_batch_pallas(instrs, edge_table, inputs, lengths, mem_size,
                      max_steps, n_edges, interpret=False,
-                     skip=None) -> VMResult:
+                     skip=None, dots=DEFAULT_DOTS) -> VMResult:
     """Pallas engine entry: same contract as vm._run_batch_impl with
     record_stream=False.  B must be a multiple of LANE_TILE (callers
     pad; padded lanes are regular executions of duplicated inputs).
@@ -298,10 +348,12 @@ def run_batch_pallas(instrs, edge_table, inputs, lengths, mem_size,
     operands = [instrs_t, table_t, bufs_t, lens]
     if skip is None:
         kernel = partial(_vm_kernel, mem_size=mem_size,
-                         max_steps=max_steps, n_edges=n_edges)
+                         max_steps=max_steps, n_edges=n_edges,
+                         dots=dots)
     else:
         kernel = partial(_vm_kernel_skip, mem_size=mem_size,
-                         max_steps=max_steps, n_edges=n_edges)
+                         max_steps=max_steps, n_edges=n_edges,
+                         dots=dots)
         in_specs.append(pl.BlockSpec((1, LANE_TILE), lane_block))
         operands.append(skip.astype(jnp.int32).reshape(1, b))
     in_specs.append(pl.BlockSpec((1, LANE_TILE), lane_block))
@@ -337,7 +389,8 @@ def _slice_vmresult(res: VMResult, b: int) -> VMResult:
 
 def run_batch_pallas_padded(instrs, edge_table, inputs, lengths,
                             mem_size, max_steps, n_edges,
-                            interpret=False, skip=None) -> VMResult:
+                            interpret=False, skip=None,
+                            dots=DEFAULT_DOTS) -> VMResult:
     """run_batch_pallas for ANY batch size: pads to a LANE_TILE
     multiple and slices results back.  Padded lanes are skip-masked
     when a skip vector is given, else duplicate lane 0 (coverage
@@ -355,7 +408,7 @@ def run_batch_pallas_padded(instrs, edge_table, inputs, lengths,
                 [skip, jnp.ones((pad,), skip.dtype)])
     res = run_batch_pallas(instrs, edge_table, inputs, lengths,
                            mem_size, max_steps, n_edges,
-                           interpret=interpret, skip=skip)
+                           interpret=interpret, skip=skip, dots=dots)
     return _slice_vmresult(res, b) if pad else res
 
 
@@ -495,9 +548,9 @@ def _fuzz_kernel(instrs_t_ref, table_t_ref, seed_ref, lens_ref,
                  words_ref, zero_ref,
                  status_ref, exit_ref, counts_ref, steps_ref, hash_ref,
                  bufs_out_ref, lens_out_ref,
-                 *, mem_size, max_steps, n_edges, stack_pow2):
-    instrs_t = instrs_t_ref[...].astype(jnp.float32)
-    table_t = table_t_ref[...].astype(jnp.float32)
+                 *, mem_size, max_steps, n_edges, stack_pow2, dots):
+    instrs_t = instrs_t_ref[...]
+    table_t = table_t_ref[...]
     z = zero_ref[...]
     buf = seed_ref[...] + z                     # [L, T] (load-derived)
     length = lens_ref[...] + z                  # [1, T]
@@ -513,7 +566,7 @@ def _fuzz_kernel(instrs_t_ref, table_t_ref, seed_ref, lens_ref,
         buf, length = _havoc_edit(buf, length, w, active, L)
 
     final = _vm_loop(instrs_t, table_t, buf, length, z,
-                     mem_size, max_steps, n_edges)
+                     mem_size, max_steps, n_edges, dots=dots)
     status_ref[...] = final[4]
     exit_ref[...] = final[5]
     counts_ref[...] = final[7]
@@ -549,10 +602,10 @@ def havoc_words(key, b, stack_pow2=4):
 
 
 @partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
-                                   "stack_pow2", "interpret"))
+                                   "stack_pow2", "interpret", "dots"))
 def fuzz_batch_pallas(instrs, edge_table, seed_buf, seed_len, words,
                       mem_size, max_steps, n_edges, stack_pow2=4,
-                      interpret=False):
+                      interpret=False, dots=DEFAULT_DOTS):
     """Fused fuzz step: havoc mutation AND VM execution in one
     pallas_call — candidates are born, run and triaged (counts) while
     resident in VMEM.  ``seed_buf`` uint8[L], ``words`` from
@@ -577,7 +630,7 @@ def fuzz_batch_pallas(instrs, edge_table, seed_buf, seed_len, words,
 
     kernel = partial(_fuzz_kernel, mem_size=mem_size,
                      max_steps=max_steps, n_edges=n_edges,
-                     stack_pow2=stack_pow2)
+                     stack_pow2=stack_pow2, dots=dots)
     out_shapes = (
         jax.ShapeDtypeStruct((1, b), jnp.int32),
         jax.ShapeDtypeStruct((1, b), jnp.int32),
@@ -652,7 +705,7 @@ def auto_phase1_steps(max_steps: int) -> int:
 def fuzz_batch_pallas_2phase(instrs, edge_table, seed_buf, seed_len,
                              words, mem_size, max_steps, n_edges,
                              stack_pow2=4, phase1_steps=0,
-                             interpret=False):
+                             interpret=False, dots=DEFAULT_DOTS):
     """fuzz_batch_pallas with two-phase tail scheduling.
     ``phase1_steps``: <0 = auto (auto_phase1_steps); 0 or >=
     max_steps disables phase 2."""
@@ -661,7 +714,8 @@ def fuzz_batch_pallas_2phase(instrs, edge_table, seed_buf, seed_len,
     res1, bufs, lens = fuzz_batch_pallas(
         instrs, edge_table, seed_buf, seed_len, words, mem_size,
         min(phase1_steps, max_steps) if phase1_steps else max_steps,
-        n_edges, stack_pow2=stack_pow2, interpret=interpret)
+        n_edges, stack_pow2=stack_pow2, interpret=interpret,
+        dots=dots)
     if not phase1_steps or phase1_steps >= max_steps:
         return res1, bufs, lens
 
@@ -673,7 +727,7 @@ def fuzz_batch_pallas_2phase(instrs, edge_table, seed_buf, seed_len,
         instrs, edge_table,
         jnp.take(bufs, order, axis=0), jnp.take(lens, order),
         mem_size, max_steps, n_edges, interpret=interpret,
-        skip=jnp.take((~surv).astype(jnp.int32), order))
+        skip=jnp.take((~surv).astype(jnp.int32), order), dots=dots)
 
     def mix(f1, f2_sorted):
         f2 = jnp.take(f2_sorted, inv, axis=0)

@@ -125,12 +125,18 @@ class ShardedCampaignDriver(Driver):
                 f"mesh batch is {b} (use -n as a multiple of -b)")
         mut = self.mutator
         its = mut.peek_iterations(n)
-        base_it = int(its[0]) // b  # step counter, resume-stable
+        # PRNG step: fold the RAW absolute mutator iteration into the
+        # keys, not a derived batch counter.  Iterations are consumed
+        # monotonically, so a state resumed under a DIFFERENT -b can
+        # never land on a (step, lane) pair an earlier run already
+        # used — any division-derived counter (floor or ceil) can
+        # collide when the batch size changes across a resume.
+        base_it = int(its[0])
         seed_buf = jnp.asarray(mut.seed_buf)
         (self.state, statuses, rets, uc, uh, exit_codes, bufs,
          lens, compact) = self._step(self.state, seed_buf,
                                      jnp.int32(mut.seed_len),
-                                     jnp.int32(base_it))
+                                     jnp.uint32(base_it))
         mut.advance(n)
         # expose the sharded maps through the instrumentation so
         # get_state()/merge()/coverage_bytes() see campaign coverage

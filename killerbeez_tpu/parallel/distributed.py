@@ -124,8 +124,10 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
     candidates[B, L], lengths[B], compact)`` where B =
     batch_per_device * n_dp, candidates dp-sharded, virgin maps
     mp-sharded, and ``compact`` = (idx, bufs, lens, counts) is the
-    per-shard interesting-lane report. ``base_it`` is the global
-    iteration counter the per-lane PRNG keys fold in.
+    per-shard interesting-lane report. ``base_it`` is the counter the
+    per-lane PRNG keys fold in; the CLI campaign passes the absolute
+    mutator iteration (monotonically consumed), so resumed runs can
+    never replay an earlier run's (counter, lane) key pair.
 
     ``engine``: "xla" (batched one-hot engine), "pallas" (VMEM VM
     kernel under shard_map), or "pallas_fused" (mutation fused into
@@ -150,6 +152,8 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
     slice_size = program.map_size // n_mp
     instrs = jnp.asarray(program.instrs)
     edge_table = jnp.asarray(program.edge_table)
+    from ..ops.vm_kernel import dot_modes
+    dots = dot_modes(program.instrs, program.n_edges)
     u_loc_np, eidx_np, outside_np = _shard_static_maps(program, n_mp)
     u_loc_all = jnp.asarray(u_loc_np)
     eidx_all = jnp.asarray(eidx_np)
@@ -162,7 +166,8 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         from ..ops.vm_kernel import run_batch_pallas_padded
         return run_batch_pallas_padded(
             instrs, edge_table, bufs, lens, program.mem_size,
-            program.max_steps, program.n_edges, interpret=interpret)
+            program.max_steps, program.n_edges, interpret=interpret,
+            dots=dots)
 
     def local_step(vb, vc, vh, seed_buf, seed_len, base_it):
         # ---- which shard am I ----
@@ -198,7 +203,7 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
             res, bufs, lens = fuzz_batch_pallas(
                 instrs, edge_table, sb, seed_len, words,
                 program.mem_size, program.max_steps, program.n_edges,
-                stack_pow2=stack_pow2, interpret=interpret)
+                stack_pow2=stack_pow2, interpret=interpret, dots=dots)
             if pad:
                 from ..ops.vm_kernel import _slice_vmresult
                 res = _slice_vmresult(res, batch_per_device)

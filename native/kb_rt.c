@@ -129,21 +129,29 @@ static void kb_register_module(void) {
     char *entry = tab + idx * KB_MODTAB_NAME;
     if (!entry[0]) {
       /* width-1: names keep a NUL at <= byte KB_MODTAB_NAME-2, so
-       * the flag byte never clobbers a maximal name's terminator */
+       * the flag byte never clobbers a maximal name's terminator.
+       * Bit 1 of the flag records "stored name is a truncation" at
+       * write time, so a LATER full-width matcher can tell it might
+       * be aliasing a different long basename (the order-independent
+       * half of the check below). */
       snprintf(entry, KB_MODTAB_NAME - 1, "%s", name);
+      if (strlen(name) > KB_MODTAB_NAME - 2)
+        entry[KB_MODTAB_NAME - 1] |= 2;
       break;
     }
     if (!strncmp(entry, name, KB_MODTAB_NAME - 2)) {
       /* a full-width match may be a truncated alias of a DIFFERENT
-       * long basename, not a re-registration of ours */
-      if (strlen(name) > KB_MODTAB_NAME - 2)
-        entry[KB_MODTAB_NAME - 1] = 1;
+       * long basename — either ours (longer than the field) or the
+       * stored one (truncated bit recorded at write time) */
+      if (strlen(name) > KB_MODTAB_NAME - 2 ||
+          (entry[KB_MODTAB_NAME - 1] & 2))
+        entry[KB_MODTAB_NAME - 1] |= 1;
       break;
     }
   }
   if (idx >= KB_N_MODULES) { /* table full: share the last partition */
     idx = KB_N_MODULES - 1;
-    tab[idx * KB_MODTAB_NAME + KB_MODTAB_NAME - 1] = 1;
+    tab[idx * KB_MODTAB_NAME + KB_MODTAB_NAME - 1] |= 1;
   }
   kb_mod_base = (uintptr_t)idx * KB_MOD_SIZE;
   kb_loc_mask = KB_MOD_SIZE - 1;

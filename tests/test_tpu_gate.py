@@ -47,7 +47,8 @@ import jax.numpy as jnp
 from killerbeez_tpu.models import targets, targets_cgc
 from killerbeez_tpu.models.vm import _run_batch_impl
 from killerbeez_tpu.ops.vm_kernel import (
-    LANE_TILE, fuzz_batch_pallas_2phase, havoc_words, run_batch_pallas,
+    LANE_TILE, dot_modes, fuzz_batch_pallas_2phase, havoc_words,
+    run_batch_pallas,
 )
 
 prog = targets.get_target("tlvstack_vm")
@@ -56,6 +57,9 @@ L = max(8, ((len(seed) + 7) // 8) * 8)
 sb = np.zeros(L, np.uint8); sb[:len(seed)] = np.frombuffer(seed, np.uint8)
 ins, tbl = jnp.asarray(prog.instrs), jnp.asarray(prog.edge_table)
 sbj, slj = jnp.asarray(sb), jnp.int32(len(seed))
+# the PRODUCT dtype config (exact-bf16 dots on guarded programs):
+# parity below gates it bit-for-bit against the f32 XLA engine
+dots = dot_modes(prog.instrs, prog.n_edges)
 FIELDS = ("status", "exit_code", "counts", "steps", "path_hash")
 
 # (a)+(b) fused kernel (two-phase, the product default) vs XLA engine
@@ -63,7 +67,7 @@ B = 4 * LANE_TILE
 words = havoc_words(jax.random.fold_in(jax.random.key(0), 42), B)
 res, bufs, lens = fuzz_batch_pallas_2phase(
     ins, tbl, sbj, slj, words, prog.mem_size, prog.max_steps,
-    prog.n_edges, phase1_steps=-1)
+    prog.n_edges, phase1_steps=-1, dots=dots)
 ref = _run_batch_impl(ins, tbl, bufs, lens, prog.mem_size,
                       prog.max_steps, prog.n_edges, False)
 for f in FIELDS:
@@ -75,7 +79,7 @@ for f in FIELDS:
 
 # (b) plain VM kernel parity on the same mutants
 out = run_batch_pallas(ins, tbl, bufs, lens, prog.mem_size,
-                       prog.max_steps, prog.n_edges)
+                       prog.max_steps, prog.n_edges, dots=dots)
 for f in FIELDS:
     a, b = np.asarray(getattr(ref, f)), np.asarray(getattr(out, f))
     if not np.array_equal(a, b):
@@ -90,13 +94,14 @@ ws = [havoc_words(jax.random.fold_in(jax.random.key(0), i), Bf)
 jax.block_until_ready(ws)
 r = fuzz_batch_pallas_2phase(ins, tbl, sbj, slj, ws[0], prog.mem_size,
                              prog.max_steps, prog.n_edges,
-                             phase1_steps=-1)
+                             phase1_steps=-1, dots=dots)
 jax.block_until_ready(r[0].status)
 t0 = time.time()
 for i in range(1, wsteps + 1):
     r = fuzz_batch_pallas_2phase(ins, tbl, sbj, slj, ws[i],
                                  prog.mem_size, prog.max_steps,
-                                 prog.n_edges, phase1_steps=-1)
+                                 prog.n_edges, phase1_steps=-1,
+                                 dots=dots)
 jax.block_until_ready(r[0].status)
 rate = Bf * wsteps / (time.time() - t0)
 print(json.dumps({"ok": True, "execs_per_sec": rate,

@@ -59,10 +59,17 @@ class Fuzzer:
         #: every `feedback` batches, rotate the mutator seed through
         #: new-path findings (coverage-guided corpus loop; 0 = off)
         self.feedback = int(feedback)
+        # corpus arms: [buf, selections, edge_novel_finds] — the
+        # rotation is a greedy optimistic bandit over these plus the
+        # base seed (see _rotate_seed)
         self._corpus: list = []
-        self._corpus_pos = 0
+        self._base_stats = [0, 0]       # [selections, finds]
+        self._active: Optional[int] = None  # corpus index or None=base
         self._base_seed = None
         self._rotations = 0
+        self._fb_batches = 0
+        import random as _random
+        self._fb_rng = _random.Random(0x6b62)  # deterministic splices
         self._dbg = None
         self.stats = FuzzStats()
         self._seen = {k: set() for k in ("crashes", "hangs", "new_paths")}
@@ -158,9 +165,19 @@ class Fuzzer:
             # bucket-only findings are overwhelmingly shallow
             # variants that dilute the rotation
             if recorded and self.feedback and new_path == 2:
-                self._corpus.append(buf)
+                self._corpus.append([buf, 0, 0])
                 if len(self._corpus) > self.CORPUS_CAP:
                     self._corpus.pop(0)
+                    # keep the active-arm credit pointer aligned
+                    if self._active is not None:
+                        self._active = (None if self._active == 0
+                                        else self._active - 1)
+                # credit the arm whose batches are being triaged:
+                # its lineage just found a brand-new edge
+                if self._active is None:
+                    self._base_stats[1] += 1
+                else:
+                    self._corpus[self._active][2] += 1
 
     # -- loops ----------------------------------------------------------
 
@@ -297,26 +314,81 @@ class Fuzzer:
                     fn()
         return packed
 
+    #: per-period decay of bandit stats: scores track the RECENT
+    #: discovery rate, so the base seed's productive warm-up can't
+    #: lock the greedy choice forever, and a stale arm's score
+    #: relaxes back toward the optimistic 1.0 (periodic re-probe)
+    FEEDBACK_DECAY = 0.8
+
+    def _credit_period(self) -> None:
+        """Close one feedback period: decay every arm's stats and
+        charge the period to the arm that was active during it."""
+        g = self.FEEDBACK_DECAY
+        self._base_stats[0] *= g
+        self._base_stats[1] *= g
+        for e in self._corpus:
+            e[1] *= g
+            e[2] *= g
+        if self._active is None:
+            self._base_stats[0] += 1
+        else:
+            self._corpus[self._active][1] += 1
+
     def _rotate_seed(self, mut) -> None:
         """Coverage-guided corpus feedback (beyond reference parity:
         the reference's equivalent is operators re-seeding campaigns
-        from new_paths/ by hand or via manager jobs).  Round-robins
-        the mutator seed through recorded new-path findings; seed
-        swaps keep the candidate buffer width so compiled steps never
-        retrace (mutator.set_input(keep_length=True)); findings too
-        long for the buffer are dropped from rotation."""
+        from new_paths/ by hand or via manager jobs).
+
+        Seed selection is a greedy optimistic bandit over the base
+        seed plus every edge-novel finding: each arm scores
+        (finds + 1) / (selections + 1), where ``finds`` counts the
+        brand-new edges discovered while that arm's batches were
+        being triaged.  Unexplored arms score 1.0, so every new
+        frontier gets probed once; ties break toward the NEWEST
+        discovery; a productive base seed keeps most of the budget
+        instead of being diluted round-robin (round-3's rotation
+        measurably lost to single-seed havoc for exactly that
+        reason).  When at least two findings exist, half the
+        corpus-arm turns fuzz an AFL-style SPLICE of the arm with a
+        random partner — mutants then draw material from two
+        lineages, which plain single-seed havoc cannot do.
+
+        Seed swaps keep the candidate buffer width so compiled steps
+        never retrace (mutator.set_input(keep_length=True)); findings
+        too long for the buffer are dropped from rotation."""
         self._rotations += 1
-        if self._rotations % 2 == 0 and self._base_seed is not None:
-            cands = [self._base_seed]     # anchor turn
-        else:
-            cands = None
-        while cands or self._corpus:
-            if cands:
-                cand = cands.pop()
+        while True:
+            best, best_score = None, 0.0
+            if self._base_seed is not None:
+                best_score = ((self._base_stats[1] + 1.0)
+                              / (self._base_stats[0] + 1.0))
+            for i, (buf, sel, finds) in enumerate(self._corpus):
+                score = (finds + 1.0) / (sel + 1.0)
+                if score >= best_score:   # >= : newest wins ties
+                    best, best_score = i, score
+            if best is None:
+                if self._base_seed is None:
+                    return
+                cand = self._base_seed
             else:
-                cand = self._corpus[self._corpus_pos
-                                    % len(self._corpus)]
-                self._corpus_pos += 1
+                arm = self._corpus[best]
+                cand = arm[0]
+                if len(self._corpus) >= 2 and self._fb_rng.random() < 0.5:
+                    partner = self._fb_rng.choice(
+                        [e[0] for j, e in enumerate(self._corpus)
+                         if j != best])
+                    # AFL-style splice (afl locate_diffs semantics):
+                    # cross over INSIDE the differing region so the
+                    # common prefix — magic bytes, headers — survives
+                    n = min(len(cand), len(partner))
+                    fd = next((i for i in range(n)
+                               if cand[i] != partner[i]), None)
+                    if fd is not None:
+                        ld = next(i for i in range(n - 1, -1, -1)
+                                  if cand[i] != partner[i])
+                        if ld > fd + 1:
+                            k = self._fb_rng.randrange(fd + 1, ld)
+                            cand = cand[:k] + partner[k:]
             try:
                 it = mut.get_current_iteration()
                 mut.set_input(cand, keep_length=True)
@@ -325,12 +397,19 @@ class Fuzzer:
                 # keys, not replay the (seed, iteration) pairs it
                 # already executed
                 mut.iteration = it
-                DEBUG_MSG("feedback: rotated seed to a %d-byte "
-                          "input", len(cand))
+                self._active = best
+                DEBUG_MSG("feedback: arm %s (score %.2f), %d-byte "
+                          "input", best, best_score, len(cand))
                 return
             except ValueError:       # finding wider than the buffer
-                if cand in self._corpus:
-                    self._corpus.remove(cand)  # anchor isn't in it
+                if best is None:
+                    return            # base seed itself doesn't fit
+                self._corpus.pop(best)
+                if self._active is not None:
+                    if self._active == best:
+                        self._active = None
+                    elif self._active > best:
+                        self._active -= 1
 
     def _run_batched(self, n_iterations: int) -> None:
         from collections import deque
@@ -345,7 +424,6 @@ class Fuzzer:
         # corpus is always stale/empty at rotation time
         depth = min(self.PIPELINE_DEPTH, self.feedback) \
             if self.feedback else self.PIPELINE_DEPTH
-        batches = 0
         if self.feedback and self._base_seed is None and \
                 getattr(mut, "seed_bytes", None):
             # the baseline seed anchors the rotation: every other
@@ -364,10 +442,15 @@ class Fuzzer:
                         "executes whole %d-lane batches (-n should "
                         "be a multiple of -b)", room, quantum)
                     break
-                if (self.feedback and self._corpus
-                        and batches and batches % self.feedback == 0):
-                    self._rotate_seed(mut)
-                batches += 1
+                # cadence counter lives on self: a caller sampling
+                # coverage with repeated short run() calls must not
+                # reset the rotation clock
+                if (self.feedback and self._fb_batches
+                        and self._fb_batches % self.feedback == 0):
+                    self._credit_period()
+                    if self._corpus:
+                        self._rotate_seed(mut)
+                self._fb_batches += 1
                 # a smaller tail batch would change tensor shapes and
                 # force a full XLA recompile; the driver pads to
                 # batch_size with duplicate lanes (coverage no-ops)
@@ -401,9 +484,11 @@ class Fuzzer:
                 getattr(mut, "seed_bytes", None):
             self._base_seed = mut.seed_bytes
         while self._remaining(n_iterations) > 0:
-            if (rotate_every and self._corpus and self.stats.iterations
+            if (rotate_every and self.stats.iterations
                     and self.stats.iterations % rotate_every == 0):
-                self._rotate_seed(mut)
+                self._credit_period()
+                if self._corpus:
+                    self._rotate_seed(mut)
             result = self.driver.test_next_input()
             if result is None:  # mutator exhausted (reference -2)
                 INFO_MSG("mutator exhausted after %d iterations",

@@ -5,6 +5,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# ~2-4 min of CPU-mesh/interpret-mode work: nightly lane only
+pytestmark = pytest.mark.slow
+
 from killerbeez_tpu import FUZZ_CRASH, MAP_SIZE
 from killerbeez_tpu.models import targets
 from killerbeez_tpu.parallel import (

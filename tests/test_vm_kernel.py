@@ -8,6 +8,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# ~2-4 min of CPU-mesh/interpret-mode work: nightly lane only
+pytestmark = pytest.mark.slow
+
 from killerbeez_tpu.models import targets, targets_cgc
 from killerbeez_tpu.models.vm import _run_batch_impl
 from killerbeez_tpu.ops.vm_kernel import LANE_TILE, run_batch_pallas

@@ -130,13 +130,16 @@ class ShardedCampaignDriver(Driver):
         # monotonically, so a state resumed under a DIFFERENT -b can
         # never land on a (step, lane) pair an earlier run already
         # used — any division-derived counter (floor or ceil) can
-        # collide when the batch size changes across a resume.
+        # collide when the batch size changes across a resume.  Passed
+        # as the raw Python int: the step folds all 64 bits (two
+        # uint32 halves), so campaigns past 2^32 execs neither crash
+        # (NumPy 2.x uint32 conversion) nor replay old key pairs.
         base_it = int(its[0])
         seed_buf = jnp.asarray(mut.seed_buf)
         (self.state, statuses, rets, uc, uh, exit_codes, bufs,
          lens, compact) = self._step(self.state, seed_buf,
                                      jnp.int32(mut.seed_len),
-                                     jnp.uint32(base_it))
+                                     base_it)
         mut.advance(n)
         # expose the sharded maps through the instrumentation so
         # get_state()/merge()/coverage_bytes() see campaign coverage

@@ -126,8 +126,10 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
     mp-sharded, and ``compact`` = (idx, bufs, lens, counts) is the
     per-shard interesting-lane report. ``base_it`` is the counter the
     per-lane PRNG keys fold in; the CLI campaign passes the absolute
-    mutator iteration (monotonically consumed), so resumed runs can
-    never replay an earlier run's (counter, lane) key pair.
+    mutator iteration (monotonically consumed) as a Python int, so
+    resumed runs can never replay an earlier run's (counter, lane)
+    key pair.  All 64 bits are folded (as two uint32 halves), so the
+    guarantee survives past 2^32 total execs.
 
     ``engine``: "xla" (batched one-hot engine), "pallas" (VMEM VM
     kernel under shard_map), or "pallas_fused" (mutation fused into
@@ -181,10 +183,14 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         lane = (dp_i.astype(jnp.uint32) * batch_per_device
                 + jnp.arange(batch_per_device, dtype=jnp.uint32))
         base = jax.random.key(seed)
-        keys = jax.vmap(
-            lambda l: jax.random.fold_in(
-                jax.random.fold_in(base, base_it.astype(jnp.uint32)), l)
-        )(lane)
+        # base_it is the absolute mutator iteration split into two
+        # uint32 halves [lo, hi]; folding BOTH halves keeps (counter,
+        # lane) key pairs unique past 2^32 total execs (under an hour
+        # at benched multi-chip rates — a single-fold uint32 counter
+        # would wrap and replay earlier mutants).
+        folded = jax.random.fold_in(
+            jax.random.fold_in(base, base_it[0]), base_it[1])
+        keys = jax.vmap(lambda l: jax.random.fold_in(folded, l))(lane)
         if engine == "pallas_fused":
             # mutation AND execution in one kernel per dp shard
             from ..ops.vm_kernel import (
@@ -317,7 +323,7 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
     )
 
     @jax.jit
-    def step(state: ShardedFuzzState, seed_buf, seed_len, base_it):
+    def _step_jit(state: ShardedFuzzState, seed_buf, seed_len, base_it):
         if state.virgin_bits.shape[-1] != program.map_size:
             raise ValueError(
                 f"state map is {state.virgin_bits.shape[-1]} bytes but "
@@ -337,5 +343,26 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         new_state = ShardedFuzzState(vb, vc, vh, state.step + 1)
         return (new_state, statuses, rets, uc, uh, exit_codes, bufs,
                 lens, (sel_idx, sel_bufs, sel_lens, counts))
+
+    def step(state: ShardedFuzzState, seed_buf, seed_len, base_it):
+        """Public step: splits ``base_it`` into uint32 halves host-side
+        (a Python int keeps all 64 bits; a device scalar from an older
+        caller becomes [it, 0]) so the jitted body never converts a
+        >=2^32 Python int to uint32 — NumPy 2.x raises OverflowError
+        there, and older NumPy wraps silently, replaying earlier
+        (counter, lane) PRNG pairs."""
+        if isinstance(base_it, (int, np.integer)):
+            it = int(base_it)
+            halves = jnp.asarray(
+                [it & 0xFFFFFFFF, (it >> 32) & 0xFFFFFFFF],
+                dtype=jnp.uint32)
+        else:
+            arr = jnp.asarray(base_it)
+            if arr.ndim == 0:
+                halves = jnp.stack([arr.astype(jnp.uint32),
+                                    jnp.zeros((), jnp.uint32)])
+            else:
+                halves = arr.astype(jnp.uint32)
+        return _step_jit(state, seed_buf, seed_len, halves)
 
     return step

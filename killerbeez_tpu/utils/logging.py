@@ -29,7 +29,13 @@ _LEVEL_NAMES = ["DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL", "FATAL"]
 
 class _LogState:
     level: int = LEVEL_INFO
-    stream: TextIO = sys.stderr
+    # None = resolve sys.stderr at write time.  Binding the stream at import
+    # time makes every later write target whatever stderr was when this
+    # module was first imported — under pytest that can be a capture stream
+    # that is closed long before the logging call, turning unrelated tests
+    # into "I/O operation on closed file" failures depending on collection
+    # order.  Only an explicitly configured {"file": ...} stream is pinned.
+    stream: Optional[TextIO] = None
     filename: Optional[str] = None
     _fh: Optional[TextIO] = None
 
@@ -74,7 +80,17 @@ def _log(level: int, fmt: str, *args) -> None:
         return
     msg = (fmt % args) if args else fmt
     stamp = time.strftime("%Y-%m-%d %H:%M:%S")
-    _state.stream.write(f"{stamp} - {_LEVEL_NAMES[level]} - {msg}\n")
+    stream = _state.stream if _state.stream is not None else sys.stderr
+    try:
+        stream.write(f"{stamp} - {_LEVEL_NAMES[level]} - {msg}\n")
+    except ValueError:
+        # A pinned stream (log file or an inherited redirect) was closed
+        # out from under us; fall back to the live stderr rather than
+        # turning a log line into a crash.
+        if stream is not sys.stderr:
+            _state.stream = None
+            _state._fh = None
+            sys.stderr.write(f"{stamp} - {_LEVEL_NAMES[level]} - {msg}\n")
 
 
 def DEBUG_MSG(fmt: str, *args) -> None:

@@ -252,9 +252,10 @@ typedef struct {
   uintptr_t addr;
   long orig;
 } kb_bp;
-#define KB_MAX_BP 64
+#define KB_MAX_BP 256
 static kb_bp kb_bps[KB_MAX_BP];
 static int kb_nbps;
+static unsigned kb_dbg_bp_dropped; /* plants skipped: table full */
 
 static int kb_bp_find(uintptr_t addr) {
   for (int i = 0; i < kb_nbps; i++)
@@ -263,9 +264,14 @@ static int kb_bp_find(uintptr_t addr) {
 }
 
 static void kb_bp_plant(pid_t pid, uintptr_t addr) {
-  if (!kb_in_image(addr) || kb_nbps >= KB_MAX_BP ||
-      kb_bp_find(addr) >= 0)
+  if (!kb_in_image(addr) || kb_bp_find(addr) >= 0) return;
+  if (kb_nbps >= KB_MAX_BP) {
+    /* control returning from a later excursion will not be re-trapped
+     * — count it so truncated coverage is observable (KB_TRACE_DEBUG)
+     * instead of silent */
+    kb_dbg_bp_dropped++;
     return;
+  }
   errno = 0;
   long orig = ptrace(PTRACE_PEEKTEXT, pid, (void *)addr, NULL);
   if (orig == -1 && errno) return;
@@ -454,7 +460,18 @@ static void kb_template_setup(char **argv) {
   int died = kb_run_to(pid, kb_main_addr, &status);
   alarm(0);
   kb_guard_pid = 0;
-  if (died) return; /* died (or was reaped by the guard) pre-main */
+  if (died) {
+    /* the child ran to completion without ever hitting the learned
+     * main() — the first-excursion rdi heuristic picked a
+     * never-executed address (non-glibc startup, unusual _start).
+     * Tracing every exec from there would silently produce EMPTY
+     * maps; fall back to entry tracing instead, loudly. */
+    fprintf(stderr,
+            "kb_trace: learned main 0x%lx never reached; falling back "
+            "to entry tracing\n", (unsigned long)kb_main_addr);
+    kb_main_addr = 0;
+    return;
+  }
   if (kb_read_pc(pid) != kb_main_addr ||
       ptrace(PTRACE_GETREGS, pid, NULL, &kb_tmpl_regs) != 0) {
     kill(pid, SIGKILL);
@@ -803,9 +820,9 @@ int main(int argc, char **argv) {
         if (getenv("KB_TRACE_DEBUG"))
           fprintf(stderr,
                   "kb_trace: %u stops, %u excursions, %u tforks, "
-                  "%u spawns\n",
+                  "%u spawns, %u bp-drops\n",
                   kb_dbg_stops, kb_dbg_excursions, kb_dbg_tforks,
-                  kb_dbg_spawns);
+                  kb_dbg_spawns, kb_dbg_bp_dropped);
         _exit(0);
 
       case KB_CMD_FORK:
@@ -831,10 +848,34 @@ int main(int argc, char **argv) {
         break;
 
       case KB_CMD_GET_STATUS: {
+        static int kb_first_recorded = 1;
         int32_t st32 = -1;
         if (child > 0) {
           st32 = (int32_t)kb_trace_child(child, argv[1]);
           child = -1;
+          if (kb_first_recorded) {
+            kb_first_recorded = 0;
+            int validated = 0;
+#if defined(__x86_64__)
+            validated = kb_template > 0; /* setup reached main alive */
+#endif
+            if (kb_main_addr && !kb_opt_off && !validated) {
+              /* template setup validates main; without a template
+               * (KB_TRACE_NOFORK, or setup failure) nothing did:
+               * verify the first traced-from-main exec actually
+               * produced coverage, else reset to entry tracing. */
+              unsigned tch = 0;
+              for (unsigned i = 0; i < KB_MAP_SIZE && !tch; i++)
+                tch = kb_map[i] != 0;
+              if (!tch) {
+                fprintf(stderr,
+                        "kb_trace: empty map tracing from main 0x%lx; "
+                        "falling back to entry tracing\n",
+                        (unsigned long)kb_main_addr);
+                kb_main_addr = 0;
+              }
+            }
+          }
           if (kb_log) {
             fprintf(kb_log, "---\n");
             fflush(kb_log);

@@ -1,0 +1,38 @@
+"""Fast-lane bf16 dot-mode parity (ops/vm_kernel.py dot_modes).
+
+The exact-bf16 one-hot MXU dots ("bf16x2"/"bf16") must be
+bit-identical to the f32 HIGHEST path.  The heavyweight
+engine-equivalence sweeps live in test_vm_kernel.py (nightly lane);
+this file keeps ONE interpret-mode parity check in the per-push lane
+so a dot-mode regression can't slip through between nightlies.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from killerbeez_tpu.models import targets
+from killerbeez_tpu.ops.vm_kernel import (
+    LANE_TILE, dot_modes, run_batch_pallas,
+)
+
+
+@pytest.mark.parametrize("name", ["test", "tlvstack_vm"])
+def test_fast_dots_match_f32(name, rng):
+    prog = targets.get_target(name)
+    fast = dot_modes(prog.instrs, prog.n_edges)
+    assert fast != ("f32", "f32"), (
+        f"{name} no longer qualifies for the fast dot modes; pick a "
+        "fixture that does so the bf16 path stays covered per-push")
+    B, L = LANE_TILE, 24
+    inputs = rng.integers(0, 256, (B, L)).astype(np.uint8)
+    lengths = rng.integers(1, L + 1, B).astype(np.int32)
+    args = (jnp.asarray(prog.instrs), jnp.asarray(prog.edge_table),
+            jnp.asarray(inputs), jnp.asarray(lengths),
+            prog.mem_size, prog.max_steps, prog.n_edges)
+    ref = run_batch_pallas(*args, interpret=True, dots=("f32", "f32"))
+    out = run_batch_pallas(*args, interpret=True, dots=fast)
+    for f in ("status", "exit_code", "counts", "steps", "path_hash"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, f)), np.asarray(getattr(out, f)),
+            err_msg=f"{name} dots={fast}: {f} diverged from f32")

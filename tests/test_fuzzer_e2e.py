@@ -374,6 +374,7 @@ def _interpret_pallas(monkeypatch):
     return (jh._fused_step, jh._fused_fuzz_step)
 
 
+@pytest.mark.slow  # ~40s interpret-mode sweep: nightly lane
 def test_fused_cli_path_matches_unfused(tmp_path, monkeypatch):
     """The product path for the flagship number: engine
     "pallas_fused" + havoc drives mutation AND execution in one

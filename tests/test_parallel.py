@@ -120,10 +120,11 @@ def test_sharded_triage_matches_single_chip_reference():
     vb = vc = vh = jnp.full((prog.map_size,), 0xFF, jnp.uint8)
     base = jax.random.key(0)
     for it in range(n_steps):
-        keys = jax.vmap(
-            lambda l: jax.random.fold_in(
-                jax.random.fold_in(base, jnp.uint32(it)), l)
-        )(jnp.arange(B, dtype=jnp.uint32))
+        # the sharded step folds the 64-bit counter as [lo, hi] halves
+        folded = jax.random.fold_in(
+            jax.random.fold_in(base, jnp.uint32(it)), jnp.uint32(0))
+        keys = jax.vmap(lambda l: jax.random.fold_in(folded, l))(
+            jnp.arange(B, dtype=jnp.uint32))
         bufs, lens = jax.vmap(
             lambda k: havoc_at(sb, sl, k, stack_pow2=4))(keys)
         res = _run_batch_impl(ins, tbl, bufs, lens, prog.mem_size,
@@ -327,3 +328,26 @@ def test_sharded_fused_engine_matches_xla():
     for i in range(5):
         np.testing.assert_array_equal(outs["xla"][i],
                                       outs["pallas_fused"][i])
+
+
+def test_counter_folds_all_64_bits():
+    """base_it past 2^32 must neither crash (NumPy 2.x uint32
+    OverflowError) nor replay an earlier counter's candidate stream:
+    2^32 + 7 and 7 share a lo half but differ in hi, so their mutant
+    batches must diverge; equal Python-int and device-scalar forms of
+    the same sub-2^32 counter must agree."""
+    prog = targets.get_target("cgc_like")
+    mesh = make_mesh(4, 2)
+    step = make_sharded_fuzz_step(prog, mesh, batch_per_device=8,
+                                  max_len=16)
+    sb, sl = seed_arrays()
+    s0 = sharded_state_init(mesh, prog.map_size)
+
+    def bufs_for(it):
+        _, *rest = step(s0, sb, sl, it)
+        return np.asarray(rest[5])  # candidate buffers [B, L]
+
+    low = bufs_for(7)
+    np.testing.assert_array_equal(low, bufs_for(jnp.int32(7)))
+    high = bufs_for((1 << 32) + 7)   # would OverflowError pre-fix
+    assert (low != high).any(), "hi half of the counter was ignored"

@@ -130,6 +130,10 @@ def _run_on_chip():
                          f"{r.stderr[-400:]}"}, r
 
 
+@pytest.mark.slow  # ~90s real-chip subprocess (tunnel): run via the
+# nightly lane or explicitly (`pytest tests/test_tpu_gate.py`) as the
+# documented pre-commit ritual for kernel changes — keeping it out of
+# the per-push lane keeps that lane < 5 min on a 1-core host.
 def test_pallas_kernels_on_real_tpu():
     report, proc = _run_on_chip()
     if "skip" in report:

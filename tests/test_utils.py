@@ -99,11 +99,12 @@ def test_logging_config_and_fatal(tmp_path, capsys):
     assert "shown msg" in text and "WARNING" in text
     assert "boom" in text and "FATAL" in text
     assert "level" in logging_help()
-    # reset for other tests
+    # reset for other tests: stream=None resolves sys.stderr at write time
     setup_logging('{"level": 1}')
     from killerbeez_tpu.utils.logging import _state
-    import sys
-    _state.stream = sys.stderr
+    _state.stream = None
+    _state._fh = None
+    _state.filename = None
 
 
 def test_logging_bad_level():

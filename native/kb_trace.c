@@ -44,6 +44,12 @@
  *     identities differ from compiled-in edge logging; within-tier
  *     novelty is consistent, cross-tier maps are not comparable.
  *
+ * Default engine (forkserver mode, x86_64): UnTracer-style
+ * coverage-only breakpoints — steady-state execs run at native
+ * PTRACE_CONT speed and only novelty pays for tracing; see the
+ * "UnTracer mode" comment block below.  KB_TRACE_FULL=1 forces the
+ * block engine for every exec.
+ *
  * Fallback engine: per-instruction PTRACE_SINGLESTEP over everything
  * (the round-3 engine) on non-x86 hosts, when the kernel rejects
  * PTRACE_SINGLEBLOCK, or when KB_TRACE_STEP=1 is set.
@@ -58,6 +64,7 @@
 #define _GNU_SOURCE
 #include <elf.h>
 #include <errno.h>
+#include <fcntl.h>
 #include <limits.h>
 #include <signal.h>
 #include <stdint.h>
@@ -553,6 +560,331 @@ dead:
 
 static unsigned kb_dbg_stops, kb_dbg_excursions;
 static unsigned kb_dbg_tforks, kb_dbg_spawns;
+static unsigned kb_dbg_head_hits, kb_dbg_reruns, kb_dbg_fast_execs;
+
+#if defined(__x86_64__)
+/* ---- UnTracer mode: coverage-only breakpoints ----------------------
+ *
+ * Block-stepping every exec pays one ptrace stop per basic block
+ * (~38us each on this class of host) even when the exec discovers
+ * nothing — and steady-state fuzzing discovers nothing almost
+ * always.  UnTracer-style coverage-guided tracing inverts the cost:
+ *
+ *   - setup: one `objdump -d` pass over the target finds basic-block
+ *     leaders (branch targets, post-terminator fallthroughs,
+ *     function entries); an int3 is planted at every NOT-YET-SEEN
+ *     leader in the fork TEMPLATE's text, which acts as the
+ *     persistent "oracle" — children minted from it inherit the
+ *     armed text by CoW;
+ *   - steady state: the child runs under plain PTRACE_CONT at native
+ *     speed; no armed leader executes, no stop happens, the map
+ *     stays empty, and the fuzzer's has_new_bits correctly reports
+ *     "nothing new" — total cost is the fork+cont+reap floor;
+ *   - novelty: an armed int3 fires -> that block is new GLOBALLY;
+ *     record it, restore the original byte in the child (to resume)
+ *     AND the template (so no future exec traps there), and when
+ *     the exec finishes RE-RUN the same input once under the full
+ *     block-step tracer to rebuild a complete, hit-counted map with
+ *     the same slot identities every other map in the campaign uses.
+ *     Crashing execs re-run too, so crash triage always sees full
+ *     maps.  Execs the fuzzer killed (hang timeout, SIGKILL) skip
+ *     the re-run — re-tracing a hang would hang the tracer.
+ *
+ * Trade-off (documented in docs/HOST_TIER.md): novelty is
+ * block-granular.  A new EDGE between two already-seen blocks, or a
+ * hit-count bucket change, fires no breakpoint and is not reported.
+ * This matches UnTracer's published design point; the reference's
+ * QEMU tier pays per-TB hooks on every exec instead.
+ *
+ * The reference analogue is the QEMU tier's cost model
+ * (afl_progs/qemu_mode/afl-qemu-cpu-inl.h: per-translated-block
+ * hook + fork at first block); this replaces the per-block tax with
+ * a pay-only-for-novelty scheme on raw ptrace.
+ *
+ * Indirect-only block entries (jump tables, virtual calls into
+ * blocks objdump can't prove are leaders) are invisible until some
+ * direct path reaches them — the same blind spot static-rewriting
+ * UnTracer has.  KB_TRACE_FULL=1 opts back into full block-stepping
+ * per exec. ---- */
+
+static int kb_stopped_on_int3(pid_t pid); /* defined with the block engine */
+
+typedef struct {
+  uintptr_t addr;          /* runtime address (bias applied) */
+  unsigned char orig;      /* original first byte */
+  unsigned char armed;
+} kb_head;
+static kb_head *kb_heads;
+static int kb_nheads;
+static int kb_untracer;    /* engine active for template children */
+
+static int kb_head_cmp(const void *a, const void *b) {
+  uintptr_t x = ((const kb_head *)a)->addr;
+  uintptr_t y = ((const kb_head *)b)->addr;
+  return x < y ? -1 : x > y ? 1 : 0;
+}
+
+static int kb_head_find(uintptr_t addr) {
+  int lo = 0, hi = kb_nheads - 1;
+  while (lo <= hi) {
+    int mid = (lo + hi) / 2;
+    if (kb_heads[mid].addr == addr) return mid;
+    if (kb_heads[mid].addr < addr) lo = mid + 1;
+    else hi = mid - 1;
+  }
+  return -1;
+}
+
+/* Parse `objdump -d` output into file-relative basic-block leaders.
+ * Leaders: numeric jmp/jcc/call targets, the instruction after any
+ * terminator (jmp/jcc/ret/ud2/hlt — jcc fallthroughs are the
+ * frontier that matters), and function-symbol entries.  Returns the
+ * count, leaders in kb_heads[].addr (unbiased). */
+static int kb_load_heads(const char *target) {
+  static char real[PATH_MAX], line[512];
+  if (!realpath(target, real)) return 0;
+  /* argv exec, not popen: a shell would re-interpret quote characters
+   * in the target path */
+  int pfd[2];
+  if (pipe(pfd)) return 0;
+  pid_t dp = fork();
+  if (dp < 0) {
+    close(pfd[0]);
+    close(pfd[1]);
+    return 0;
+  }
+  if (dp == 0) {
+    dup2(pfd[1], 1);
+    close(pfd[0]);
+    close(pfd[1]);
+    int devnull = open("/dev/null", O_RDWR);
+    if (devnull >= 0) dup2(devnull, 2);
+    execlp("objdump", "objdump", "-d", "--no-show-raw-insn", real,
+           (char *)NULL);
+    _exit(127);
+  }
+  close(pfd[1]);
+  FILE *f = fdopen(pfd[0], "r");
+  if (!f) {
+    close(pfd[0]);
+    waitpid(dp, NULL, 0);
+    return 0;
+  }
+  int cap = 1024;
+  kb_heads = malloc(cap * sizeof *kb_heads);
+  if (!kb_heads) {
+    fclose(f);
+    waitpid(dp, NULL, 0);
+    return 0;
+  }
+  int pending = 0; /* previous insn ended a block */
+#define KB_HEAD_ADD(a)                                         \
+  do {                                                         \
+    if (kb_nheads == cap) {                                    \
+      int ncap = cap * 2;                                      \
+      void *p = realloc(kb_heads, ncap * sizeof *kb_heads);    \
+      if (!p) break;                                           \
+      kb_heads = p;                                            \
+      cap = ncap;                                              \
+    }                                                          \
+    kb_heads[kb_nheads].addr = (a);                            \
+    kb_heads[kb_nheads].armed = 0;                             \
+    kb_nheads++;                                               \
+  } while (0)
+  while (fgets(line, sizeof line, f)) {
+    unsigned long addr;
+    int off = 0;
+    /* function symbol line: "0000000000001030 <name>:" */
+    if (line[0] != ' ' && line[0] != '\t') {
+      if (sscanf(line, "%lx <%*[^>]>:", &addr) == 1) {
+        KB_HEAD_ADD((uintptr_t)addr);
+        pending = 0;
+      }
+      continue;
+    }
+    /* instruction line: "  1012:\tmnemonic operand..." */
+    if (sscanf(line, " %lx: %n", &addr, &off) != 1 || !off) continue;
+    if (pending) {
+      KB_HEAD_ADD((uintptr_t)addr);
+      pending = 0;
+    }
+    char m[16] = {0};
+    const char *p = line + off;
+    while (*p == ' ' || *p == '\t') p++;
+    /* skip prefixes objdump prints as separate tokens */
+    while (!strncmp(p, "bnd ", 4) || !strncmp(p, "notrack ", 8) ||
+           !strncmp(p, "lock ", 5) || !strncmp(p, "rep ", 4) ||
+           !strncmp(p, "repz ", 5) || !strncmp(p, "repnz ", 6))
+      p = strchr(p, ' ') + 1;
+    int mi = 0;
+    while (*p && *p != ' ' && *p != '\t' && *p != '\n' &&
+           mi < (int)sizeof m - 1)
+      m[mi++] = *p++;
+    while (*p == ' ' || *p == '\t') p++;
+    int is_jmp = !strcmp(m, "jmp") || !strcmp(m, "jmpq");
+    int is_jcc = m[0] == 'j' && !is_jmp; /* jne/ja/.../jecxz/jrcxz */
+    int is_loop = !strncmp(m, "loop", 4);
+    int is_call = !strcmp(m, "call") || !strcmp(m, "callq");
+    if (is_jmp || is_jcc || is_loop || is_call) {
+      /* numeric direct target ("1150 <sym+0x10>"); '*' = indirect */
+      if (*p != '*') {
+        char *end;
+        unsigned long tgt = strtoul(p, &end, 16);
+        if (end != p) KB_HEAD_ADD((uintptr_t)tgt);
+      }
+      if (is_jmp || is_jcc || is_loop) pending = 1;
+    } else if (!strcmp(m, "ret") || !strcmp(m, "retq") ||
+               !strcmp(m, "ud2") || !strcmp(m, "hlt")) {
+      pending = 1;
+    }
+  }
+#undef KB_HEAD_ADD
+  fclose(f);
+  waitpid(dp, NULL, 0);
+  return kb_nheads;
+}
+
+/* Patch ONE byte at addr in pid's text, preserving neighbours (two
+ * leaders can share a word; word-granular restore would clobber the
+ * neighbour's int3). */
+static int kb_poke_byte(pid_t pid, uintptr_t addr, unsigned char b,
+                        unsigned char *orig_out) {
+  errno = 0;
+  unsigned long w =
+      (unsigned long)ptrace(PTRACE_PEEKTEXT, pid, (void *)addr, NULL);
+  if (errno) return -1;
+  if (orig_out) *orig_out = (unsigned char)(w & 0xFF);
+  unsigned long nw = (w & ~0xFFUL) | b;
+  return (int)ptrace(PTRACE_POKETEXT, pid, (void *)addr, (void *)nw);
+}
+
+/* Bias file-relative leaders to runtime addresses, drop the ones the
+ * engine must not trap (outside the image; main, whose byte the
+ * fork-template gadget rewrites), sort, dedupe, and arm every leader
+ * in the parked template.  Called once, after template setup. */
+static void kb_untracer_arm(const char *target) {
+  static char real[PATH_MAX];
+  if (kb_template <= 0 || !kb_nheads) return;
+  uintptr_t bias = 0;
+  if (realpath(target, real)) {
+    FILE *e = fopen(real, "rb");
+    if (e) {
+      Elf64_Ehdr eh;
+      if (fread(&eh, 1, sizeof eh, e) == sizeof eh &&
+          eh.e_type == ET_DYN)
+        bias = kb_image_base(kb_template, real);
+      fclose(e);
+    }
+  }
+  int n = 0;
+  for (int i = 0; i < kb_nheads; i++) {
+    uintptr_t a = kb_heads[i].addr + bias;
+    /* exclude the word at main: the fork-template clone gadget
+     * rewrites and restores that whole 8-byte word from its
+     * pre-arming snapshot, which would silently strip any int3
+     * armed inside it */
+    if (!kb_in_image(a) ||
+        (a >= kb_main_addr && a < kb_main_addr + 8))
+      continue;
+    kb_heads[n].addr = a;
+    kb_heads[n].armed = 0;
+    n++;
+  }
+  kb_nheads = n;
+  qsort(kb_heads, kb_nheads, sizeof *kb_heads, kb_head_cmp);
+  n = 0;
+  for (int i = 0; i < kb_nheads; i++)
+    if (!n || kb_heads[i].addr != kb_heads[n - 1].addr)
+      kb_heads[n++] = kb_heads[i];
+  kb_nheads = n;
+  int armed = 0;
+  for (int i = 0; i < kb_nheads; i++) {
+    if (kb_poke_byte(kb_template, kb_heads[i].addr, 0xCC,
+                     &kb_heads[i].orig) == 0) {
+      kb_heads[i].armed = 1;
+      armed++;
+    }
+  }
+  kb_untracer = armed > 0;
+  if (getenv("KB_TRACE_DEBUG"))
+    fprintf(stderr, "kb_trace: untracer armed %d/%d leaders\n",
+            armed, kb_nheads);
+}
+
+static void kb_head_disarm(pid_t pid, int i) {
+  if (pid > 0) kb_poke_byte(pid, kb_heads[i].addr, kb_heads[i].orig, NULL);
+}
+
+/* Leaders that fired during the current fast exec.  If the full-map
+ * re-run cannot happen (fuzzer hang-killed the child, or the re-run
+ * spawn failed), these are RE-ARMED in the template: the novelty is
+ * deferred to a later exec that reaches the block instead of being
+ * lost forever (the map itself stays empty — fast execs never write
+ * provisional slots, whose breakpoint-sequence edge identities would
+ * not be comparable with block-step maps). */
+#define KB_MAX_FIRED 512
+static int kb_fired[KB_MAX_FIRED];
+static int kb_nfired;
+
+static void kb_rearm_fired(void) {
+  for (int k = 0; k < kb_nfired; k++) {
+    int i = kb_fired[k];
+    if (!kb_heads[i].armed &&
+        kb_poke_byte(kb_template, kb_heads[i].addr, 0xCC, NULL) == 0)
+      kb_heads[i].armed = 1;
+  }
+  kb_nfired = 0;
+}
+
+/* Native-speed exec over a template child with armed leaders.
+ * Returns the wait status; *newcov = 1 iff any leader fired. */
+static int kb_untracer_loop(pid_t pid, int *newcov) {
+  int status = 0, deliver = 0, stall = 0, last_sig = 0;
+  uintptr_t last_pc = 0;
+  *newcov = 0;
+  kb_nfired = 0;
+  for (;;) {
+    if (ptrace(PTRACE_CONT, pid, NULL, (void *)(uintptr_t)deliver) != 0) {
+      waitpid(pid, &status, __WALL); /* vanished (hang-timeout kill) */
+      return status;
+    }
+    deliver = 0;
+    if (waitpid(pid, &status, __WALL) < 0) return status;
+    if (!WIFSTOPPED(status)) return status;
+    int sig = WSTOPSIG(status);
+    if (sig == SIGTRAP) {
+      uintptr_t pc = kb_read_pc(pid);
+      int i = kb_head_find(pc - KB_BP_PC_REWIND);
+      if (i >= 0 && kb_heads[i].armed && kb_stopped_on_int3(pid)) {
+        uintptr_t a = kb_heads[i].addr;
+        kb_head_disarm(pid, i);          /* resume this child */
+        kb_head_disarm(kb_template, i);  /* future children skip it */
+        kb_heads[i].armed = 0;
+        if (kb_nfired < KB_MAX_FIRED) kb_fired[kb_nfired++] = i;
+        kb_set_pc(pid, a);
+        *newcov = 1;
+        kb_dbg_head_hits++;
+        if (kb_log) fprintf(kb_log, "bp %lx\n", (unsigned long)a);
+      } else {
+        deliver = SIGTRAP; /* the target's own int3/trap */
+      }
+    } else {
+      uintptr_t pc = kb_read_pc(pid);
+      if (sig == last_sig && pc == last_pc) {
+        if (++stall > KB_MAX_STALL) break;
+      } else {
+        stall = 0;
+        last_sig = sig;
+        last_pc = pc;
+      }
+      deliver = sig == SIGSTOP ? 0 : sig;
+    }
+  }
+  kill(pid, SIGKILL);
+  waitpid(pid, &status, __WALL);
+  return status;
+}
+#endif /* __x86_64__ */
 
 /* Fallback engine: single-step `pid` to completion over everything,
  * per-instruction edges (non-x86 hosts, SINGLEBLOCK-less kernels,
@@ -804,10 +1136,14 @@ int main(int argc, char **argv) {
     kb_warmup(argv + 1);
 #if defined(__x86_64__)
     if (!getenv("KB_TRACE_NOFORK")) kb_template_setup(argv + 1);
+    if (kb_template > 0 && !kb_env_flag("KB_TRACE_FULL") &&
+        kb_load_heads(argv[1]))
+      kb_untracer_arm(argv[1]);
 #endif
   }
 
   pid_t child = -1;
+  int child_tmpl = 0; /* current child minted from the armed template */
   for (;;) {
     unsigned char cmd;
     if (read(KB_FORKSRV_FD, &cmd, 1) != 1) _exit(0);
@@ -820,17 +1156,23 @@ int main(int argc, char **argv) {
         if (getenv("KB_TRACE_DEBUG"))
           fprintf(stderr,
                   "kb_trace: %u stops, %u excursions, %u tforks, "
-                  "%u spawns, %u bp-drops\n",
+                  "%u spawns, %u bp-drops, %u fast execs, "
+                  "%u head hits, %u reruns\n",
                   kb_dbg_stops, kb_dbg_excursions, kb_dbg_tforks,
-                  kb_dbg_spawns, kb_dbg_bp_dropped);
+                  kb_dbg_spawns, kb_dbg_bp_dropped, kb_dbg_fast_execs,
+                  kb_dbg_head_hits, kb_dbg_reruns);
         _exit(0);
 
       case KB_CMD_FORK:
       case KB_CMD_FORK_RUN: {
         child = -1;
+        child_tmpl = 0;
 #if defined(__x86_64__)
         child = kb_template_fork();
-        if (child > 0) kb_dbg_tforks++;
+        if (child > 0) {
+          kb_dbg_tforks++;
+          child_tmpl = 1;
+        }
 #endif
         if (child < 0) {
           child = kb_spawn(argv + 1);
@@ -851,6 +1193,52 @@ int main(int argc, char **argv) {
         static int kb_first_recorded = 1;
         int32_t st32 = -1;
         if (child > 0) {
+#if defined(__x86_64__)
+          if (kb_untracer && child_tmpl) {
+            int newcov = 0;
+            st32 = (int32_t)kb_untracer_loop(child, &newcov);
+            child = -1;
+            kb_dbg_fast_execs++;
+            /* fuzzer-killed children (hang timeout) must not be
+             * re-traced — the re-run would hang the tracer while
+             * the fuzzer is already moving on */
+            int killed = WIFSIGNALED(st32) && WTERMSIG(st32) == SIGKILL;
+            int crashed = WIFSIGNALED(st32) && !killed;
+            int retraced = 0;
+            if ((newcov || crashed) && !killed) {
+              /* rebuild a complete hit-counted map for this input
+               * with the block-step engine (same slot identities as
+               * every other full map); the fast run's status is the
+               * verdict either way */
+              lseek(0, 0, SEEK_SET); /* fast child consumed stdin */
+              pid_t r = kb_spawn(argv + 1);
+              if (r > 0) {
+                memset(kb_map, 0, KB_SHM_TOTAL);
+                kb_dbg_reruns++;
+                kb_guard_pid = r;
+                alarm(10);
+                kb_trace_child(r, argv[1]);
+                alarm(0);
+                kb_guard_pid = 0;
+                retraced = 1;
+              }
+            }
+            if (newcov && !retraced) {
+              /* the novelty could not be turned into a full map
+               * (hang-killed child, or the re-run spawn failed) —
+               * re-arm the fired leaders so a later exec that
+               * reaches those blocks re-reports them instead of
+               * the discovery being lost forever */
+              kb_rearm_fired();
+            }
+            if (kb_log) {
+              fprintf(kb_log, "---\n");
+              fflush(kb_log);
+            }
+            if (write(KB_STATUS_FD, &st32, 4) != 4) _exit(1);
+            break;
+          }
+#endif
           st32 = (int32_t)kb_trace_child(child, argv[1]);
           child = -1;
           if (kb_first_recorded) {

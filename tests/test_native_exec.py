@@ -245,6 +245,38 @@ def test_qemu_mode_binary_only_coverage(corpus_bin):
         instr.cleanup()
 
 
+def test_untracer_mode_map_parity(corpus_bin, monkeypatch):
+    """UnTracer mode (default) vs full block-stepping
+    (KB_TRACE_FULL=1): for a novelty-bearing input the re-run must
+    rebuild the IDENTICAL map the full engine produces, and a
+    repeated input must report nothing new in both modes."""
+    import json as _json
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+
+    def coverage_for(env_full):
+        if env_full:
+            monkeypatch.setenv("KB_TRACE_FULL", "1")
+        else:
+            monkeypatch.delenv("KB_TRACE_FULL", raising=False)
+        instr = instrumentation_factory("afl", _json.dumps(
+            {"qemu_mode": 1}))
+        try:
+            instr.enable(b"zzzz", cmd_line=corpus_bin("test-plain"))
+            assert instr.is_new_path() > 0
+            nbytes = instr.coverage_bytes()
+            instr.enable(b"zzzz", cmd_line=corpus_bin("test-plain"))
+            assert instr.is_new_path() == 0
+            return nbytes
+        finally:
+            instr.cleanup()
+
+    fast_bytes = coverage_for(False)
+    full_bytes = coverage_for(True)
+    assert fast_bytes == full_bytes
+
+
 def test_qemu_mode_plain_exec(corpus_bin):
     """qemu_mode with use_fork_server=0: one tracer process per exec
     (the reference's -Q without forkserver); verdicts still come

@@ -29,7 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_NONE, MAP_SIZE
-from ..native.exec_backend import ExecPool, ExecTarget, classify
+from ..native.exec_backend import (
+    ExecPool, ExecTarget, classify, classify_batch,
+)
 from ..ops.coverage import (
     COUNT_CLASS_LOOKUP, classify_counts, count_non_255_bytes,
     merge_virgin, simplify_trace,
@@ -191,10 +193,19 @@ class AflInstrumentation(Instrumentation):
             mem_limit_mb=int(self.options["mem_limit"]),
             coverage=True,
             timeout=float(self.options["timeout"]))
+        extra_env = []
         if self.options["modules"]:
             # targets read KB_MODULES at constructor time; delivered
             # as per-target child env, not the fuzzer's own environ
-            kwargs["extra_env"] = ["KB_MODULES=1"]
+            extra_env.append("KB_MODULES=1")
+        if self.options["qemu_mode"]:
+            # budget for kb-trace's UnTracer full-map re-run: it must
+            # finish inside the exec's status window or the exec is
+            # misreported as a hang (kb_trace.c kb_rerun_budget)
+            extra_env.append(
+                f"KB_TRACE_BUDGET={0.8 * float(self.options['timeout'])}")
+        if extra_env:
+            kwargs["extra_env"] = extra_env
         workers = int(self.options["workers"])
         argv = self._build_argv(cmd_line)
         if workers > 1 and use_stdin and input_file is None:
@@ -314,12 +325,7 @@ class AflInstrumentation(Instrumentation):
                     [bitmaps,
                      np.zeros((pad, bitmaps.shape[1]), dtype=np.uint8)])
         n = len(statuses_raw)
-        verdicts = np.full(n, FUZZ_NONE, dtype=np.int32)
-        verdicts[statuses_raw >= 512] = FUZZ_CRASH
-        verdicts[statuses_raw == -1] = FUZZ_HANG
-        verdicts[statuses_raw <= -2] = FUZZ_ERROR  # incl. -3 padding
-        exit_codes = np.where(statuses_raw >= 512, statuses_raw - 512,
-                              np.maximum(statuses_raw, 0)).astype(np.int32)
+        verdicts, exit_codes = classify_batch(statuses_raw)
 
         if self.options["device_triage"]:
             new_paths, uc, uh, vb, vc, vh = _triage_host_bitmaps(

@@ -95,32 +95,70 @@ def _ipt_step(instrs, edge_table, inputs, lengths, filt_lo, filt_hi,
 
 @register_instrumentation
 class IptInstrumentation(Instrumentation):
-    """Hash-set (path-sensitive) novelty over KBVM trace streams."""
+    """Hash-set (path-sensitive) novelty over KBVM trace streams, or —
+    with ``{"qemu_mode": 1}`` — over REAL host binaries' block-PC
+    streams observed by the kb-trace engine in hash mode (the
+    reference fuzzes uninstrumented binaries this way via Intel PT,
+    linux_ipt_instrumentation.c:212-426; this host tier gets the same
+    (tip, tnt)-pair novelty from ptrace block tracing instead of a PT
+    PMU)."""
     name = "ipt"
     supports_batch = True
     device_backed = True
     OPTION_SCHEMA = {"target": str, "program_file": str,
-                     "max_steps": int, "filters": list}
+                     "max_steps": int, "filters": list,
+                     "qemu_mode": int, "qemu_path": str,
+                     "timeout": float}
     OPTION_DESCS = {
         "target": "built-in KBVM target name",
         "program_file": "path to a .npz compiled KBVM program",
         "max_steps": "override the program's hang step budget",
         "filters": "[[lo, hi], ...] block-id ranges to trace "
                    "(default: everything; reference IPT address "
-                   "filters)",
+                   "filters; KBVM targets only)",
+        "qemu_mode": "1 = hash coverage of an UNINSTRUMENTED host "
+                     "binary: run it under kb-trace in hash mode "
+                     "(KB_TRACE_HASH=1), novelty = unseen 128-bit "
+                     "(tip, tnt) pair over the block-PC stream",
+        "qemu_path": "tracer binary for qemu_mode (default "
+                     "native/build/kb-trace)",
+        "timeout": "qemu_mode: seconds before an exec counts as a "
+                   "hang (default 2.0)",
     }
-    DEFAULTS: dict = {}
+    DEFAULTS: dict = {"qemu_mode": 0, "timeout": 2.0}
 
     def __init__(self, options: Optional[str] = None):
         super().__init__(options)
-        self.program = prog = targets_mod.load_program_from_options(
-            self.options,
-            'ipt needs {"target": name} or {"program_file": path} — '
-            "hash coverage of native host binaries needs an Intel PT "
-            "PMU, absent on TPU-VM hosts; use the afl instrumentation "
-            "for host targets")
-        self._instrs = jnp.asarray(prog.instrs)
-        self._edge_table = jnp.asarray(prog.edge_table)
+        self._host_target = None
+        self._host_target_key = None
+        if self.options["qemu_mode"]:
+            # host-binary tier: targets come from the driver's
+            # cmd_line at enable/prepare_host time, like afl
+            self.program = None
+            self.device_backed = False  # instance override
+            qemu = self.options.get("qemu_path")
+            if not qemu:
+                from ..native.build import build_native, kb_trace_path
+                build_native()
+                qemu = kb_trace_path()
+                self.options["qemu_path"] = qemu
+            import os
+            if not os.path.exists(qemu):
+                raise ValueError(
+                    f"qemu_mode: tracer binary {qemu!r} not found "
+                    "(the bundled default is native/build/kb-trace)")
+            if self.options.get("filters"):
+                raise ValueError(
+                    "ipt filters are block-id ranges of KBVM programs; "
+                    "host-binary (qemu_mode) hashing is whole-image")
+        else:
+            self.program = prog = targets_mod.load_program_from_options(
+                self.options,
+                'ipt needs {"target": name} or {"program_file": path} '
+                'for KBVM targets, or {"qemu_mode": 1} to hash-cover '
+                "a real host binary under the kb-trace engine")
+            self._instrs = jnp.asarray(prog.instrs)
+            self._edge_table = jnp.asarray(prog.edge_table)
         # no filters configured (the default) = whole-trace hashing,
         # which the engines compute in-loop — no stream materialized
         self._unfiltered = not self.options.get("filters")
@@ -137,9 +175,71 @@ class IptInstrumentation(Instrumentation):
         self._last_unique_crash = False
         self._last_unique_hang = False
 
+    # -- host-binary tier (qemu_mode) -----------------------------------
+
+    def _ensure_host_target(self, cmd_line: str, use_stdin: bool,
+                            input_file: Optional[str]):
+        import shlex
+        from ..native.exec_backend import ExecTarget
+        key = (cmd_line, use_stdin, input_file)
+        if self._host_target is not None and \
+                self._host_target_key == key:
+            return self._host_target
+        if self._host_target is not None:
+            self._host_target.close()
+        argv = [self.options["qemu_path"]] + shlex.split(cmd_line)
+        self._host_target = ExecTarget(
+            argv, use_stdin=use_stdin, input_file=input_file,
+            use_forkserver=True, coverage=True,
+            timeout=float(self.options["timeout"]),
+            extra_env=["KB_TRACE_HASH=1"])  # hash mode: no re-runs,
+        # so no KB_TRACE_BUDGET needed (every exec is a full trace)
+        self._host_target_key = key
+        return self._host_target
+
+    def prepare_host(self, cmd_line: str, use_stdin: bool,
+                     input_file: Optional[str] = None) -> None:
+        self._ensure_host_target(cmd_line, use_stdin, input_file)
+
+    @staticmethod
+    def _host_pairs(bitmaps: np.ndarray) -> List[int]:
+        """The tracer publishes the exec's (tip, tnt) u64 pair in the
+        first 16 bytes of the SHM region (kb_trace.c hash mode);
+        fold into one 128-bit set key."""
+        words = bitmaps[:, :16].copy().view("<u8")
+        return [(int(w[0]) << 64) | int(w[1]) for w in words]
+
+    # -- set updates (shared by the KBVM and host tiers) ---------------
+
+    def _update_sets(self, statuses: np.ndarray, pairs: List[int],
+                     exit_codes: np.ndarray) -> BatchResult:
+        n = len(pairs)
+        self.total_execs += n
+        new_paths = np.zeros(n, dtype=np.int32)
+        uc = np.zeros(n, dtype=bool)
+        uh = np.zeros(n, dtype=bool)
+        # sequential membership+insert: in-batch duplicates count once
+        # (exact single-exec-loop parity, like jit_harness "exact")
+        for i, p in enumerate(pairs):
+            if p not in self.hashes:
+                self.hashes.add(p)
+                new_paths[i] = 1
+            if statuses[i] == FUZZ_CRASH and p not in self.crash_hashes:
+                self.crash_hashes.add(p)
+                uc[i] = True
+            elif statuses[i] == FUZZ_HANG and p not in self.hang_hashes:
+                self.hang_hashes.add(p)
+                uh[i] = True
+        return BatchResult(statuses=statuses, new_paths=new_paths,
+                           unique_crashes=uc, unique_hangs=uh,
+                           exit_codes=np.asarray(exit_codes))
+
     # -- batched --------------------------------------------------------
 
-    def run_batch(self, inputs, lengths) -> BatchResult:
+    def run_batch(self, inputs, lengths,
+                  pad_to: Optional[int] = None) -> BatchResult:
+        if self.options["qemu_mode"]:
+            return self._run_batch_host(inputs, lengths, pad_to)
         inputs = jnp.asarray(inputs, dtype=jnp.uint8)
         lengths = jnp.asarray(lengths, dtype=jnp.int32)
         if self._unfiltered:
@@ -156,40 +256,71 @@ class IptInstrumentation(Instrumentation):
         statuses = np.asarray(statuses)
         tip = np.asarray(tip, dtype=np.uint64)
         tnt = np.asarray(tnt, dtype=np.uint64)
-        pairs = (tip << np.uint64(32)) | tnt
-        n = len(pairs)
-        self.total_execs += n
-        new_paths = np.zeros(n, dtype=np.int32)
-        uc = np.zeros(n, dtype=bool)
-        uh = np.zeros(n, dtype=bool)
-        # sequential membership+insert: in-batch duplicates count once
-        # (exact single-exec-loop parity, like jit_harness "exact")
-        for i, p in enumerate(map(int, pairs)):
-            if p not in self.hashes:
-                self.hashes.add(p)
-                new_paths[i] = 1
-            if statuses[i] == FUZZ_CRASH and p not in self.crash_hashes:
-                self.crash_hashes.add(p)
-                uc[i] = True
-            elif statuses[i] == FUZZ_HANG and p not in self.hang_hashes:
-                self.hang_hashes.add(p)
-                uh[i] = True
-        return BatchResult(statuses=statuses, new_paths=new_paths,
-                           unique_crashes=uc, unique_hangs=uh,
-                           exit_codes=np.asarray(exit_codes))
+        pairs = [int(p) for p in (tip << np.uint64(32)) | tnt]
+        return self._update_sets(statuses, pairs,
+                                 np.asarray(exit_codes))
+
+    def _run_batch_host(self, inputs, lengths,
+                        pad_to: Optional[int] = None) -> BatchResult:
+        from .. import FUZZ_ERROR
+        from ..native.exec_backend import classify_batch
+        if self._host_target is None:
+            raise RuntimeError(
+                "ipt qemu_mode: prepare_host() not called (the driver "
+                "binds the target command first)")
+        inputs = np.asarray(inputs)
+        lengths = np.asarray(lengths)
+        statuses_raw, bitmaps = self._host_target.run_batch(inputs,
+                                                            lengths)
+        pairs = self._host_pairs(bitmaps)
+        n = len(statuses_raw)
+        verdicts, exit_codes = classify_batch(statuses_raw)
+        res = self._update_sets(verdicts, pairs, exit_codes)
+        if pad_to is not None and pad_to > n:
+            pad = pad_to - n
+            res = BatchResult(
+                statuses=np.concatenate(
+                    [res.statuses,
+                     np.full(pad, FUZZ_ERROR, dtype=np.int32)]),
+                new_paths=np.concatenate(
+                    [res.new_paths, np.zeros(pad, dtype=np.int32)]),
+                unique_crashes=np.concatenate(
+                    [res.unique_crashes, np.zeros(pad, dtype=bool)]),
+                unique_hangs=np.concatenate(
+                    [res.unique_hangs, np.zeros(pad, dtype=bool)]),
+                exit_codes=np.concatenate(
+                    [res.exit_codes, np.zeros(pad, dtype=np.int32)]))
+        return res
 
     # -- single-exec shim ----------------------------------------------
 
     def enable(self, input_bytes: Optional[bytes] = None,
                cmd_line: Optional[str] = None) -> None:
-        if input_bytes is None:
-            raise ValueError("ipt needs input bytes")
-        L = max(((len(input_bytes) + 7) // 8) * 8, 8)
-        buf = np.zeros((1, L), dtype=np.uint8)
-        buf[0, :len(input_bytes)] = np.frombuffer(input_bytes,
-                                                  dtype=np.uint8)
-        res = self.run_batch(buf, np.array([len(input_bytes)],
-                                           dtype=np.int32))
+        if self.options["qemu_mode"]:
+            if cmd_line is None:
+                raise ValueError(
+                    "ipt qemu_mode needs a cmd_line (use a host "
+                    "driver: file/stdin)")
+            from ..native.exec_backend import classify
+            use_stdin = input_bytes is not None
+            t = self._ensure_host_target(cmd_line, use_stdin, None)
+            t.clear_trace()
+            status_raw = t.run(input_bytes or b"")
+            verdict, _ = classify(status_raw)
+            pair = self._host_pairs(
+                t.trace_bits().reshape(1, -1))[0]
+            res = self._update_sets(
+                np.array([verdict], dtype=np.int32), [pair],
+                np.array([0], dtype=np.int32))
+        else:
+            if input_bytes is None:
+                raise ValueError("ipt needs input bytes")
+            L = max(((len(input_bytes) + 7) // 8) * 8, 8)
+            buf = np.zeros((1, L), dtype=np.uint8)
+            buf[0, :len(input_bytes)] = np.frombuffer(input_bytes,
+                                                      dtype=np.uint8)
+            res = self.run_batch(buf, np.array([len(input_bytes)],
+                                               dtype=np.int32))
         self.last_status = int(res.statuses[0])
         self.last_new_path = int(res.new_paths[0])
         self._last_unique_crash = bool(res.unique_crashes[0])
@@ -202,7 +333,13 @@ class IptInstrumentation(Instrumentation):
         return self._last_unique_hang
 
     def get_module_info(self) -> List[str]:
-        return [self.program.name]
+        return [self.program.name if self.program is not None
+                else "target"]
+
+    def cleanup(self) -> None:
+        if self._host_target is not None:
+            self._host_target.close()
+            self._host_target = None
 
     # -- state / merge (reference ipt get_state: hash list) -------------
 
@@ -217,8 +354,11 @@ class IptInstrumentation(Instrumentation):
     @property
     def _hash_scheme(self) -> str:
         """Hash-space identity: fast (in-loop path hash + counts
-        hash) and filtered (murmur over the windowed stream) pairs
-        are DIFFERENT 64-bit spaces — states only union within one."""
+        hash), filtered (murmur over the windowed stream), and
+        host-block (kb-trace 128-bit pairs over real binaries) are
+        DIFFERENT spaces — states only union within one."""
+        if self.options["qemu_mode"]:
+            return "host-block"
         return "path+counts" if self._unfiltered else "stream"
 
     def _check_scheme(self, d: Dict) -> bool:
@@ -239,7 +379,8 @@ class IptInstrumentation(Instrumentation):
     def get_state(self) -> str:
         return json.dumps({
             "instrumentation": self.name,
-            "target": self.program.name,
+            "target": (self.program.name if self.program is not None
+                       else "host"),
             "hash_scheme": self._hash_scheme,
             "total_execs": self.total_execs,
             "hashes": self._dump(self.hashes),

@@ -100,6 +100,21 @@ def classify(status: int) -> Tuple[int, int]:
     return FUZZ_NONE, status
 
 
+def classify_batch(statuses_raw: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized classify() over a raw status array: (verdicts,
+    exit_codes).  The single definition of the status encoding for
+    batched host tiers (afl, host ipt) — ``<= -2`` covers both the
+    error sentinel and result-padding lanes (-3)."""
+    verdicts = np.full(len(statuses_raw), FUZZ_NONE, dtype=np.int32)
+    verdicts[statuses_raw >= 512] = FUZZ_CRASH
+    verdicts[statuses_raw == -1] = FUZZ_HANG
+    verdicts[statuses_raw <= -2] = FUZZ_ERROR
+    exit_codes = np.where(statuses_raw >= 512, statuses_raw - 512,
+                          np.maximum(statuses_raw, 0)).astype(np.int32)
+    return verdicts, exit_codes
+
+
 class ExecTarget:
     """One configured target; reusable across many executions."""
 

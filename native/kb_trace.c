@@ -132,11 +132,56 @@ static unsigned kb_prev; /* rolling AFL edge state, reset per exec */
 
 static FILE *kb_log; /* KB_TRACE_LOG=path: per-exec PC stream dump */
 
+/* ---- hash mode (KB_TRACE_HASH=1): the host-binary `ipt` tier.
+ * The reference's flagship Linux instrumentation reduces each exec
+ * to an XXH64 (tip, tnt) pair over the Intel-PT packet stream and
+ * calls the exec novel when the pair is unseen
+ * (linux_ipt_instrumentation.c:212-426).  This host has no PT PMU;
+ * the block tracer already observes the same control flow, so hash
+ * mode folds the ordered block-PC stream into two murmur-style
+ * 64-bit accumulators — tip over the targets, tnt over the
+ * transition stream (pc ^ prev>>1), the two roles the reference's
+ * TIP/TNT packets play — and publishes the pair in the first 16
+ * bytes of the SHM region at exec end (hash coverage does not use
+ * the bitmap).  Path-sensitive novelty requires observing every
+ * block, so hash mode forces the full block engine (no UnTracer). */
+static int kb_opt_hash;
+static uint64_t kb_h_tip, kb_h_tnt;
+static uintptr_t kb_h_prev;
+#define KB_H_TIP_SEED 0x1994C9A500000001ULL
+#define KB_H_TNT_SEED 0x7E57ED0100000001ULL
+
+static inline uint64_t kb_mix64(uint64_t h, uint64_t v) {
+  v *= 0x87c37b91114253d5ULL;
+  v = (v << 31) | (v >> 33);
+  v *= 0x4cf5ad432745937fULL;
+  h ^= v;
+  h = (h << 27) | (h >> 37);
+  return h * 5 + 0x52dce729;
+}
+
+static void kb_hash_reset(void) {
+  kb_h_tip = KB_H_TIP_SEED;
+  kb_h_tnt = KB_H_TNT_SEED;
+  kb_h_prev = 0;
+}
+
+static void kb_hash_writeout(void) {
+  if (!kb_opt_hash) return;
+  memcpy(kb_map, &kb_h_tip, 8);
+  memcpy(kb_map + 8, &kb_h_tnt, 8);
+}
+
 static inline void kb_record(uintptr_t pc) {
   unsigned cur = kb_slot(pc);
   if (kb_log) fprintf(kb_log, "%lx\n", (unsigned long)pc);
   kb_map[cur ^ kb_prev]++;
   kb_prev = cur >> 1;
+  if (kb_opt_hash) {
+    kb_h_tip = kb_mix64(kb_h_tip, (uint64_t)pc);
+    kb_h_tnt = kb_mix64(kb_h_tnt, (uint64_t)(pc ^ (kb_h_prev >> 1)));
+    kb_h_prev = pc;
+  }
 }
 
 /* ---- main-image executable ranges (block mode steps only inside
@@ -420,13 +465,30 @@ static pid_t kb_spawn(char **argv) {
   return pid;
 }
 
-/* Watchdog for the startup runs (warm-up, template parking): kills
- * the guarded child if it hangs before reaching its stop point. */
+/* Watchdog for the startup runs (warm-up, template parking) and the
+ * UnTracer full-map re-runs: kills the guarded child if it outlives
+ * its budget. */
 static volatile pid_t kb_guard_pid;
+static volatile sig_atomic_t kb_guard_fired;
 
 static void kb_guard_alarm(int sig) {
   (void)sig;
+  kb_guard_fired = 1;
   if (kb_guard_pid > 0) kill(kb_guard_pid, SIGKILL);
+}
+
+/* Re-run time budget: the re-run happens inside the exec's status
+ * window, so it must finish before the FUZZER's per-exec timeout or
+ * the exec is misreported as a hang (and a long enough overrun tears
+ * the forkserver down).  The fuzzer passes its budget via
+ * KB_TRACE_BUDGET (seconds); default/cap 10s, floor 1s (alarm
+ * granularity). */
+static unsigned kb_rerun_budget(void) {
+  const char *e = getenv("KB_TRACE_BUDGET");
+  double d = e ? atof(e) : 0;
+  if (d <= 0 || d > 10) d = 10;
+  if (d < 1) d = 1;
+  return (unsigned)d;
 }
 
 /* ---- fork-template (x86_64): the reference's QEMU tier starts its
@@ -641,7 +703,7 @@ static int kb_head_find(uintptr_t addr) {
  * frontier that matters), and function-symbol entries.  Returns the
  * count, leaders in kb_heads[].addr (unbiased). */
 static int kb_load_heads(const char *target) {
-  static char real[PATH_MAX], line[512];
+  static char real[PATH_MAX], line[4096];
   if (!realpath(target, real)) return 0;
   /* argv exec, not popen: a shell would re-interpret quote characters
    * in the target path */
@@ -694,6 +756,14 @@ static int kb_load_heads(const char *target) {
   while (fgets(line, sizeof line, f)) {
     unsigned long addr;
     int off = 0;
+    /* over-long line (huge mangled symbol): fgets split it — drop
+     * the tail too, or its fragment could sscanf-match as a bogus
+     * leader address and arm an int3 mid-instruction */
+    if (!strchr(line, '\n')) {
+      int c;
+      while ((c = fgetc(f)) != EOF && c != '\n') {}
+      continue;
+    }
     /* function symbol line: "0000000000001030 <name>:" */
     if (line[0] != ' ' && line[0] != '\t') {
       if (sscanf(line, "%lx <%*[^>]>:", &addr) == 1) {
@@ -825,15 +895,27 @@ static void kb_head_disarm(pid_t pid, int i) {
 #define KB_MAX_FIRED 512
 static int kb_fired[KB_MAX_FIRED];
 static int kb_nfired;
+static int kb_fired_overflow;
+
+static void kb_rearm_one(int i) {
+  if (!kb_heads[i].armed &&
+      kb_poke_byte(kb_template, kb_heads[i].addr, 0xCC, NULL) == 0)
+    kb_heads[i].armed = 1;
+}
 
 static void kb_rearm_fired(void) {
-  for (int k = 0; k < kb_nfired; k++) {
-    int i = kb_fired[k];
-    if (!kb_heads[i].armed &&
-        kb_poke_byte(kb_template, kb_heads[i].addr, 0xCC, NULL) == 0)
-      kb_heads[i].armed = 1;
+  if (kb_fired_overflow) {
+    /* more leaders fired this exec than the table holds — re-arm
+     * every disarmed leader.  Long-disarmed ones re-fire once and
+     * re-report blocks the virgin maps already hold (novelty no-op,
+     * one extra re-run); losing the overflow leaders forever would
+     * not be a no-op. */
+    for (int i = 0; i < kb_nheads; i++) kb_rearm_one(i);
+  } else {
+    for (int k = 0; k < kb_nfired; k++) kb_rearm_one(kb_fired[k]);
   }
   kb_nfired = 0;
+  kb_fired_overflow = 0;
 }
 
 /* Native-speed exec over a template child with armed leaders.
@@ -861,6 +943,7 @@ static int kb_untracer_loop(pid_t pid, int *newcov) {
         kb_head_disarm(kb_template, i);  /* future children skip it */
         kb_heads[i].armed = 0;
         if (kb_nfired < KB_MAX_FIRED) kb_fired[kb_nfired++] = i;
+        else kb_fired_overflow = 1;
         kb_set_pc(pid, a);
         *newcov = 1;
         kb_dbg_head_hits++;
@@ -894,6 +977,7 @@ static int kb_step_loop(pid_t pid, const char *target) {
   int deliver = 0, stall = 0, last_sig = 0;
   uintptr_t last_pc = 0;
   kb_prev = 0;
+  kb_hash_reset();
   if (kb_run_to(pid, kb_entry_addr(pid, target), &status)) return status;
   for (unsigned n = 0; n < KB_MAX_STEPS; n++) {
     if (ptrace(PTRACE_SINGLESTEP, pid, NULL,
@@ -954,6 +1038,7 @@ static int kb_block_loop(pid_t pid, const char *target) {
   int deliver = 0, stall = 0, last_sig = 0, excursions = 0;
   uintptr_t last_pc = 0;
   kb_prev = 0;
+  kb_hash_reset();
   kb_nbps = 0;
   if (!kb_load_xranges(pid, target)) return -2;
   int from_entry = kb_main_addr == 0;
@@ -1111,6 +1196,7 @@ int main(int argc, char **argv) {
   }
   kb_opt_off = kb_env_flag("KB_TRACE_OFF");
   kb_opt_step = kb_env_flag("KB_TRACE_STEP");
+  kb_opt_hash = kb_env_flag("KB_TRACE_HASH");
 
   uint32_t hello = KB_HELLO;
   if (write(KB_STATUS_FD, &hello, 4) != 4) {
@@ -1118,6 +1204,7 @@ int main(int argc, char **argv) {
     pid_t pid = kb_spawn(argv + 1);
     if (pid < 0) return 2;
     int status = kb_trace_child(pid, argv[1]);
+    kb_hash_writeout();
     unsigned touched = 0;
     for (unsigned i = 0; i < KB_MAP_SIZE; i++) touched += kb_map[i] != 0;
     fprintf(stderr, "kb_trace: %u bitmap slots touched\n", touched);
@@ -1137,7 +1224,7 @@ int main(int argc, char **argv) {
 #if defined(__x86_64__)
     if (!getenv("KB_TRACE_NOFORK")) kb_template_setup(argv + 1);
     if (kb_template > 0 && !kb_env_flag("KB_TRACE_FULL") &&
-        kb_load_heads(argv[1]))
+        !kb_opt_hash && kb_load_heads(argv[1]))
       kb_untracer_arm(argv[1]);
 #endif
   }
@@ -1216,11 +1303,17 @@ int main(int argc, char **argv) {
                 memset(kb_map, 0, KB_SHM_TOTAL);
                 kb_dbg_reruns++;
                 kb_guard_pid = r;
-                alarm(10);
+                kb_guard_fired = 0;
+                alarm(kb_rerun_budget());
                 kb_trace_child(r, argv[1]);
                 alarm(0);
                 kb_guard_pid = 0;
-                retraced = 1;
+                /* guard-killed re-run: the map holds a valid PREFIX
+                 * of the full trace (real block-step slots, just
+                 * incomplete) — keep it, but treat the re-run as
+                 * failed so the fired leaders re-arm and the rest of
+                 * the discovery re-fires on a later exec */
+                retraced = !kb_guard_fired;
               }
             }
             if (newcov && !retraced) {
@@ -1241,6 +1334,15 @@ int main(int argc, char **argv) {
 #endif
           st32 = (int32_t)kb_trace_child(child, argv[1]);
           child = -1;
+          /* a fuzzer-killed (hang-timeout) exec stopped at an
+           * arbitrary block: its partial hash pair is timing-noise
+           * that would make every hang look like a new unique path.
+           * Publish the deterministic empty-trace pair instead so
+           * hangs dedupe. */
+          if (kb_opt_hash && WIFSIGNALED(st32) &&
+              WTERMSIG(st32) == SIGKILL)
+            kb_hash_reset();
+          kb_hash_writeout();
           if (kb_first_recorded) {
             kb_first_recorded = 0;
             int validated = 0;

@@ -111,9 +111,69 @@ def test_ipt_foreign_hash_scheme_degrades_not_raises():
     assert d.coverage_bytes() == a.coverage_bytes()
 
 
-def test_ipt_rejects_host_targets():
-    with pytest.raises(ValueError, match="PMU|afl"):
+def test_ipt_without_target_mentions_host_mode():
+    with pytest.raises(ValueError, match="qemu_mode"):
         instrumentation_factory("ipt", None)
+
+
+def test_ipt_host_binary_hash_coverage(corpus_bin):
+    """The host-binary ipt tier (reference
+    linux_ipt_instrumentation.c:212-426 role): an UNINSTRUMENTED
+    binary under kb-trace hash mode gets path-sensitive (tip, tnt)
+    pair novelty — distinct compare-fail paths are distinct pairs,
+    repeats are not novel, crash pairs drive uniqueness."""
+    instr = instrumentation_factory("ipt", json.dumps(
+        {"qemu_mode": 1}))
+    try:
+        tgt = corpus_bin("test-plain")
+        instr.enable(b"zzzz", cmd_line=tgt)
+        assert instr.get_fuzz_result() == FUZZ_NONE
+        assert instr.is_new_path() == 1
+        instr.enable(b"zzzz", cmd_line=tgt)
+        assert instr.is_new_path() == 0          # same path
+        instr.enable(b"ABCD", cmd_line=tgt)
+        assert instr.get_fuzz_result() == FUZZ_CRASH
+        assert instr.last_unique_crash()
+        instr.enable(b"ABCD", cmd_line=tgt)
+        assert not instr.last_unique_crash()     # same crash path
+        instr.enable(b"ABXD", cmd_line=tgt)
+        assert instr.is_new_path() == 1          # divergence at byte 2
+        assert instr.coverage_bytes() == 3       # 3 distinct paths
+        # batch path agrees with the single-exec loop
+        instr.prepare_host(tgt, use_stdin=True)
+        inputs = np.zeros((3, 4), np.uint8)
+        for i, s in enumerate([b"zzzz", b"ABXD", b"AXCD"]):
+            inputs[i, :4] = np.frombuffer(s, np.uint8)
+        res = instr.run_batch(inputs, np.full(3, 4, np.int32))
+        assert list(res.new_paths) == [0, 0, 1]
+    finally:
+        instr.cleanup()
+
+
+def test_ipt_host_state_merge_is_set_union(corpus_bin):
+    """Host-tier states merge as set union (reference merger fold)
+    and carry their own hash-space tag."""
+    tgt = corpus_bin("test-plain")
+    a = instrumentation_factory("ipt", json.dumps({"qemu_mode": 1}))
+    b = instrumentation_factory("ipt", json.dumps({"qemu_mode": 1}))
+    try:
+        a.enable(b"zzzz", cmd_line=tgt)
+        a.enable(b"ABXD", cmd_line=tgt)
+        b.enable(b"zzzz", cmd_line=tgt)
+        b.enable(b"AXCD", cmd_line=tgt)
+        union = a.hashes | b.hashes
+        a.merge(b.get_state())
+        assert a.hashes == union and len(union) == 3
+        assert json.loads(a.get_state())["hash_scheme"] == "host-block"
+        # VM-space states do not pollute host-space sets
+        vm = instrumentation_factory("ipt", '{"target": "test"}')
+        vm.enable(b"zzzz")
+        before = set(a.hashes)
+        a.merge(vm.get_state())
+        assert a.hashes == before
+    finally:
+        a.cleanup()
+        b.cleanup()
 
 
 def test_debug_crash_details(corpus_bin):

@@ -36,7 +36,6 @@ from ..ops.coverage import (
     COUNT_CLASS_LOOKUP, classify_counts, count_non_255_bytes,
     merge_virgin, simplify_trace,
 )
-from ..utils.logging import WARNING_MSG
 from ..utils.serialization import decode_array, encode_array
 from .base import BatchResult, Instrumentation, module_slice_edges
 from .factory import register_instrumentation
@@ -208,17 +207,13 @@ class AflInstrumentation(Instrumentation):
             kwargs["extra_env"] = extra_env
         workers = int(self.options["workers"])
         argv = self._build_argv(cmd_line)
-        if workers > 1 and use_stdin and input_file is None:
+        if workers > 1:
+            # stdin workers mint private temp files; file-delivery
+            # workers derive private @@ paths from the driver's
+            # (reference per-instance scaling,
+            # dynamorio_instrumentation.c:418-431)
             self._target = ExecPool(argv, workers, **kwargs)
         else:
-            # file delivery shares the driver's @@ path: single instance
-            if workers > 1:
-                WARNING_MSG(
-                    "afl: workers=%d requested but %s delivery forces "
-                    "a single target instance (each worker would need "
-                    "its own input file); running 1 instance — see "
-                    "docs/AFL.md", workers,
-                    "file" if not use_stdin else "explicit input_file")
             self._target = ExecTarget(argv, **kwargs)
         self._target_key = key
         return self._target
@@ -339,26 +334,70 @@ class AflInstrumentation(Instrumentation):
             new_paths, uc, uh = (np.asarray(new_paths), np.asarray(uc),
                                  np.asarray(uh))
         else:
-            new_paths = np.zeros(n, dtype=np.int32)
-            uc = np.zeros(n, dtype=bool)
-            uh = np.zeros(n, dtype=bool)
-            for i in range(n):
-                cls = _np_classify(bitmaps[i])
-                new_paths[i], self.virgin_bits = _np_has_new_bits(
-                    self.virgin_bits, cls)
-                simp = np.where(bitmaps[i] == 0, 1, 128).astype(np.uint8)
-                if verdicts[i] == FUZZ_CRASH:
-                    r, self.virgin_crash = _np_has_new_bits(
-                        self.virgin_crash, simp)
-                    uc[i] = r > 0
-                elif verdicts[i] == FUZZ_HANG:
-                    r, self.virgin_tmout = _np_has_new_bits(
-                        self.virgin_tmout, simp)
-                    uh[i] = r > 0
+            new_paths, uc, uh = self._np_triage_batch(bitmaps, verdicts)
         self._last_trace = bitmaps[real - 1] if real else None
         return BatchResult(statuses=verdicts, new_paths=new_paths,
                            unique_crashes=uc, unique_hangs=uh,
                            exit_codes=exit_codes)
+
+    def _np_triage_batch(self, bitmaps: np.ndarray,
+                         verdicts: np.ndarray
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Host triage of a batch of raw 64KB maps, sequential-exact.
+
+        The per-lane loop (classify + has_new_bits each exec, the
+        "~256µs/exec, saturates ~3.9k execs/s" pole in
+        docs/HOST_TIER.md) is replaced by a two-phase scan:
+
+        phase 1 (vectorized word-skip, the C has_new_bits play): find
+        the nonzero u64 words of every lane in one pass — fuzzing
+        maps are ~98% zero — then classify and test ONLY those words
+        against the BATCH-START virgin map.  Virgin maps only ever
+        shrink, so a lane with no overlap now cannot become novel
+        later in the batch: the gate is exact, not heuristic.
+
+        phase 2 (sequential, candidates only): the ordinary
+        has_new_bits fold, preserving single-exec-loop parity for
+        in-batch duplicate novelty.  Steady state has ~no candidates,
+        so the per-exec cost drops to one memory-bandwidth scan
+        (measured on this host: ~256µs/exec full classify -> ~9µs,
+        docs/HOST_TIER.md).
+        """
+        n = len(bitmaps) if bitmaps is not None else 0
+        new_paths = np.zeros(n, dtype=np.int32)
+        uc = np.zeros(n, dtype=bool)
+        uh = np.zeros(n, dtype=bool)
+        if n == 0:
+            return new_paths, uc, uh
+        # word-skip gate: lanes whose nonzero words overlap virgin
+        words = np.ascontiguousarray(bitmaps).reshape(n, -1, 8)
+        nzl, nzw = np.nonzero(words.view(np.uint64)[..., 0])
+        if len(nzl):
+            wb = words[nzl, nzw]                        # [K, 8] bytes
+            cls = COUNT_CLASS_LOOKUP[wb]
+            virg = self.virgin_bits.reshape(-1, 8)[nzw]  # [K, 8]
+            hit_lanes = nzl[(cls & virg).any(axis=1)]
+            cand = np.zeros(n, dtype=bool)
+            cand[hit_lanes] = True
+        else:
+            cand = np.zeros(n, dtype=bool)
+
+        for i in np.flatnonzero(cand):
+            cls = _np_classify(bitmaps[i])
+            new_paths[i], self.virgin_bits = _np_has_new_bits(
+                self.virgin_bits, cls)
+        for i in np.flatnonzero((verdicts == FUZZ_CRASH)
+                                | (verdicts == FUZZ_HANG)):
+            simp = np.where(bitmaps[i] == 0, 1, 128).astype(np.uint8)
+            if verdicts[i] == FUZZ_CRASH:
+                r, self.virgin_crash = _np_has_new_bits(
+                    self.virgin_crash, simp)
+                uc[i] = r > 0
+            else:
+                r, self.virgin_tmout = _np_has_new_bits(
+                    self.virgin_tmout, simp)
+                uh[i] = r > 0
+        return new_paths, uc, uh
 
     # -- state / merge (reference afl_get_state/afl_set_state/merge) ---
 

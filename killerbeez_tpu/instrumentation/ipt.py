@@ -26,6 +26,8 @@ AFL-style bucketed novelty instead.
 from __future__ import annotations
 
 import json
+import os
+import shlex
 from functools import partial
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -33,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING
+from .. import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_RUNNING
 from ..models import targets as targets_mod
 from ..models.vm import _run_batch_impl
 from ..ops.hashing import murmur3_32
@@ -142,7 +144,6 @@ class IptInstrumentation(Instrumentation):
                 build_native()
                 qemu = kb_trace_path()
                 self.options["qemu_path"] = qemu
-            import os
             if not os.path.exists(qemu):
                 raise ValueError(
                     f"qemu_mode: tracer binary {qemu!r} not found "
@@ -179,7 +180,6 @@ class IptInstrumentation(Instrumentation):
 
     def _ensure_host_target(self, cmd_line: str, use_stdin: bool,
                             input_file: Optional[str]):
-        import shlex
         from ..native.exec_backend import ExecTarget
         key = (cmd_line, use_stdin, input_file)
         if self._host_target is not None and \
@@ -262,7 +262,6 @@ class IptInstrumentation(Instrumentation):
 
     def _run_batch_host(self, inputs, lengths,
                         pad_to: Optional[int] = None) -> BatchResult:
-        from .. import FUZZ_ERROR
         from ..native.exec_backend import classify_batch
         if self._host_target is None:
             raise RuntimeError(

@@ -341,25 +341,45 @@ class ExecPool:
     with distinct SHM names (dynamorio_instrumentation.c:418-431 picks
     a random fuzzer_id per instance); here one fuzzer process shards
     each batch across N ``ExecTarget`` instances — each with its own
-    forkserver, IPC_PRIVATE SHM segment and temp stdin file — on a
+    forkserver, IPC_PRIVATE SHM segment and private input file — on a
     thread pool.  ctypes releases the GIL for the duration of
     ``kb_target_run_batch``, so the C exec loops genuinely overlap.
 
-    Only stdin-style delivery is poolable (every worker owns a private
-    input file); file-mode targets share the driver's ``@@`` path and
-    must stay single-instance.
+    File-mode delivery (``input_file`` set): each worker derives a
+    private ``<input_file>.wN`` path and gets the argv with the
+    driver's ``@@`` substitution re-pointed at it, matching the
+    reference's per-instance input files
+    (dynamorio_instrumentation.c:418-431).  Stdin mode mints a temp
+    file per worker as before.
 
     The single-exec surface (``run``/``trace_bits``/...) delegates to
     worker 0, so an ExecPool drops into ExecTarget call sites.
     """
 
     def __init__(self, argv: Sequence[str], n_workers: int, **kwargs):
-        if kwargs.get("input_file"):
-            raise ValueError("ExecPool requires per-worker private "
-                             "input files (stdin mode)")
         from concurrent.futures import ThreadPoolExecutor
-        self.targets = [ExecTarget(argv, **kwargs)
-                        for _ in range(max(n_workers, 1))]
+        input_file = kwargs.pop("input_file", None)
+        self._derived_files: list = []
+        if input_file:
+            if not any(input_file in a for a in argv):
+                raise ValueError(
+                    "ExecPool file mode: argv does not reference the "
+                    f"input file {input_file!r} (@@ substitution "
+                    "happens in the driver)")
+            self.targets = []
+            root, ext = os.path.splitext(input_file)
+            for i in range(max(n_workers, 1)):
+                # suffix BEFORE the extension: format-sniffing targets
+                # that validate the input path's extension keep seeing
+                # it (in.png -> in.w0.png, not in.png.w0)
+                f_i = f"{root}.w{i}{ext}"
+                argv_i = [a.replace(input_file, f_i) for a in argv]
+                self.targets.append(
+                    ExecTarget(argv_i, input_file=f_i, **kwargs))
+                self._derived_files.append(f_i)
+        else:
+            self.targets = [ExecTarget(argv, **kwargs)
+                            for _ in range(max(n_workers, 1))]
         self._tp = ThreadPoolExecutor(max_workers=len(self.targets))
         self.coverage = self.targets[0].coverage
         self.timeout = self.targets[0].timeout
@@ -424,6 +444,12 @@ class ExecPool:
         self._tp.shutdown(wait=True)
         for t in self.targets:
             t.close()
+        for f in self._derived_files:
+            try:
+                os.unlink(f)
+            except OSError:
+                pass
+        self._derived_files = []
 
     def __enter__(self) -> "ExecPool":
         return self

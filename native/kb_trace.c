@@ -74,6 +74,7 @@
 #include <sys/personality.h>
 #include <sys/ptrace.h>
 #include <sys/shm.h>
+#include <sys/time.h>
 #include <sys/types.h>
 #include <sys/uio.h>
 #include <sys/user.h>
@@ -481,14 +482,31 @@ static void kb_guard_alarm(int sig) {
  * window, so it must finish before the FUZZER's per-exec timeout or
  * the exec is misreported as a hang (and a long enough overrun tears
  * the forkserver down).  The fuzzer passes its budget via
- * KB_TRACE_BUDGET (seconds); default/cap 10s, floor 1s (alarm
- * granularity). */
-static unsigned kb_rerun_budget(void) {
+ * KB_TRACE_BUDGET (seconds, fractional); default/cap 10s.  Armed via
+ * setitimer, not alarm(), so sub-second fuzzer timeouts are
+ * honored. */
+static double kb_rerun_budget(void) {
   const char *e = getenv("KB_TRACE_BUDGET");
   double d = e ? atof(e) : 0;
   if (d <= 0 || d > 10) d = 10;
-  if (d < 1) d = 1;
-  return (unsigned)d;
+  if (d < 0.05) d = 0.05;
+  return d;
+}
+
+static void kb_guard_arm(double secs) {
+  struct itimerval it;
+  memset(&it, 0, sizeof it);
+  it.it_value.tv_sec = (time_t)secs;
+  it.it_value.tv_usec = (suseconds_t)((secs - (double)(time_t)secs) * 1e6);
+  if (it.it_value.tv_sec == 0 && it.it_value.tv_usec < 1000)
+    it.it_value.tv_usec = 1000;
+  setitimer(ITIMER_REAL, &it, NULL);
+}
+
+static void kb_guard_disarm(void) {
+  struct itimerval z;
+  memset(&z, 0, sizeof z);
+  setitimer(ITIMER_REAL, &z, NULL);
 }
 
 /* ---- fork-template (x86_64): the reference's QEMU tier starts its
@@ -1304,9 +1322,9 @@ int main(int argc, char **argv) {
                 kb_dbg_reruns++;
                 kb_guard_pid = r;
                 kb_guard_fired = 0;
-                alarm(kb_rerun_budget());
+                kb_guard_arm(kb_rerun_budget());
                 kb_trace_child(r, argv[1]);
-                alarm(0);
+                kb_guard_disarm();
                 kb_guard_pid = 0;
                 /* guard-killed re-run: the map holds a valid PREFIX
                  * of the full trace (real block-step slots, just

@@ -168,3 +168,53 @@ def test_qemu_mode_defaults_to_bundled_tracer():
     with pytest.raises(ValueError, match="qemu"):
         instrumentation_factory("afl", json.dumps(
             {"qemu_mode": 1, "qemu_path": "/nonexistent"}))
+
+
+def test_word_skip_triage_matches_per_lane_loop():
+    """The word-skip batch triage (afl.py _np_triage_batch) must be
+    bit-identical to the per-lane classify + has_new_bits fold it
+    replaced — new-path returns, crash/hang uniqueness, and all
+    three virgin maps, across densities and in-batch duplicates."""
+    from killerbeez_tpu import FUZZ_CRASH, FUZZ_HANG, MAP_SIZE
+    from killerbeez_tpu.instrumentation.afl import (
+        _np_classify, _np_has_new_bits,
+    )
+
+    def ref_triage(instr, bitmaps, verdicts):
+        n = len(bitmaps)
+        np_, uc, uh = (np.zeros(n, np.int32), np.zeros(n, bool),
+                       np.zeros(n, bool))
+        for i in range(n):
+            cls = _np_classify(bitmaps[i])
+            np_[i], instr.virgin_bits = _np_has_new_bits(
+                instr.virgin_bits, cls)
+            simp = np.where(bitmaps[i] == 0, 1, 128).astype(np.uint8)
+            if verdicts[i] == FUZZ_CRASH:
+                r, instr.virgin_crash = _np_has_new_bits(
+                    instr.virgin_crash, simp)
+                uc[i] = r > 0
+            elif verdicts[i] == FUZZ_HANG:
+                r, instr.virgin_tmout = _np_has_new_bits(
+                    instr.virgin_tmout, simp)
+                uh[i] = r > 0
+        return np_, uc, uh
+
+    rng = np.random.default_rng(7)
+    a = instrumentation_factory("afl", None)
+    b = instrumentation_factory("afl", None)
+    for trial in range(4):
+        n = 40
+        maps = np.zeros((n, MAP_SIZE), np.uint8)
+        idx = rng.integers(0, MAP_SIZE,
+                           (6, int(MAP_SIZE * rng.uniform(5e-4, 8e-3))))
+        for i in range(n):  # duplicates within the batch on purpose
+            maps[i, idx[i % 6]] = rng.integers(1, 255)
+        verd = rng.choice([0, FUZZ_CRASH, FUZZ_HANG], n,
+                          p=[0.7, 0.15, 0.15]).astype(np.int32)
+        ra = a._np_triage_batch(maps, verd)
+        rb = ref_triage(b, maps, verd)
+        for x, y in zip(ra, rb):
+            np.testing.assert_array_equal(x, y, err_msg=f"trial {trial}")
+    np.testing.assert_array_equal(a.virgin_bits, b.virgin_bits)
+    np.testing.assert_array_equal(a.virgin_crash, b.virgin_crash)
+    np.testing.assert_array_equal(a.virgin_tmout, b.virgin_tmout)

@@ -302,3 +302,31 @@ def test_qemu_mode_rejects_missing_tracer():
     with pytest.raises(ValueError, match="qemu_mode"):
         instrumentation_factory("afl", json.dumps(
             {"qemu_mode": 1, "qemu_path": "/nonexistent/qemu"}))
+
+
+def test_afl_workers_file_delivery(corpus_bin):
+    """workers>1 with file (@@) delivery: each pool worker derives a
+    private input file, so file-mode targets scale like stdin ones
+    (reference per-instance input files,
+    dynamorio_instrumentation.c:418-431)."""
+    import tempfile
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    from killerbeez_tpu.native.exec_backend import ExecPool
+    instr = instrumentation_factory("afl", json.dumps({"workers": 3}))
+    tf = tempfile.mktemp(prefix="kbz_in_")
+    try:
+        instr.prepare_host(f'{corpus_bin("test")} {tf}',
+                           use_stdin=False, input_file=tf)
+        assert isinstance(instr._target, ExecPool)
+        assert instr._target.n_workers == 3
+        inputs = np.zeros((6, 4), dtype=np.uint8)
+        for i, s in enumerate([b"ABCD", b"zzzz", b"ABC@", b"yyyy",
+                               b"ABCD", b"ABCz"]):
+            inputs[i, :len(s)] = np.frombuffer(s, dtype=np.uint8)
+        res = instr.run_batch(inputs, np.full(6, 4, dtype=np.int32))
+        assert (res.statuses == 2).sum() == 2      # both ABCD lanes
+        assert instr.total_execs == 6
+    finally:
+        instr.cleanup()

@@ -162,6 +162,36 @@ class Driver:
             self.last_input = None
         return BatchOutcome(result=result, inputs=bufs, lengths=lens)
 
+    def supports_fused_multi(self) -> bool:
+        """True when test_batch_fused_multi can run: fused device path
+        with a multi-step instrumentation (the CLI's K-step
+        device-side accumulation)."""
+        instr = self.instrumentation
+        wants = getattr(instr, "wants_fused", None)
+        return (self.supports_batch and instr.device_backed
+                and getattr(self, "batch_quantum", 1) == 1
+                and hasattr(instr, "run_batch_fused_multi")
+                # edges mode records per-batch count tensors, which
+                # the multi path does not maintain
+                and not getattr(instr, "options", {}).get("edges")
+                and wants is not None and wants(self.mutator))
+
+    def test_batch_fused_multi(self, n: int, k: int):
+        """K consecutive fused batches of ``n`` in one device
+        dispatch; candidate/verdict streams are bit-identical to k
+        test_batch(n) calls.  Returns the stacked lazy device arrays
+        (packed[k, B], bufs[k, B, L], lens[k, B], stacked compact) —
+        the Fuzzer loop owns slicing them into per-step triage."""
+        its = self.mutator.peek_iterations(n)
+        packed, bufs, lens, compact = \
+            self.instrumentation.run_batch_fused_multi(
+                self.mutator, its, k, pad_to=n)
+        self.mutator.advance(k * n)
+        if n > 0:
+            self._last_batch_tail = (bufs[k - 1], lens[k - 1], n - 1)
+            self.last_input = None
+        return packed, bufs, lens, compact
+
     def cleanup(self) -> None:
         pass
 

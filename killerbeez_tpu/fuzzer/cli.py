@@ -67,6 +67,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "details next to the repro (host targets)")
     p.add_argument("-b", "--batch-size", type=int, default=1024,
                    help="candidates per device step (batched backends)")
+    p.add_argument("-K", "--accumulate", type=int, default=0,
+                   help="fused device path: accumulate K batches "
+                        "per device dispatch so the host pulls one "
+                        "transfer set per K batches (0 = auto, "
+                        "1 = per-batch; tunnel-RTT resilience)")
     p.add_argument("--mesh",
                    help='multi-chip campaign over a "dp,mp" device '
                         "mesh (e.g. --mesh 4,2): candidates shard "
@@ -140,7 +145,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         fuzzer = Fuzzer(driver, output_dir=args.output,
                         batch_size=args.batch_size,
                         debug_triage=args.debug_triage,
-                        feedback=args.feedback)
+                        feedback=args.feedback,
+                        accumulate=args.accumulate)
         stats = fuzzer.run(args.iterations)
         INFO_MSG(
             "results: %d crashes (%d unique), %d hangs (%d unique), "

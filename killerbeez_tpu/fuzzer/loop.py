@@ -26,6 +26,42 @@ from ..utils.logging import CRITICAL_MSG, DEBUG_MSG, INFO_MSG, WARNING_MSG
 FINDING_DIRS = {FUZZ_CRASH: "crashes", FUZZ_HANG: "hangs"}
 
 
+class _StackedRows:
+    """One stacked [k, ...] device array whose host copy is pulled
+    ONCE (async-prefetched), shared by k per-step triage views — the
+    transfer-count divider behind the K-step superbatch path."""
+
+    def __init__(self, dev):
+        self.dev = dev
+        self._np = None
+        fn = getattr(dev, "copy_to_host_async", None)
+        if fn is not None:
+            fn()
+
+    def materialize(self) -> np.ndarray:
+        if self._np is None:
+            self._np = np.asarray(self.dev)
+            self.dev = None
+        return self._np
+
+    def row(self, i: int) -> "_LazyRow":
+        return _LazyRow(self, i)
+
+
+class _LazyRow:
+    """numpy-coercible view of one row of a _StackedRows holder."""
+
+    def __init__(self, holder: _StackedRows, i: int):
+        self._holder = holder
+        self._i = i
+
+    def __array__(self, dtype=None, copy=None):
+        # np.asarray: scalar rows (e.g. per-step counts) must come
+        # back as 0-d ARRAYS, not numpy scalars
+        r = np.asarray(self._holder.materialize()[self._i])
+        return r.astype(dtype) if dtype is not None else r
+
+
 @dataclass
 class FuzzStats:
     iterations: int = 0
@@ -48,14 +84,22 @@ class Fuzzer:
     #: most recent new-path findings (older ones stay on disk)
     CORPUS_CAP = 256
 
+    #: K-step device-side accumulation default for the fused path
+    #: (overridable via accumulate=; 1 disables)
+    ACCUMULATE_AUTO = 8
+
     def __init__(self, driver: Driver, output_dir: str = "output",
                  batch_size: int = 1024, write_findings: bool = True,
-                 debug_triage: bool = False, feedback: int = 0):
+                 debug_triage: bool = False, feedback: int = 0,
+                 accumulate: int = 0):
         self.driver = driver
         self.output_dir = output_dir
         self.batch_size = int(batch_size)
         self.write_findings = write_findings
         self.debug_triage = debug_triage
+        #: fused superbatch depth: 0 = auto (ACCUMULATE_AUTO when the
+        #: driver supports the fused-multi path), 1 = per-batch
+        self.accumulate = int(accumulate)
         #: every `feedback` batches, rotate the mutator seed through
         #: new-path findings (coverage-guided corpus loop; 0 = off)
         self.feedback = int(feedback)
@@ -411,6 +455,47 @@ class Fuzzer:
                     elif self._active > best:
                         self._active -= 1
 
+    def _resolve_accumulate(self) -> int:
+        """Effective superbatch depth K.  Auto engages only on the
+        fused device path; corpus feedback requires the rotation
+        cadence to land on superbatch boundaries (K divides
+        ``feedback``), else K degrades to the largest divisor."""
+        k = self.accumulate if self.accumulate > 0 \
+            else self.ACCUMULATE_AUTO
+        if k <= 1:
+            return 1
+        try:
+            if not self.driver.supports_fused_multi():
+                return 1
+        except AttributeError:
+            return 1
+        if self.feedback:
+            while k > 1 and self.feedback % k:
+                k -= 1
+        return k
+
+    def _run_superbatch(self, k: int, pending, depth) -> None:
+        """Execute K fused batches in one device dispatch and enqueue
+        K per-step triage entries over shared stacked host pulls."""
+        from ..instrumentation.base import CompactReport
+        from ..drivers.base import BatchOutcome
+        b = self.batch_size
+        packed, bufs, lens, compact = \
+            self.driver.test_batch_fused_multi(b, k)
+        ph = _StackedRows(packed)
+        idxh, sbh, slh, cnth = (_StackedRows(a) for a in compact)
+        for j in range(k):
+            self.stats.iterations += b
+            self._fb_batches += 1
+            out = BatchOutcome(
+                result=None, inputs=bufs[j], lengths=lens[j],
+                compact=CompactReport(idx=idxh.row(j), bufs=sbh.row(j),
+                                      lens=slh.row(j),
+                                      count=cnth.row(j)))
+            pending.append((out, b, self.stats.iterations, ph.row(j)))
+            if len(pending) >= depth:
+                self._triage_batch(*pending.popleft())
+
     def _run_batched(self, n_iterations: int) -> None:
         from collections import deque
         mut = self.driver.mutator
@@ -424,6 +509,7 @@ class Fuzzer:
         # corpus is always stale/empty at rotation time
         depth = min(self.PIPELINE_DEPTH, self.feedback) \
             if self.feedback else self.PIPELINE_DEPTH
+        accumulate = self._resolve_accumulate()
         if self.feedback and self._base_seed is None and \
                 getattr(mut, "seed_bytes", None):
             # the baseline seed anchors the rotation: every other
@@ -450,6 +536,16 @@ class Fuzzer:
                     self._credit_period()
                     if self._corpus:
                         self._rotate_seed(mut)
+                if (accumulate > 1
+                        and self._remaining(n_iterations)
+                        >= accumulate * self.batch_size
+                        and mut.remaining()
+                        >= accumulate * self.batch_size):
+                    # K-step device-side accumulation: one transfer
+                    # set per K batches (rotation cadence alignment
+                    # guaranteed by _resolve_accumulate)
+                    self._run_superbatch(accumulate, pending, depth)
+                    continue
                 self._fb_batches += 1
                 # a smaller tail batch would change tensor shapes and
                 # force a full XLA recompile; the driver pads to

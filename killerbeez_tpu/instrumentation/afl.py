@@ -36,6 +36,7 @@ from ..ops.coverage import (
     COUNT_CLASS_LOOKUP, classify_counts, count_non_255_bytes,
     merge_virgin, simplify_trace,
 )
+from ..utils.logging import WARNING_MSG
 from ..utils.serialization import decode_array, encode_array
 from .base import BatchResult, Instrumentation, module_slice_edges
 from .factory import register_instrumentation
@@ -207,13 +208,22 @@ class AflInstrumentation(Instrumentation):
             kwargs["extra_env"] = extra_env
         workers = int(self.options["workers"])
         argv = self._build_argv(cmd_line)
-        if workers > 1:
-            # stdin workers mint private temp files; file-delivery
-            # workers derive private @@ paths from the driver's
-            # (reference per-instance scaling,
-            # dynamorio_instrumentation.c:418-431)
+        # stdin workers mint private temp files; file-delivery workers
+        # derive private @@ paths from the driver's (reference
+        # per-instance scaling, dynamorio_instrumentation.c:418-431).
+        # A file path the argv doesn't carry as an exact token (no @@,
+        # or embedded in a larger argument) can't be re-pointed per
+        # worker — those targets keep the old single-instance behavior.
+        poolable = (input_file is None and use_stdin) or \
+            (input_file is not None and input_file in argv)
+        if workers > 1 and poolable:
             self._target = ExecPool(argv, workers, **kwargs)
         else:
+            if workers > 1:
+                WARNING_MSG(
+                    "afl: workers=%d requested but the input file is "
+                    "not an exact argv token (no @@, or embedded in a "
+                    "larger argument) — running 1 instance", workers)
             self._target = ExecTarget(argv, **kwargs)
         self._target_key = key
         return self._target

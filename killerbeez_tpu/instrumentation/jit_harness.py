@@ -168,6 +168,64 @@ def _fused_fuzz_step(instrs, edge_table, u_slots, seg_id, seed_buf,
             (sel_idx.astype(jnp.int32), sel_bufs, sel_lens, count))
 
 
+@partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
+                                   "exact", "stack_pow2", "k",
+                                   "phase1_steps", "dots"))
+def _fused_fuzz_multi(instrs, edge_table, u_slots, seg_id, seed_buf,
+                      seed_len, base_key, its0, n_real, vb, vc, vh,
+                      mem_size, max_steps, n_edges, exact, stack_pow2,
+                      k, phase1_steps=0, dots=("f32", "f32")):
+    """K fused fuzz steps in ONE XLA program (lax.scan), virgin maps
+    threaded through the carry, verdicts bit-packed on device.
+
+    The per-step transfer pattern of the single-step path (packed
+    verdict byte + 4 compact arrays per batch) is what makes the CLI
+    number hostage to tunnel RTT spikes (docs/PERF.md "Current
+    ceiling"): accumulating K steps device-side divides the number of
+    device->host transfer events by K — the host reads one [k, B]
+    packed array and one stacked compact report per superbatch.
+    Candidate streams are bit-identical to K sequential steps: step j
+    executes iterations ``its0 + j*n_real`` (monotonic mutator
+    consumption), padding lanes duplicate lane 0 exactly like the
+    single-step path."""
+    from ..ops.vm_kernel import (
+        fuzz_batch_pallas_2phase, havoc_words_for_keys,
+    )
+    b = its0.shape[0]
+    cap = min(COMPACT_CAP, b)
+
+    def body(carry, step):
+        vb, vc, vh = carry
+        its = its0 + step * jnp.uint32(n_real)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(its)
+        words = havoc_words_for_keys(keys, stack_pow2)
+        res, bufs, lens = fuzz_batch_pallas_2phase(
+            instrs, edge_table, seed_buf, seed_len, words, mem_size,
+            max_steps, n_edges, stack_pow2=stack_pow2,
+            phase1_steps=phase1_steps, dots=dots)
+        statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
+                             res.status)
+        new_paths, uc, uh, vb2, vc2, vh2 = _triage_counts(
+            res.counts, statuses, u_slots, seg_id, vb, vc, vh, exact)
+        packed = (statuses.astype(jnp.uint8)
+                  | (new_paths.astype(jnp.uint8) << 3)
+                  | (uc.astype(jnp.uint8) << 5)
+                  | (uh.astype(jnp.uint8) << 6))
+        flags = ((statuses != FUZZ_NONE) | (new_paths > 0)) & \
+            (jnp.arange(b) < n_real)
+        (sel_idx,) = jnp.nonzero(flags, size=cap, fill_value=0)
+        sel_bufs = jnp.take(bufs, sel_idx, axis=0)
+        sel_lens = jnp.take(lens, sel_idx)
+        count = jnp.sum(flags).astype(jnp.int32)
+        return (vb2, vc2, vh2), (packed, bufs, lens,
+                                 sel_idx.astype(jnp.int32), sel_bufs,
+                                 sel_lens, count)
+
+    (vb, vc, vh), outs = jax.lax.scan(
+        body, (vb, vc, vh), jnp.arange(k, dtype=jnp.uint32))
+    return (vb, vc, vh) + tuple(outs)
+
+
 @register_instrumentation
 class JitHarnessInstrumentation(Instrumentation):
     """Executes KBVM targets fully on-device with AFL-map triage."""
@@ -361,6 +419,36 @@ class JitHarnessInstrumentation(Instrumentation):
             statuses=statuses, new_paths=new_paths, unique_crashes=uc,
             unique_hangs=uh, exit_codes=exit_codes), bufs, lens,
             CompactReport(*compact))
+
+    def run_batch_fused_multi(self, mutator, its, k: int,
+                              pad_to: Optional[int] = None):
+        """K fused steps in one dispatch (see _fused_fuzz_multi).
+        Returns (packed uint8[k, B], bufs uint8[k, B, L],
+        lens int32[k, B], (idx, bufs, lens, count) stacked compact) —
+        all LAZY device arrays; step j of the superbatch executed
+        iterations ``its + j*len(its)``.  Callers advance the mutator
+        by k*len(its)."""
+        from ..ops.vm_kernel import LANE_TILE
+        n = len(its)
+        b = max(n, pad_to or 0)
+        b += (-b) % LANE_TILE
+        self._apply_exact_gate(b)
+        seed_buf, seed_len, base_key, stack_pow2 = mutator.fused_spec()
+        its = np.asarray(its, dtype=np.uint32)
+        if b > n:  # duplicate lane 0's iteration: coverage no-ops
+            its = np.concatenate([its, np.repeat(its[:1], b - n)])
+        (vb, vc, vh, packed, bufs, lens, sel_idx, sel_bufs, sel_lens,
+         counts) = _fused_fuzz_multi(
+            self._instrs, self._edge_table, self._u_slots, self._seg_id,
+            jnp.asarray(seed_buf), jnp.int32(seed_len), base_key,
+            jnp.asarray(its), jnp.int32(n),
+            self.virgin_bits, self.virgin_crash, self.virgin_tmout,
+            self.program.mem_size, self.program.max_steps,
+            self.program.n_edges, self.exact, stack_pow2,
+            int(k), self.phase1_steps, self._dots)
+        self.virgin_bits, self.virgin_crash, self.virgin_tmout = vb, vc, vh
+        self.total_execs += int(k) * n
+        return packed, bufs, lens, (sel_idx, sel_bufs, sel_lens, counts)
 
     # -- single-exec shim ----------------------------------------------
 

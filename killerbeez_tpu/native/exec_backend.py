@@ -361,19 +361,23 @@ class ExecPool:
         input_file = kwargs.pop("input_file", None)
         self._derived_files: list = []
         if input_file:
-            if not any(input_file in a for a in argv):
+            if not any(a == input_file for a in argv):
                 raise ValueError(
-                    "ExecPool file mode: argv does not reference the "
-                    f"input file {input_file!r} (@@ substitution "
-                    "happens in the driver)")
+                    "ExecPool file mode needs the input file as an "
+                    f"EXACT argv token; {input_file!r} is absent or "
+                    "embedded in a larger argument (callers degrade "
+                    "such targets to a single instance)")
             self.targets = []
             root, ext = os.path.splitext(input_file)
             for i in range(max(n_workers, 1)):
                 # suffix BEFORE the extension: format-sniffing targets
                 # that validate the input path's extension keep seeing
-                # it (in.png -> in.w0.png, not in.png.w0)
+                # it (in.png -> in.w0.png, not in.png.w0).  Only
+                # exact-match tokens are re-pointed — a substring
+                # replace would corrupt companion arguments like
+                # --dict=<input>.dict that nobody stages per worker.
                 f_i = f"{root}.w{i}{ext}"
-                argv_i = [a.replace(input_file, f_i) for a in argv]
+                argv_i = [f_i if a == input_file else a for a in argv]
                 self.targets.append(
                     ExecTarget(argv_i, input_file=f_i, **kwargs))
                 self._derived_files.append(f_i)

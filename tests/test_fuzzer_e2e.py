@@ -560,3 +560,40 @@ def test_mutator_sweep_runs_clean(mutator, driver, tmp_path, capfd):
     bad = [ln for ln in err.splitlines()
            if " - WARNING - " in ln or " - ERROR - " in ln]
     assert not bad, bad
+
+
+def test_superbatch_matches_per_batch(tmp_path, monkeypatch):
+    """K-step device-side accumulation (Fuzzer accumulate=K,
+    jit_harness._fused_fuzz_multi): candidate/verdict streams and
+    on-disk findings must be IDENTICAL to K sequential fused
+    batches — same mutator iterations, same PRNG keys, same triage
+    fold through the virgin maps."""
+    import shutil
+    from killerbeez_tpu.models import targets_cgc
+    _interpret_pallas(monkeypatch)
+    import killerbeez_tpu.instrumentation.jit_harness as jh
+    jh._fused_fuzz_multi.clear_cache()
+    seed = targets_cgc.tlvstack_vm_seed()
+
+    def run(K, out):
+        instr = instrumentation_factory("jit_harness", json.dumps(
+            {"target": "tlvstack_vm", "engine": "pallas_fused",
+             "novelty": "throughput"}))
+        mut = mutator_factory("havoc", '{"seed": 3}', seed)
+        drv = driver_factory("file", None, instr, mut)
+        fz = Fuzzer(drv, output_dir=str(out), batch_size=512,
+                    accumulate=K)
+        stats = fz.run(512 * 4)
+        return stats, sorted(os.listdir(out / "new_paths")), \
+            sorted(os.listdir(out / "crashes"))
+
+    try:
+        s1, np1, cr1 = run(1, tmp_path / "k1")
+        s2, np2, cr2 = run(2, tmp_path / "k2")
+    finally:
+        jh._fused_fuzz_multi.clear_cache()
+    assert np1 == np2 and cr1 == cr2
+    assert (s1.iterations, s1.new_paths, s1.crashes,
+            s1.unique_crashes) == \
+           (s2.iterations, s2.new_paths, s2.crashes, s2.unique_crashes)
+    assert s1.iterations == 2048

@@ -57,10 +57,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-msd", "--mutator-state-dump",
                    help="dump mutator state to file on exit")
     p.add_argument("-l", "--logging-options", help="logging JSON options")
-    p.add_argument("-fb", "--feedback", type=int, default=0,
+    p.add_argument("-fb", "--feedback", type=int, default=-1,
                    help="coverage-guided corpus loop: every N "
                         "batches, rotate the seed through new-path "
-                        "findings (0 = off)")
+                        "findings (default: ON for randomized "
+                        "mutators, every 8 batches; 0 = off)")
     p.add_argument("-dt", "--debug-triage", action="store_true",
                    help="re-run each unique crash once under the "
                         "ptrace debug tier and save signal-level "

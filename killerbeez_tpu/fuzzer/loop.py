@@ -88,15 +88,35 @@ class Fuzzer:
     #: (overridable via accumulate=; 1 disables)
     ACCUMULATE_AUTO = 8
 
+    #: default corpus-feedback cadence (batches between rotations)
+    #: when feedback < 0: coverage-guided seeding is ON by default for
+    #: RANDOMIZED mutators (get_total_iteration_count() == -1) —
+    #: fb_gate.py measures it >= single-seed havoc on every CGC
+    #: target.  Deterministic walks (bit_flip, arithmetic, ...) keep
+    #: feedback off under auto: rotating the seed mid-walk would
+    #: change the reference's deterministic iteration contract (an
+    #: explicit -fb N still applies to them).  8 matches the
+    #: superbatch depth (K stays 8) and, because finds are credited
+    #: to the GENERATING arm, rotation reads the corpus without
+    #: draining the pipeline — no throughput cost.  Rotation only
+    #: engages once edge-novel findings exist, so short runs and
+    #: finding-free targets behave exactly as with feedback off.
+    FEEDBACK_AUTO = 8
+
     def __init__(self, driver: Driver, output_dir: str = "output",
                  batch_size: int = 1024, write_findings: bool = True,
-                 debug_triage: bool = False, feedback: int = 0,
+                 debug_triage: bool = False, feedback: int = -1,
                  accumulate: int = 0):
         self.driver = driver
         self.output_dir = output_dir
         self.batch_size = int(batch_size)
         self.write_findings = write_findings
         self.debug_triage = debug_triage
+        if feedback < 0:
+            mut = getattr(driver, "mutator", None)
+            randomized = (mut is not None
+                          and mut.get_total_iteration_count() < 0)
+            feedback = self.FEEDBACK_AUTO if randomized else 0
         #: fused superbatch depth: 0 = auto (ACCUMULATE_AUTO when the
         #: driver supports the fused-multi path), 1 = per-batch
         self.accumulate = int(accumulate)
@@ -109,6 +129,12 @@ class Fuzzer:
         self._corpus: list = []
         self._base_stats = [0, 0]       # [selections, finds]
         self._active: Optional[int] = None  # corpus index or None=base
+        # the arm whose candidates the batch being TRIAGED came from:
+        # with a deep pipeline, triage lags generation, so finds must
+        # credit the GENERATING arm (entry object, robust to corpus
+        # index shifts), not whichever arm is active at triage time
+        self._credit_arm: Optional[list] = None
+        self._active_entry: Optional[list] = None
         self._base_seed = None
         self._rotations = 0
         self._fb_batches = 0
@@ -212,16 +238,18 @@ class Fuzzer:
                 self._corpus.append([buf, 0, 0])
                 if len(self._corpus) > self.CORPUS_CAP:
                     self._corpus.pop(0)
-                    # keep the active-arm credit pointer aligned
+                    # keep the active-arm selection pointer aligned
                     if self._active is not None:
                         self._active = (None if self._active == 0
                                         else self._active - 1)
-                # credit the arm whose batches are being triaged:
-                # its lineage just found a brand-new edge
-                if self._active is None:
+                # credit the arm whose candidates PRODUCED this find
+                # (set per triaged batch; a capped-out arm's entry may
+                # already be off the corpus list — the credit is then
+                # a harmless write to a dead object)
+                if self._credit_arm is None:
                     self._base_stats[1] += 1
                 else:
-                    self._corpus[self._active][2] += 1
+                    self._credit_arm[2] += 1
 
     # -- loops ----------------------------------------------------------
 
@@ -263,13 +291,15 @@ class Fuzzer:
         return rows
 
     def _triage_batch(self, out, room: int, done_through: int,
-                      packed=None) -> None:
+                      packed=None, arm: Optional[list] = None
+                      ) -> None:
         """``done_through`` is the global iteration count as of THIS
         batch — with pipelining, stats.iterations runs ahead of the
         batch being triaged, so logs must not read it.  ``packed`` is
         the device-side verdict byte built by _prefetch; when set,
         the big per-lane arrays never cross to the host unless this
         batch actually has interesting lanes."""
+        self._credit_arm = arm
         res = out.result
         if packed is not None:
             pk = np.asarray(packed)          # prefetched: cache hit
@@ -367,7 +397,7 @@ class Fuzzer:
     def _credit_period(self) -> None:
         """Close one feedback period: decay every arm's stats and
         charge the period to the arm that was active during it."""
-        g = self.FEEDBACK_DECAY
+        g = self.FEEDBACK_DECAY ** min(self.feedback or 1, 16)
         self._base_stats[0] *= g
         self._base_stats[1] *= g
         for e in self._corpus:
@@ -442,6 +472,8 @@ class Fuzzer:
                 # already executed
                 mut.iteration = it
                 self._active = best
+                self._active_entry = (None if best is None
+                                      else self._corpus[best])
                 DEBUG_MSG("feedback: arm %s (score %.2f), %d-byte "
                           "input", best, best_score, len(cand))
                 return
@@ -492,9 +524,28 @@ class Fuzzer:
                 compact=CompactReport(idx=idxh.row(j), bufs=sbh.row(j),
                                       lens=slh.row(j),
                                       count=cnth.row(j)))
-            pending.append((out, b, self.stats.iterations, ph.row(j)))
+            pending.append((out, b, self.stats.iterations, ph.row(j),
+                            self._active_entry))
             if len(pending) >= depth:
                 self._triage_batch(*pending.popleft())
+
+    def _drain_ready(self, pending) -> None:
+        """Triage every leading pending batch whose device results are
+        already computed (non-blocking is_ready probe): keeps the
+        corpus fresh at rotation boundaries without stalling the
+        pipeline on a transfer that hasn't landed."""
+        while pending:
+            packed = pending[0][3]
+            holder = getattr(packed, "_holder", None)
+            arr = packed if holder is None else holder.dev
+            probe = getattr(arr, "is_ready", None)
+            if probe is not None:
+                try:
+                    if not probe():
+                        return
+                except Exception:
+                    pass
+            self._triage_batch(*pending.popleft())
 
     def _run_batched(self, n_iterations: int) -> None:
         from collections import deque
@@ -504,11 +555,10 @@ class Fuzzer:
         # smaller than the quantum is skipped with a warning instead
         # of dying mid-run
         quantum = getattr(self.driver, "batch_quantum", 1)
-        # corpus feedback rotates on TRIAGED findings: the pipeline
-        # may not run further ahead than the rotation cadence or the
-        # corpus is always stale/empty at rotation time
-        depth = min(self.PIPELINE_DEPTH, self.feedback) \
-            if self.feedback else self.PIPELINE_DEPTH
+        # corpus feedback no longer caps the pipeline: finds are
+        # credited to the arm that GENERATED the batch (lag-safe),
+        # so rotation reads the corpus as-of-now without draining
+        depth = self.PIPELINE_DEPTH
         accumulate = self._resolve_accumulate()
         if self.feedback and self._base_seed is None and \
                 getattr(mut, "seed_bytes", None):
@@ -533,17 +583,33 @@ class Fuzzer:
                 # reset the rotation clock
                 if (self.feedback and self._fb_batches
                         and self._fb_batches % self.feedback == 0):
+                    # freshen the corpus without stalling; while it is
+                    # still EMPTY, force one pull so short runs get
+                    # their rotations (bounded: stops mattering the
+                    # moment the first finding lands)
+                    self._drain_ready(pending)
+                    if not self._corpus and pending:
+                        self._triage_batch(*pending.popleft())
                     self._credit_period()
                     if self._corpus:
                         self._rotate_seed(mut)
-                if (accumulate > 1
+                # K-step accumulation may not stride over a feedback
+                # rotation boundary (the check above only fires at
+                # loop top): engage only when the next boundary is at
+                # least K batches away — _fb_batches can enter run()
+                # misaligned after short per-batch runs
+                if self.feedback:
+                    gap = (-self._fb_batches) % self.feedback \
+                        or self.feedback
+                else:
+                    gap = accumulate
+                if (accumulate > 1 and gap >= accumulate
                         and self._remaining(n_iterations)
                         >= accumulate * self.batch_size
                         and mut.remaining()
                         >= accumulate * self.batch_size):
                     # K-step device-side accumulation: one transfer
-                    # set per K batches (rotation cadence alignment
-                    # guaranteed by _resolve_accumulate)
+                    # set per K batches
                     self._run_superbatch(accumulate, pending, depth)
                     continue
                 self._fb_batches += 1
@@ -562,7 +628,7 @@ class Fuzzer:
                 self.stats.iterations += room
                 packed = self._prefetch(out)
                 pending.append((out, room, self.stats.iterations,
-                                packed))
+                                packed, self._active_entry))
                 if len(pending) >= depth:
                     self._triage_batch(*pending.popleft())
         finally:
@@ -592,6 +658,7 @@ class Fuzzer:
                 break
             self.stats.iterations += 1
             buf = self.driver.get_last_input() or b""
+            self._credit_arm = self._active_entry
             self._triage_lane(result, instr.is_new_path(), buf,
                               instr.last_unique_crash(),
                               instr.last_unique_hang())

@@ -211,19 +211,22 @@ class AflInstrumentation(Instrumentation):
         # stdin workers mint private temp files; file-delivery workers
         # derive private @@ paths from the driver's (reference
         # per-instance scaling, dynamorio_instrumentation.c:418-431).
-        # A file path the argv doesn't carry as an exact token (no @@,
-        # or embedded in a larger argument) can't be re-pointed per
-        # worker — those targets keep the old single-instance behavior.
+        # A file path the argv doesn't carry as a re-pointable token
+        # (whole token or --flag=<path>; no @@, or embedded
+        # mid-argument) can't be privatized per worker — those
+        # targets keep the old single-instance behavior.
+        from ..native.exec_backend import pool_token_matches
         poolable = (input_file is None and use_stdin) or \
-            (input_file is not None and input_file in argv)
+            (input_file is not None and
+             any(pool_token_matches(a, input_file) for a in argv))
         if workers > 1 and poolable:
             self._target = ExecPool(argv, workers, **kwargs)
         else:
             if workers > 1:
                 WARNING_MSG(
                     "afl: workers=%d requested but the input file is "
-                    "not an exact argv token (no @@, or embedded in a "
-                    "larger argument) — running 1 instance", workers)
+                    "not a re-pointable argv token (no @@, or embedded "
+                    "mid-argument) — running 1 instance", workers)
             self._target = ExecTarget(argv, **kwargs)
         self._target_key = key
         return self._target

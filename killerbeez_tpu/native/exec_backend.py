@@ -100,6 +100,12 @@ def classify(status: int) -> Tuple[int, int]:
     return FUZZ_NONE, status
 
 
+def pool_token_matches(arg: str, input_file: str) -> bool:
+    """True when ``arg`` carries ``input_file`` in a form ExecPool can
+    re-point per worker: the whole token, or a --flag=<path> value."""
+    return arg == input_file or arg.endswith("=" + input_file)
+
+
 def classify_batch(statuses_raw: np.ndarray
                    ) -> Tuple[np.ndarray, np.ndarray]:
     """Vectorized classify() over a raw status array: (verdicts,
@@ -361,23 +367,28 @@ class ExecPool:
         input_file = kwargs.pop("input_file", None)
         self._derived_files: list = []
         if input_file:
-            if not any(a == input_file for a in argv):
+            if not any(pool_token_matches(a, input_file) for a in argv):
                 raise ValueError(
                     "ExecPool file mode needs the input file as an "
-                    f"EXACT argv token; {input_file!r} is absent or "
-                    "embedded in a larger argument (callers degrade "
-                    "such targets to a single instance)")
+                    f"argv token (or --flag={input_file!r} value); it "
+                    "is absent or embedded mid-argument (callers "
+                    "degrade such targets to a single instance)")
             self.targets = []
             root, ext = os.path.splitext(input_file)
             for i in range(max(n_workers, 1)):
                 # suffix BEFORE the extension: format-sniffing targets
                 # that validate the input path's extension keep seeing
-                # it (in.png -> in.w0.png, not in.png.w0).  Only
-                # exact-match tokens are re-pointed — a substring
-                # replace would corrupt companion arguments like
-                # --dict=<input>.dict that nobody stages per worker.
+                # it (in.png -> in.w0.png, not in.png.w0).  Only whole
+                # tokens and --flag=<path> values are re-pointed — a
+                # raw substring replace would corrupt companion
+                # arguments like --dict=<input>.dict that nobody
+                # stages per worker.
                 f_i = f"{root}.w{i}{ext}"
-                argv_i = [f_i if a == input_file else a for a in argv]
+                argv_i = [
+                    f_i if a == input_file
+                    else (a[:-len(input_file)] + f_i
+                          if a.endswith("=" + input_file) else a)
+                    for a in argv]
                 self.targets.append(
                     ExecTarget(argv_i, input_file=f_i, **kwargs))
                 self._derived_files.append(f_i)

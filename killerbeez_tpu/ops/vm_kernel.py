@@ -96,7 +96,7 @@ def _chain(pairs, default):
 
 def _vm_loop(instrs_t, table_t, bufs, lengths, z,
              mem_size, max_steps, n_edges, status0=None,
-             dots=DEFAULT_DOTS):
+             dots=DEFAULT_DOTS, narrow=None):
     """The VM step loop shared by the plain and fused kernels: takes
     lane-last [L, T] candidate bytes + [1, T] lengths, returns the
     final carry tuple.  ``z`` is a loaded [1, T] zeros row (see the
@@ -105,7 +105,10 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
     lanes FUZZ_NONE so their tiles exit the while-loop immediately);
     it must be load-derived like everything else.  The program
     tables arrive RAW int32; ``dots`` selects the MXU dtypes (see
-    the DEFAULT_DOTS note)."""
+    the DEFAULT_DOTS note).  ``narrow`` (requires max_steps < 2^15)
+    carries the static-edge counts as int16 — halving the widest VPU
+    rows of the step, the [E+1, T] accounting — exact because a
+    count can never exceed max_steps."""
     t = bufs.shape[1]
     ni = instrs_t.shape[1]
     nb = table_t.shape[0]
@@ -233,7 +236,7 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
         eidx = jnp.sum(jnp.where(io_nb == cur_idx, rows_e, 0),
                        axis=0, keepdims=True).astype(jnp.int32)
         emask = (io_e == eidx) & is_block
-        new_counts = counts + emask.astype(jnp.int32)
+        new_counts = counts + emask.astype(counts.dtype)
         new_prev_idx = jnp.where(is_block, cur_idx + 1, prev_idx)
         new_hash = jnp.where(
             is_block, _mix32(path_hash ^ cur_loc.astype(jnp.uint32)),
@@ -257,6 +260,13 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
     # (or anything folded to one, like lens*0) gets Mosaic's
     # fully-replicated {*,*} layout, and the loop back-edge cannot
     # relayout the computed {0,0} values into it.
+    if narrow is None:  # auto: exact whenever a count can't overflow
+        import os as _os
+        narrow = (max_steps < (1 << 15)
+                  and not _os.environ.get("KB_VM_WIDE"))
+    cdt = jnp.int16 if narrow else jnp.int32
+    if narrow and max_steps >= (1 << 15):
+        raise ValueError("narrow counts need max_steps < 32768")
     state0 = (z,
               jnp.zeros((N_REGS, t), jnp.int32) + z,
               jnp.zeros((mem_size, t), jnp.int32) + z,
@@ -264,7 +274,7 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
               (z + FUZZ_RUNNING) if status0 is None else status0,
               z,
               z,
-              jnp.zeros((n_edges + 1, t), jnp.int32) + z,
+              jnp.zeros((n_edges + 1, t), cdt) + z.astype(cdt),
               z.astype(jnp.uint32),
               jnp.int32(0),
               z)
@@ -272,7 +282,10 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
     def cond(s):
         return jnp.any(s[4] == FUZZ_RUNNING) & (s[9] < max_steps)
 
-    return jax.lax.while_loop(cond, lambda s: step(s), state0)
+    final = jax.lax.while_loop(cond, lambda s: step(s), state0)
+    if narrow:  # outputs stay int32 regardless of the carry width
+        final = final[:7] + (final[7].astype(jnp.int32),) + final[8:]
+    return final
 
 
 def _vm_kernel(instrs_t_ref, table_t_ref, bufs_ref, lens_ref, zero_ref,

@@ -47,7 +47,9 @@ def execs_to(curve, level):
 
 def main():
     from killerbeez_tpu.models import targets_cgc
-    execs = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    # default budget spans many FEEDBACK_AUTO cadences (8 batches
+    # between rotations on the default path)
+    execs = int(sys.argv[1]) if len(sys.argv) > 1 else 131072
     batch = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
     targets = [
         ("tlvstack_vm", targets_cgc.tlvstack_vm_seed()),
@@ -66,7 +68,9 @@ def main():
         target_lost = False
         for label, sd in (("crafted", seed), ("minimal", seed[:8])):
             base, bs, bc = coverage_at(name, sd, execs, batch, 0)
-            fb, fs, fc = coverage_at(name, sd, execs, batch, 1)
+            # -1 = the PRODUCT DEFAULT path (Fuzzer.FEEDBACK_AUTO
+            # cadence) — the gate measures what users actually get
+            fb, fs, fc = coverage_at(name, sd, execs, batch, -1)
             level = min(base, fb)
             tb, tf = execs_to(bc, level), execs_to(fc, level)
             if fb > base:

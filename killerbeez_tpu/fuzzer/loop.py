@@ -403,10 +403,15 @@ class Fuzzer:
         for e in self._corpus:
             e[1] *= g
             e[2] *= g
-        if self._active is None:
+        # charge the period's selection to the arm ENTRY that actually
+        # generated it: when CORPUS_CAP pops the active arm, the index
+        # goes stale but the entry object is still the generator —
+        # charging base instead would depress base's score for batches
+        # it never produced (the find credits go to the same object)
+        if self._active_entry is None:
             self._base_stats[0] += 1
         else:
-            self._corpus[self._active][1] += 1
+            self._active_entry[1] += 1
 
     def _rotate_seed(self, mut) -> None:
         """Coverage-guided corpus feedback (beyond reference parity:
@@ -584,11 +589,15 @@ class Fuzzer:
                 if (self.feedback and self._fb_batches
                         and self._fb_batches % self.feedback == 0):
                     # freshen the corpus without stalling; while it is
-                    # still EMPTY, force one pull so short runs get
-                    # their rotations (bounded: stops mattering the
-                    # moment the first finding lands)
+                    # still EMPTY, force one pull — but only of an
+                    # entry at least a full cadence old, whose async
+                    # copy has had a cadence of compute time to land
+                    # (a finding-free campaign then pays ~nothing per
+                    # boundary instead of a fresh-transfer RTT)
                     self._drain_ready(pending)
-                    if not self._corpus and pending:
+                    if (not self._corpus and pending
+                            and self.stats.iterations - pending[0][2]
+                            >= self.feedback * self.batch_size):
                         self._triage_batch(*pending.popleft())
                     self._credit_period()
                     if self._corpus:

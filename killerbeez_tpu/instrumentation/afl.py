@@ -89,8 +89,11 @@ class AflInstrumentation(Instrumentation):
                             "itself (skip the pre-main forkserver)",
         "qemu_mode": "1 = binary-only target: run it under the "
                      "coverage tracer given by qemu_path (default: "
-                     "the bundled kb-trace ptrace single-stepper; "
-                     "any __AFL_SHM_ID-honoring emulator works)",
+                     "the bundled kb-trace ptrace tracer; any "
+                     "__AFL_SHM_ID-honoring emulator works — proven "
+                     "by corpus/qemu_stub.c, an external stub built "
+                     "from the documented wire contract alone, "
+                     "exercised by test_qemu_path_external_emulator)",
         "qemu_path": "emulator/tracer binary for qemu_mode (default "
                      "native/build/kb-trace)",
         "timeout": "seconds before an exec counts as a hang "

@@ -330,3 +330,37 @@ def test_afl_workers_file_delivery(corpus_bin):
         assert instr.total_execs == 6
     finally:
         instr.cleanup()
+
+
+def test_qemu_path_external_emulator(corpus_bin):
+    """The qemu_path interop claim (afl.py options): ANY external
+    __AFL_SHM_ID-honoring emulator plugs in.  corpus/qemu_stub.c is
+    built from the documented wire contract alone (no killerbeez
+    headers); campaigns through it must get verdicts AND
+    input-dependent coverage novelty."""
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    instr = instrumentation_factory("afl", json.dumps(
+        {"qemu_mode": 1, "qemu_path": corpus_bin("qemu-stub")}))
+    try:
+        tgt = corpus_bin("test-plain")
+        instr.enable(b"zzzz", cmd_line=tgt)
+        assert instr.get_fuzz_result() == FUZZ_NONE
+        assert instr.is_new_path() > 0          # first exec: coverage
+        instr.enable(b"zzzz", cmd_line=tgt)
+        assert instr.is_new_path() == 0         # same input: no new
+        instr.enable(b"zzyy", cmd_line=tgt)
+        assert instr.is_new_path() > 0          # diverging input: new
+        instr.enable(b"ABCD", cmd_line=tgt)
+        assert instr.get_fuzz_result() == FUZZ_CRASH  # real verdicts
+        # batch path through the same external emulator
+        instr.prepare_host(tgt, use_stdin=True)
+        inputs = np.zeros((3, 4), np.uint8)
+        for i, s in enumerate([b"zzzz", b"ABCD", b"qqqq"]):
+            inputs[i, :4] = np.frombuffer(s, np.uint8)
+        res = instr.run_batch(inputs, np.full(3, 4, np.int32))
+        assert res.statuses[1] == FUZZ_CRASH
+        assert res.new_paths[2] > 0
+    finally:
+        instr.cleanup()

@@ -247,7 +247,11 @@ def bench_cli_product(target, batch, steps, seed):
     out = os.path.join(REPO, "bench_out", "cli_product")
     shutil.rmtree(out, ignore_errors=True)
     fz = Fuzzer(drv, output_dir=out, batch_size=batch)
-    fz.run(2 * batch)                      # warmup / compile
+    # warmup must cover BOTH compiled paths: the per-batch step AND
+    # the K-step superbatch (engaged once the run is deep enough),
+    # plus the feedback-cadence alignment — 2 batches would leave the
+    # _fused_fuzz_multi compile inside the timed window
+    fz.run((2 * fz.ACCUMULATE_AUTO + 2) * batch)
     done = fz.stats.iterations             # run(n) targets a TOTAL
     t0 = time.time()
     fz.run(done + batch * steps)
@@ -307,6 +311,34 @@ print(json.dumps({'ok': True, 'execs_per_sec': 64 * 4 * N / dt,
              ok=False, error=r.stderr[-300:])
 
 
+def bench_qemu_tier():
+    """Config 4q: the binary-only tier — UnTracer-mode kb-trace on an
+    UNINSTRUMENTED CGC-grade binary via the native protocol driver
+    (steady-state: breakpoint-free execs at native PTRACE_CONT
+    speed).  Reference point: its patched QEMU reaches
+    hundreds-to-thousands of execs/s on server hardware
+    (docs/AFL.md:52-55 claims ~3x stock afl-qemu)."""
+    import re
+    bt = os.path.join(REPO, "native", "build", "bench-trace")
+    kt = os.path.join(REPO, "native", "build", "kb-trace")
+    tgt = os.path.join(REPO, "corpus", "build", "tlvstack-plain")
+    seed = os.path.join(REPO, "corpus", "seeds", "tlvstack.stk")
+    if not all(os.path.exists(p) for p in (bt, kt, tgt, seed)):
+        emit("4q", "binary-only tier fixtures missing", 0.0,
+             skipped="native/corpus build unavailable")
+        return
+    env = dict(os.environ, BT_STDIN=seed)
+    r = subprocess.run([bt, "1000", "--", kt, tgt], env=env,
+                       capture_output=True, text=True, timeout=120)
+    m = re.search(r"= (\d+) execs/s", r.stdout)
+    if not m:
+        raise RuntimeError(f"bench-trace: {r.stdout[-200:]}"
+                           f"{r.stderr[-200:]}")
+    emit("4q", "binary-only UnTracer kb-trace on tlvstack-plain "
+         "(uninstrumented)", float(m.group(1)),
+         baseline=FORKSERVER_BASELINE)
+
+
 def main():
     from killerbeez_tpu.models import targets_cgc
 
@@ -354,6 +386,12 @@ def main():
     except Exception as e:
         emit("4d", "product CLI loop unavailable", 0.0, ok=False,
              error=str(e)[:200])
+
+    try:
+        bench_qemu_tier()
+    except Exception as e:
+        emit("4q", "binary-only (UnTracer kb-trace) unavailable", 0.0,
+             ok=False, error=str(e)[:200])
 
     # headline LAST: the CGC-grade flagship with mutation AND
     # execution fused into one Pallas kernel (falls back to the XLA

@@ -56,18 +56,22 @@ static void attach_map(void) {
 /* Hash the staged input into map slots, then rewind it for the
  * child.  FNV-1a over a sliding window: every byte prefix lands a
  * distinct slot, so novelty deepens as inputs diverge — the shape a
- * block-coverage stream has, without pretending to be one. */
+ * block-coverage stream has, without pretending to be one.
+ * Non-seekable stdin (one-shot manual run with piped input) is left
+ * UNTOUCHED: consuming it would truncate the child's input. */
 static void record_input_coverage(void) {
   unsigned char buf[4096];
   off_t here = lseek(0, 0, SEEK_CUR);
-  ssize_t n = read(0, buf, sizeof buf);
-  uint32_t h = 0x811c9dc5u;
-  for (ssize_t i = 0; i < n; i++) {
-    h = (h ^ buf[i]) * 0x01000193u;
-    map[h % MAP_SIZE]++;
-  }
   map[0]++; /* the "entry block": even empty inputs leave a mark */
-  if (here >= 0) lseek(0, here, SEEK_SET);
+  if (here < 0) return; /* pipe: cannot rewind, do not consume */
+  uint32_t h = 0x811c9dc5u;
+  ssize_t n;
+  while ((n = read(0, buf, sizeof buf)) > 0) /* hash the WHOLE input */
+    for (ssize_t i = 0; i < n; i++) {
+      h = (h ^ buf[i]) * 0x01000193u;
+      map[h % MAP_SIZE]++;
+    }
+  lseek(0, here, SEEK_SET);
 }
 
 static pid_t spawn_target(char **argv) {

@@ -128,7 +128,6 @@ class Fuzzer:
         # base seed (see _rotate_seed)
         self._corpus: list = []
         self._base_stats = [0, 0]       # [selections, finds]
-        self._active: Optional[int] = None  # corpus index or None=base
         # the arm whose candidates the batch being TRIAGED came from:
         # with a deep pipeline, triage lags generation, so finds must
         # credit the GENERATING arm (entry object, robust to corpus
@@ -237,11 +236,10 @@ class Fuzzer:
             if recorded and self.feedback and new_path == 2:
                 self._corpus.append([buf, 0, 0])
                 if len(self._corpus) > self.CORPUS_CAP:
+                    # the active arm may be the popped entry; the
+                    # ENTRY-object credit pointers (_active_entry,
+                    # per-batch _credit_arm) stay valid regardless
                     self._corpus.pop(0)
-                    # keep the active-arm selection pointer aligned
-                    if self._active is not None:
-                        self._active = (None if self._active == 0
-                                        else self._active - 1)
                 # credit the arm whose candidates PRODUCED this find
                 # (set per triaged batch; a capped-out arm's entry may
                 # already be off the corpus list — the credit is then
@@ -476,7 +474,6 @@ class Fuzzer:
                 # keys, not replay the (seed, iteration) pairs it
                 # already executed
                 mut.iteration = it
-                self._active = best
                 self._active_entry = (None if best is None
                                       else self._corpus[best])
                 DEBUG_MSG("feedback: arm %s (score %.2f), %d-byte "
@@ -486,11 +483,6 @@ class Fuzzer:
                 if best is None:
                     return            # base seed itself doesn't fit
                 self._corpus.pop(best)
-                if self._active is not None:
-                    if self._active == best:
-                        self._active = None
-                    elif self._active > best:
-                        self._active -= 1
 
     def _resolve_accumulate(self) -> int:
         """Effective superbatch depth K.  Auto engages only on the

@@ -86,6 +86,38 @@ for f in FIELDS:
         print(json.dumps({"error": f"vm kernel parity: {f} diverged"}))
         sys.exit(0)
 
+# (b2) K-step superbatch path (lax.scan over the fused kernel, the
+# CLI default): must compile on-chip and match K sequential fused
+# steps bit-for-bit through the instrumentation layer
+from killerbeez_tpu.instrumentation.factory import instrumentation_factory
+from killerbeez_tpu.mutators.factory import mutator_factory
+import json as _json
+K = 2
+im = instrumentation_factory("jit_harness", _json.dumps(
+    {"target": "tlvstack_vm", "engine": "pallas_fused",
+     "novelty": "throughput"}))
+i1 = instrumentation_factory("jit_harness", _json.dumps(
+    {"target": "tlvstack_vm", "engine": "pallas_fused",
+     "novelty": "throughput"}))
+mm = mutator_factory("havoc", '{"seed": 9}', seed)
+m1 = mutator_factory("havoc", '{"seed": 9}', seed)
+its0 = mm.peek_iterations(B)
+packed, mbufs, mlens, _c = im.run_batch_fused_multi(mm, its0, K)
+mm.advance(K * B)
+pk = np.asarray(packed)
+for j in range(K):
+    r1, b1, l1, _ = i1.run_batch_fused(m1, m1.peek_iterations(B))
+    m1.advance(B)
+    ref_pk = (np.asarray(r1.statuses).astype(np.uint8)
+              | (np.asarray(r1.new_paths).astype(np.uint8) << 3)
+              | (np.asarray(r1.unique_crashes).astype(np.uint8) << 5)
+              | (np.asarray(r1.unique_hangs).astype(np.uint8) << 6))
+    if not (np.array_equal(pk[j], ref_pk)
+            and np.array_equal(np.asarray(mbufs[j]), np.asarray(b1))):
+        print(_json.dumps({"error": f"superbatch step {j} diverged "
+                           "from sequential fused steps"}))
+        sys.exit(0)
+
 # (c) throughput floor, steady-state (compiles are already cached)
 Bf = 16384
 wsteps = 10

@@ -116,13 +116,30 @@ class ShardedCampaignDriver(Driver):
         """The loop may only request whole mesh batches."""
         return self.batch_per_device * self.mesh.shape["dp"]
 
-    def test_batch(self, n: int, pad_to: Optional[int] = None,
-                   prefetch_next=True) -> BatchOutcome:
+    def _check_full_batch(self, n: int) -> None:
         b = self.batch_per_device * self.mesh.shape["dp"]
         if n != b:
             raise ValueError(
                 f"sharded campaigns run full batches: asked {n}, "
                 f"mesh batch is {b} (use -n as a multiple of -b)")
+
+    def _sync_after(self, bufs, lens, n: int, execs: int) -> None:
+        """Post-step bookkeeping shared by the per-batch and K-step
+        paths: expose the sharded maps through the instrumentation
+        (get_state()/merge()/coverage_bytes() see campaign coverage)
+        and defer last-input materialization."""
+        instr = self.instrumentation
+        instr.virgin_bits = self.state.virgin_bits
+        instr.virgin_crash = self.state.virgin_crash
+        instr.virgin_tmout = self.state.virgin_tmout
+        instr.total_execs += execs
+        if n > 0:
+            self._last_batch_tail = (bufs, lens, n - 1)
+            self.last_input = None
+
+    def test_batch(self, n: int, pad_to: Optional[int] = None,
+                   prefetch_next=True) -> BatchOutcome:
+        self._check_full_batch(n)
         mut = self.mutator
         its = mut.peek_iterations(n)
         # PRNG step: fold the RAW absolute mutator iteration into the
@@ -141,22 +158,33 @@ class ShardedCampaignDriver(Driver):
                                      jnp.int32(mut.seed_len),
                                      base_it)
         mut.advance(n)
-        # expose the sharded maps through the instrumentation so
-        # get_state()/merge()/coverage_bytes() see campaign coverage
-        instr = self.instrumentation
-        instr.virgin_bits = self.state.virgin_bits
-        instr.virgin_crash = self.state.virgin_crash
-        instr.virgin_tmout = self.state.virgin_tmout
-        instr.total_execs += n
-        if n > 0:
-            self._last_batch_tail = (bufs, lens, n - 1)
-            self.last_input = None
+        self._sync_after(bufs, lens, n, n)
         return BatchOutcome(
             result=BatchResult(statuses=statuses, new_paths=rets,
                                unique_crashes=uc, unique_hangs=uh,
                                exit_codes=exit_codes),
             inputs=bufs, lengths=lens,
             compact=CompactReport(*compact))
+
+    def supports_fused_multi(self) -> bool:
+        """Mesh campaigns get their own K-step accumulation: virgin
+        maps (and the per-step ICI folds) thread a per-shard
+        lax.scan, one transfer set per K global batches — the
+        multi-chip twin of the single-chip superbatch."""
+        return True
+
+    def test_batch_fused_multi(self, n: int, k: int):
+        self._check_full_batch(n)
+        mut = self.mutator
+        its = mut.peek_iterations(n)
+        base_it = int(its[0])  # same 64-bit counter contract as
+        # test_batch; step j inside the scan adds j*n on device
+        seed_buf = jnp.asarray(mut.seed_buf)
+        (self.state, packed, bufs, lens, compact) = self._step.multi(
+            self.state, seed_buf, jnp.int32(mut.seed_len), base_it, k)
+        mut.advance(k * n)
+        self._sync_after(bufs[k - 1], lens[k - 1], n, k * n)
+        return packed, bufs, lens, compact
 
     def test_input(self, buf: bytes) -> int:
         """Single-input repro path: run through the instrumentation's

@@ -322,8 +322,93 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         check_vma=False,
     )
 
+    def local_multi(k):
+        """K sharded steps scanned per shard: virgin maps thread the
+        carry (ICI folds run INSIDE the scan), verdicts bit-packed —
+        the mesh twin of jit_harness._fused_fuzz_multi."""
+        n_global = jnp.uint32(n_dp * batch_per_device)
+
+        def body_fn(vb, vc, vh, seed_buf, seed_len, base_it):
+            def body(carry, j):
+                vb, vc, vh = carry
+                off = j * n_global
+                lo = base_it[0] + off
+                hi = base_it[1] + (lo < base_it[0]).astype(jnp.uint32)
+                (vb2, vc2, vh2, statuses, rets, uc, uh, _ec, bufs,
+                 lens, sel_idx, sel_bufs, sel_lens, count) = local_step(
+                    vb, vc, vh, seed_buf, seed_len,
+                    jnp.stack([lo, hi]))
+                packed = (statuses.astype(jnp.uint8)
+                          | (rets.astype(jnp.uint8) << 3)
+                          | (uc.astype(jnp.uint8) << 5)
+                          | (uh.astype(jnp.uint8) << 6))
+                return (vb2, vc2, vh2), (packed, bufs, lens, sel_idx,
+                                         sel_bufs, sel_lens, count)
+
+            (vb, vc, vh), outs = jax.lax.scan(
+                body, (vb, vc, vh), jnp.arange(k, dtype=jnp.uint32))
+            return (vb, vc, vh) + tuple(outs)
+
+        return body_fn
+
+    _multi_cache: dict = {}
+
+    def _sharded_multi(k: int):
+        fn = _multi_cache.get(k)
+        if fn is None:
+            fn = jax.jit(shard_map(
+                local_multi(k), mesh=mesh,
+                in_specs=(P("mp"), P("mp"), P("mp"), P(), P(), P()),
+                out_specs=(P("mp"), P("mp"), P("mp"),
+                           P(None, "dp"),          # packed [k, B]
+                           P(None, "dp", None),    # bufs [k, B, L]
+                           P(None, "dp"),          # lens [k, B]
+                           P(None, "dp"),          # sel_idx
+                           P(None, "dp", None),    # sel_bufs
+                           P(None, "dp"),          # sel_lens
+                           P(None, "dp")),         # counts [k, n_dp]
+                check_vma=False))
+            _multi_cache[k] = fn
+        return fn
+
     @jax.jit
     def _step_jit(state: ShardedFuzzState, seed_buf, seed_len, base_it):
+        seed_buf = _validate(state, seed_buf)  # defined below; bound
+        # at call time — shared with step_multi
+        (vb, vc, vh, statuses, rets, uc, uh, exit_codes, bufs,
+         lens, sel_idx, sel_bufs, sel_lens, counts) = sharded(
+            state.virgin_bits, state.virgin_crash, state.virgin_tmout,
+            seed_buf, seed_len, base_it)
+        new_state = ShardedFuzzState(vb, vc, vh, state.step + 1)
+        return (new_state, statuses, rets, uc, uh, exit_codes, bufs,
+                lens, (sel_idx, sel_bufs, sel_lens, counts))
+
+    def _halves(base_it):
+        """Split ``base_it`` into uint32 halves host-side (a Python
+        int keeps all 64 bits; a device scalar from an older caller
+        becomes [it, 0]) so the jitted body never converts a >=2^32
+        Python int to uint32 — NumPy 2.x raises OverflowError there,
+        and older NumPy wraps silently, replaying earlier
+        (counter, lane) PRNG pairs."""
+        if isinstance(base_it, (int, np.integer)):
+            it = int(base_it)
+            return jnp.asarray(
+                [it & 0xFFFFFFFF, (it >> 32) & 0xFFFFFFFF],
+                dtype=jnp.uint32)
+        arr = jnp.asarray(base_it)
+        if arr.ndim == 0:
+            return jnp.stack([arr.astype(jnp.uint32),
+                              jnp.zeros((), jnp.uint32)])
+        return arr.astype(jnp.uint32)
+
+    def step(state: ShardedFuzzState, seed_buf, seed_len, base_it):
+        """Public step (see _halves for the base_it contract)."""
+        return _step_jit(state, seed_buf, seed_len, _halves(base_it))
+
+    def _validate(state: ShardedFuzzState, seed_buf):
+        """Shared trace-time checks: both paths must reject a
+        mismatched resumed state loudly — clamped indexing into a
+        wrong-sized virgin map would silently corrupt triage."""
         if state.virgin_bits.shape[-1] != program.map_size:
             raise ValueError(
                 f"state map is {state.virgin_bits.shape[-1]} bytes but "
@@ -336,33 +421,23 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         if seed_buf.shape[-1] < max_len:  # trace-time pad to max_len
             seed_buf = jnp.pad(seed_buf,
                                (0, max_len - seed_buf.shape[-1]))
-        (vb, vc, vh, statuses, rets, uc, uh, exit_codes, bufs,
-         lens, sel_idx, sel_bufs, sel_lens, counts) = sharded(
+        return seed_buf
+
+    def step_multi(state: ShardedFuzzState, seed_buf, seed_len,
+                   base_it, k: int):
+        """K sharded steps in one dispatch: step j executes counter
+        ``base_it + j*(dp*batch_per_device)`` (the global batch the
+        campaign advances per step), virgin maps threaded on device.
+        Returns (state', packed uint8[k, B], bufs[k, B, L],
+        lens[k, B], (idx, bufs, lens, counts) stacked compact)."""
+        seed_buf = _validate(state, seed_buf)
+        (vb, vc, vh, packed, bufs, lens, sel_idx, sel_bufs, sel_lens,
+         counts) = _sharded_multi(int(k))(
             state.virgin_bits, state.virgin_crash, state.virgin_tmout,
-            seed_buf, seed_len, base_it)
-        new_state = ShardedFuzzState(vb, vc, vh, state.step + 1)
-        return (new_state, statuses, rets, uc, uh, exit_codes, bufs,
-                lens, (sel_idx, sel_bufs, sel_lens, counts))
+            seed_buf, seed_len, _halves(base_it))
+        new_state = ShardedFuzzState(vb, vc, vh, state.step + int(k))
+        return (new_state, packed, bufs, lens,
+                (sel_idx, sel_bufs, sel_lens, counts))
 
-    def step(state: ShardedFuzzState, seed_buf, seed_len, base_it):
-        """Public step: splits ``base_it`` into uint32 halves host-side
-        (a Python int keeps all 64 bits; a device scalar from an older
-        caller becomes [it, 0]) so the jitted body never converts a
-        >=2^32 Python int to uint32 — NumPy 2.x raises OverflowError
-        there, and older NumPy wraps silently, replaying earlier
-        (counter, lane) PRNG pairs."""
-        if isinstance(base_it, (int, np.integer)):
-            it = int(base_it)
-            halves = jnp.asarray(
-                [it & 0xFFFFFFFF, (it >> 32) & 0xFFFFFFFF],
-                dtype=jnp.uint32)
-        else:
-            arr = jnp.asarray(base_it)
-            if arr.ndim == 0:
-                halves = jnp.stack([arr.astype(jnp.uint32),
-                                    jnp.zeros((), jnp.uint32)])
-            else:
-                halves = arr.astype(jnp.uint32)
-        return _step_jit(state, seed_buf, seed_len, halves)
-
+    step.multi = step_multi
     return step

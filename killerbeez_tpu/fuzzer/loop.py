@@ -300,11 +300,10 @@ class Fuzzer:
         self._credit_arm = arm
         res = out.result
         if packed is not None:
+            from ..instrumentation.base import unpack_verdicts
             pk = np.asarray(packed)          # prefetched: cache hit
-            statuses = (pk & 7).astype(np.int32)
-            new_paths = (pk >> 3) & 3
-            uc = (pk >> 5) & 1
-            uh = (pk >> 6) & 1
+            statuses, new_paths, uc, uh = unpack_verdicts(pk)
+            statuses = statuses.astype(np.int32)
         else:
             statuses = np.asarray(res.statuses)
             new_paths = np.asarray(res.new_paths)
@@ -373,11 +372,9 @@ class Fuzzer:
         res = out.result
         if not hasattr(res.statuses, "copy_to_host_async"):
             return None
-        import jax.numpy as jnp
-        packed = (res.statuses.astype(jnp.uint8)
-                  | (res.new_paths.astype(jnp.uint8) << 3)
-                  | (res.unique_crashes.astype(jnp.uint8) << 5)
-                  | (res.unique_hangs.astype(jnp.uint8) << 6))
+        from ..instrumentation.base import pack_verdicts
+        packed = pack_verdicts(res.statuses, res.new_paths,
+                               res.unique_crashes, res.unique_hangs)
         packed.copy_to_host_async()
         if out.compact is not None:
             for arr in out.compact:

@@ -24,6 +24,24 @@ from .. import FUZZ_NONE
 from ..utils.options import format_help, parse_options
 
 
+def pack_verdicts(statuses, new_paths, unique_crashes, unique_hangs):
+    """One uint8 lane byte: status (3 bits) | new_paths (2) << 3 |
+    unique_crash << 5 | unique_hang << 6 — THE wire layout between
+    device steps and host triage (works on numpy and jax arrays).
+    Change field widths here and in unpack_verdicts ONLY."""
+    return (statuses.astype("uint8")
+            | (new_paths.astype("uint8") << 3)
+            | (unique_crashes.astype("uint8") << 5)
+            | (unique_hangs.astype("uint8") << 6))
+
+
+def unpack_verdicts(packed):
+    """(statuses, new_paths, unique_crashes, unique_hangs) from the
+    pack_verdicts lane byte."""
+    return (packed & 7, (packed >> 3) & 3,
+            (packed >> 5) & 1, (packed >> 6) & 1)
+
+
 class BatchResult(NamedTuple):
     """Per-lane outcome of a batched execution."""
     statuses: np.ndarray      # int32[B] FUZZ_* (RUNNING already -> HANG)

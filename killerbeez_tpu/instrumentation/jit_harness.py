@@ -46,6 +46,7 @@ from ..utils.logging import WARNING_MSG
 from ..utils.serialization import decode_array, encode_array
 from .base import (
     BatchResult, CompactReport, Instrumentation, module_slice_edges,
+    pack_verdicts,
 )
 from .factory import register_instrumentation
 
@@ -207,10 +208,7 @@ def _fused_fuzz_multi(instrs, edge_table, u_slots, seg_id, seed_buf,
                              res.status)
         new_paths, uc, uh, vb2, vc2, vh2 = _triage_counts(
             res.counts, statuses, u_slots, seg_id, vb, vc, vh, exact)
-        packed = (statuses.astype(jnp.uint8)
-                  | (new_paths.astype(jnp.uint8) << 3)
-                  | (uc.astype(jnp.uint8) << 5)
-                  | (uh.astype(jnp.uint8) << 6))
+        packed = pack_verdicts(statuses, new_paths, uc, uh)
         flags = ((statuses != FUZZ_NONE) | (new_paths > 0)) & \
             (jnp.arange(b) < n_real)
         (sel_idx,) = jnp.nonzero(flags, size=cap, fill_value=0)

@@ -115,8 +115,13 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
     L = bufs.shape[0]
     fetch_mode, edge_mode = dots
     if fetch_mode == "bf16x2":
-        ins_lo = (instrs_t & 0xFF).astype(jnp.bfloat16)
-        ins_hi = (instrs_t >> 8).astype(jnp.bfloat16)
+        # hi/lo limbs STACKED into one [8, NI] operand: the MXU's
+        # output tile rounds 4 rows up to 8 anyway, so one dot does
+        # the work of the two separate limb dots (measured 1.08x on
+        # the flagship step, bit-identical)
+        ins_cat = jnp.concatenate(
+            [(instrs_t & 0xFF).astype(jnp.bfloat16),
+             (instrs_t >> 8).astype(jnp.bfloat16)], axis=0)
     else:
         ins_f = instrs_t.astype(jnp.float32)
     table_f = table_t.astype(
@@ -142,10 +147,9 @@ def _vm_loop(instrs_t, table_t, bufs, lengths, z,
         pcc = jnp.clip(pc, 0, ni - 1)
         if fetch_mode == "bf16x2":
             onehot_pc = (io_ni == pcc).astype(jnp.bfloat16)  # [NI, T]
-            rlo = jax.lax.dot(ins_lo, onehot_pc,
-                              preferred_element_type=jnp.float32)
-            rhi = jax.lax.dot(ins_hi, onehot_pc,
-                              preferred_element_type=jnp.float32)
+            row8 = jax.lax.dot(ins_cat, onehot_pc,
+                               preferred_element_type=jnp.float32)
+            rlo, rhi = row8[:4], row8[4:]
             row = (rhi.astype(jnp.int32) << 8) + rlo.astype(jnp.int32)
         else:
             onehot_pc = (io_ni == pcc).astype(jnp.float32)   # [NI, T]

@@ -32,6 +32,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING, MAP_SIZE
+from ..instrumentation.base import pack_verdicts
 from ..models.vm import Program, _run_batch_impl
 from ..ops.coverage import classify_counts, simplify_trace
 from ..ops.mutate_core import havoc_at
@@ -338,10 +339,7 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
                  lens, sel_idx, sel_bufs, sel_lens, count) = local_step(
                     vb, vc, vh, seed_buf, seed_len,
                     jnp.stack([lo, hi]))
-                packed = (statuses.astype(jnp.uint8)
-                          | (rets.astype(jnp.uint8) << 3)
-                          | (uc.astype(jnp.uint8) << 5)
-                          | (uh.astype(jnp.uint8) << 6))
+                packed = pack_verdicts(statuses, rets, uc, uh)
                 return (vb2, vc2, vh2), (packed, bufs, lens, sel_idx,
                                          sel_bufs, sel_lens, count)
 

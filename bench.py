@@ -351,7 +351,7 @@ def main():
         emit(0, "host configs", 0.0, skipped="native toolchain "
              "or corpus build unavailable")
 
-    v4, _ = bench_device("test", 32768, 20, b"ABC@")
+    v4, _ = bench_device("test", 32768, 60, b"ABC@")
     emit(4, "jit_harness fused on-device (toy `test` target)", v4,
          baseline=FORKSERVER_BASELINE)
 
@@ -360,13 +360,13 @@ def main():
     except Exception as e:
         emit(5, "multichip smoke", 0.0, ok=False, error=str(e)[:200])
 
-    vx, _ = bench_device("tlvstack_vm", 16384, 20,
+    vx, _ = bench_device("tlvstack_vm", 16384, 60,
                          targets_cgc.tlvstack_vm_seed())
     emit("4b", "flagship tlvstack_vm, xla engine", vx,
          baseline=FORKSERVER_BASELINE)
 
     try:
-        vi, _ = bench_device_fused("imgparse_vm", 16384, 20,
+        vi, _ = bench_device_fused("imgparse_vm", 16384, 60,
                                    targets_cgc.imgparse_vm_seed())
         emit("4c", "imgparse_vm (chunked-format CGC target, fused pallas)",
              vi, baseline=FORKSERVER_BASELINE)
@@ -378,7 +378,7 @@ def main():
         # 32k lanes/batch: fewer host round-trips per exec — the
         # tunnel's RTT fluctuates and this is the config least
         # hostage to it (939k measured healthy, ~400k degraded)
-        vc_, st = bench_cli_product("tlvstack_vm", 32768, 20,
+        vc_, st = bench_cli_product("tlvstack_vm", 32768, 40,
                                     targets_cgc.tlvstack_vm_seed())
         emit("4d", "PRODUCT CLI loop (file+jit_harness+havoc, "
              "pallas_fused) on tlvstack_vm", vc_,
@@ -397,7 +397,7 @@ def main():
     # execution fused into one Pallas kernel (falls back to the XLA
     # engine number if the kernel won't compile in this environment)
     try:
-        vH, _ = bench_device_fused("tlvstack_vm", 16384, 20,
+        vH, _ = bench_device_fused("tlvstack_vm", 16384, 60,
                                    targets_cgc.tlvstack_vm_seed())
         engine_used = "fused pallas"
     except Exception as e:
@@ -407,8 +407,8 @@ def main():
     print(json.dumps({
         "metric": "execs/sec/chip on tlvstack_vm (110-block CGC-grade "
                   f"target; {engine_used} havoc+KBVM+static-edge "
-                  "triage, two-phase tail scheduling, exact-bf16 MXU "
-                  "dots)",
+                  "triage, two-phase tail scheduling, exact-bf16 "
+                  "stacked-limb MXU dots, i16 counts carry)",
         "value": round(vH, 1),
         "unit": "execs/sec",
         "vs_baseline": round(vH / FORKSERVER_BASELINE, 2),

@@ -55,8 +55,10 @@ LANE_TILE = 512  # lanes per grid instance (multiple of 128)
 # representable in bf16, i.e. |v| <= 256:
 #   * edge dot: edge ids <= n_edges, guarded n_edges < 255;
 #   * fetch dot: instruction words live in [-2^16, 2^16): split into
-#     hi/lo bytes (two independent bf16 dots, both limbs < 256 exact,
-#     f32 accumulators) and recombine (rhi << 8) + rlo.
+#     hi/lo bytes, STACKED into one [8, NI] operand (the MXU output
+#     tile rounds 4 rows to 8, so one dot covers both limbs; each
+#     limb < 256 exact, f32 accumulators) and recombined
+#     (rhi << 8) + rlo.
 # dot_modes() picks the fast modes iff the guards hold; "f32" keeps
 # the round-3 behavior.  Parity is enforced bit-for-bit by the
 # engine-equivalence tests either way.

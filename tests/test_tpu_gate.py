@@ -26,9 +26,15 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# conservative: the flagship product path measures ~1.1M execs/s on a
-# v5e chip; tunnel jitter and compile-cache misses included, anything
-# under this floor means the kernel regressed, not the environment
+# conservative: the flagship kernel computes at ~1.9M execs/s on a
+# v5e chip (round 5: i16 counts + stacked fetch dot), but the gate
+# dispatches through a tunnel whose PER-DISPATCH overhead has
+# measured anywhere from ~1ms to ~50ms across the day (best-of-3
+# windows observed 290k-1.2M for the same binary kernel; longer
+# windows measure SLOWER — deep dispatch queues throttle).  The
+# floor therefore only catches order-of-magnitude lowering
+# regressions (e.g. the 6-pass f32 dot decomposition); finer
+# regressions are the parity+bench suite's job on stable hardware.
 FLOOR_EXECS_PER_SEC = 150_000.0
 
 _SUBPROCESS_CODE = r"""
@@ -128,14 +134,18 @@ r = fuzz_batch_pallas_2phase(ins, tbl, sbj, slj, ws[0], prog.mem_size,
                              prog.max_steps, prog.n_edges,
                              phase1_steps=-1, dots=dots)
 jax.block_until_ready(r[0].status)
-t0 = time.time()
-for i in range(1, wsteps + 1):
-    r = fuzz_batch_pallas_2phase(ins, tbl, sbj, slj, ws[i],
-                                 prog.mem_size, prog.max_steps,
-                                 prog.n_edges, phase1_steps=-1,
-                                 dots=dots)
-jax.block_until_ready(r[0].status)
-rate = Bf * wsteps / (time.time() - t0)
+# best of 3 windows: a kernel regression depresses every window;
+# tunnel/queue noise does not
+rate = 0.0
+for _ in range(3):
+    t0 = time.time()
+    for i in range(1, wsteps + 1):
+        r = fuzz_batch_pallas_2phase(ins, tbl, sbj, slj, ws[i],
+                                     prog.mem_size, prog.max_steps,
+                                     prog.n_edges, phase1_steps=-1,
+                                     dots=dots)
+    jax.block_until_ready(r[0].status)
+    rate = max(rate, Bf * wsteps / (time.time() - t0))
 print(json.dumps({"ok": True, "execs_per_sec": rate,
                   "device": str(devs[0])}))
 """

@@ -42,6 +42,29 @@ def unpack_verdicts(packed):
             (packed >> 5) & 1, (packed >> 6) & 1)
 
 
+def pad_batch_result(res: "BatchResult", pad_to: int) -> "BatchResult":
+    """Pad result arrays to ``pad_to`` lanes with the shared padding
+    convention: padded lanes read FUZZ_ERROR with zero novelty (a
+    consumer that ever reads past the real count fails loudly as an
+    error spike instead of silently consuming plausible results)."""
+    from .. import FUZZ_ERROR
+    n = len(res.statuses)
+    if pad_to <= n:
+        return res
+    pad = pad_to - n
+    return BatchResult(
+        statuses=np.concatenate(
+            [res.statuses, np.full(pad, FUZZ_ERROR, dtype=np.int32)]),
+        new_paths=np.concatenate(
+            [res.new_paths, np.zeros(pad, dtype=np.int32)]),
+        unique_crashes=np.concatenate(
+            [res.unique_crashes, np.zeros(pad, dtype=bool)]),
+        unique_hangs=np.concatenate(
+            [res.unique_hangs, np.zeros(pad, dtype=bool)]),
+        exit_codes=np.concatenate(
+            [res.exit_codes, np.zeros(pad, dtype=np.int32)]))
+
+
 class BatchResult(NamedTuple):
     """Per-lane outcome of a batched execution."""
     statuses: np.ndarray      # int32[B] FUZZ_* (RUNNING already -> HANG)

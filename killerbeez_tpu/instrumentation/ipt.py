@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_RUNNING
+from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING
 from ..models import targets as targets_mod
 from ..models.vm import _run_batch_impl
 from ..ops.hashing import murmur3_32
@@ -272,23 +272,11 @@ class IptInstrumentation(Instrumentation):
         statuses_raw, bitmaps = self._host_target.run_batch(inputs,
                                                             lengths)
         pairs = self._host_pairs(bitmaps)
-        n = len(statuses_raw)
         verdicts, exit_codes = classify_batch(statuses_raw)
         res = self._update_sets(verdicts, pairs, exit_codes)
-        if pad_to is not None and pad_to > n:
-            pad = pad_to - n
-            res = BatchResult(
-                statuses=np.concatenate(
-                    [res.statuses,
-                     np.full(pad, FUZZ_ERROR, dtype=np.int32)]),
-                new_paths=np.concatenate(
-                    [res.new_paths, np.zeros(pad, dtype=np.int32)]),
-                unique_crashes=np.concatenate(
-                    [res.unique_crashes, np.zeros(pad, dtype=bool)]),
-                unique_hangs=np.concatenate(
-                    [res.unique_hangs, np.zeros(pad, dtype=bool)]),
-                exit_codes=np.concatenate(
-                    [res.exit_codes, np.zeros(pad, dtype=np.int32)]))
+        if pad_to is not None:
+            from .base import pad_batch_result
+            res = pad_batch_result(res, pad_to)
         return res
 
     # -- single-exec shim ----------------------------------------------

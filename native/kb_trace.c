@@ -943,6 +943,9 @@ static int kb_untracer_loop(pid_t pid, int *newcov) {
   uintptr_t last_pc = 0;
   *newcov = 0;
   kb_nfired = 0;
+  kb_fired_overflow = 0; /* stale overflow from an exec whose re-run
+                          * SUCCEEDED must not make a later failed
+                          * re-run re-arm every disarmed leader */
   for (;;) {
     if (ptrace(PTRACE_CONT, pid, NULL, (void *)(uintptr_t)deliver) != 0) {
       waitpid(pid, &status, __WALL); /* vanished (hang-timeout kill) */

@@ -365,15 +365,14 @@ def test_mesh_superbatch_matches_sequential_steps():
     sb, sl = seed_arrays()
     B, K = 32, 3
 
+    from killerbeez_tpu.instrumentation.base import pack_verdicts
     s = sharded_state_init(mesh, prog.map_size)
     seq = []
     for j in range(K):
         s, st, rets, uc, uh, ec, bufs, lens, _c = step(s, sb, sl,
                                                        j * B)
-        pk = (np.asarray(st).astype(np.uint8)
-              | (np.asarray(rets).astype(np.uint8) << 3)
-              | (np.asarray(uc).astype(np.uint8) << 5)
-              | (np.asarray(uh).astype(np.uint8) << 6))
+        pk = pack_verdicts(np.asarray(st), np.asarray(rets),
+                           np.asarray(uc), np.asarray(uh))
         seq.append((pk, np.asarray(bufs), np.asarray(lens)))
 
     s2 = sharded_state_init(mesh, prog.map_size)

@@ -95,6 +95,7 @@ for f in FIELDS:
 # (b2) K-step superbatch path (lax.scan over the fused kernel, the
 # CLI default): must compile on-chip and match K sequential fused
 # steps bit-for-bit through the instrumentation layer
+from killerbeez_tpu.instrumentation.base import pack_verdicts
 from killerbeez_tpu.instrumentation.factory import instrumentation_factory
 from killerbeez_tpu.mutators.factory import mutator_factory
 import json as _json
@@ -114,10 +115,10 @@ pk = np.asarray(packed)
 for j in range(K):
     r1, b1, l1, _ = i1.run_batch_fused(m1, m1.peek_iterations(B))
     m1.advance(B)
-    ref_pk = (np.asarray(r1.statuses).astype(np.uint8)
-              | (np.asarray(r1.new_paths).astype(np.uint8) << 3)
-              | (np.asarray(r1.unique_crashes).astype(np.uint8) << 5)
-              | (np.asarray(r1.unique_hangs).astype(np.uint8) << 6))
+    ref_pk = pack_verdicts(np.asarray(r1.statuses),
+                           np.asarray(r1.new_paths),
+                           np.asarray(r1.unique_crashes),
+                           np.asarray(r1.unique_hangs))
     if not (np.array_equal(pk[j], ref_pk)
             and np.array_equal(np.asarray(mbufs[j]), np.asarray(b1))):
         print(_json.dumps({"error": f"superbatch step {j} diverged "

@@ -247,11 +247,12 @@ def bench_cli_product(target, batch, steps, seed):
     out = os.path.join(REPO, "bench_out", "cli_product")
     shutil.rmtree(out, ignore_errors=True)
     fz = Fuzzer(drv, output_dir=out, batch_size=batch)
-    # warmup must cover BOTH compiled paths: the per-batch step AND
-    # the K-step superbatch (engaged once the run is deep enough),
-    # plus the feedback-cadence alignment — 2 batches would leave the
-    # _fused_fuzz_multi compile inside the timed window
-    fz.run((2 * fz.ACCUMULATE_AUTO + 2) * batch)
+    # warmup must cover BOTH compiled paths (per-batch step + K-step
+    # superbatch) AND end on a K boundary: a misaligned batch counter
+    # would route the first timed batches through the per-batch path
+    # (gap < K in _run_batched), mixing per-batch transfers into a
+    # window labeled as the superbatch config
+    fz.run(3 * fz.ACCUMULATE_AUTO * batch)
     done = fz.stats.iterations             # run(n) targets a TOTAL
     t0 = time.time()
     fz.run(done + batch * steps)
@@ -375,13 +376,13 @@ def main():
              error=str(e)[:200])
 
     try:
-        # 32k lanes/batch: fewer host round-trips per exec — the
-        # tunnel's RTT fluctuates and this is the config least
-        # hostage to it (939k measured healthy, ~400k degraded)
-        vc_, st = bench_cli_product("tlvstack_vm", 32768, 40,
+        # 64k lanes/batch + K=8 superbatch: the config that saturates
+        # the kernel rate through the CLI (1.82M measured; 32k
+        # batches read 1.3-1.6M depending on tunnel state)
+        vc_, st = bench_cli_product("tlvstack_vm", 65536, 32,
                                     targets_cgc.tlvstack_vm_seed())
         emit("4d", "PRODUCT CLI loop (file+jit_harness+havoc, "
-             "pallas_fused) on tlvstack_vm", vc_,
+             "pallas_fused, -b 65536 -K 8) on tlvstack_vm", vc_,
              baseline=FORKSERVER_BASELINE, new_paths=st.new_paths)
     except Exception as e:
         emit("4d", "product CLI loop unavailable", 0.0, ok=False,

@@ -39,6 +39,17 @@ def emit(config, metric, value, unit="execs/sec", baseline=None, **kw):
     return row
 
 
+def stage_split_row(fz):
+    """{stage: fraction} for a finished Fuzzer config, plus the
+    human-readable summary line on stderr (so the JSON stream stays
+    machine-parseable)."""
+    split = fz.telemetry.registry.stage_split()
+    line = fz.telemetry.stage_summary()
+    if line:
+        print(f"  [{line}]", file=sys.stderr, flush=True)
+    return {s: round(f, 4) for s, f in split.items()}
+
+
 def build_corpus():
     from killerbeez_tpu.native.build import build_native
     if not build_native():
@@ -85,34 +96,36 @@ def bench_host_configs():
             t0 = time.time()
             stats = fz.run(done + n_iters)
             return ((stats.iterations - done) / (time.time() - t0),
-                    stats, stats.crashes - warm_crashes)
+                    stats, stats.crashes - warm_crashes, fz)
         finally:
             if drv is not None:
                 drv.cleanup()
             instr.cleanup()
 
     # config 1: file + return_code + bit_flip -n 20 (smoke_test.sh:41-70)
-    v, stats, _ = run_config(
+    v, stats, _, fz = run_config(
         20, 20, "return_code", None, "file",
         json.dumps({"path": test_bin, "arguments": "@@"}), "c1")
     emit(1, "file+return_code+bit_flip 20 iters", v, baseline=180.0,
-         iterations=stats.iterations)
+         iterations=stats.iterations,
+         stage_split=stage_split_row(fz))
 
     # config 2: stdin + afl(forkserver) + havoc, single instance
-    v, stats, crashes = run_config(
+    v, stats, crashes, fz = run_config(
         2000, 500, "afl", None, "stdin",
         json.dumps({"path": test_bin}), "c2", warmup=500)
     emit(2, "stdin+afl forkserver, 1 instance", v,
-         baseline=FORKSERVER_BASELINE, crashes=crashes)
+         baseline=FORKSERVER_BASELINE, crashes=crashes,
+         stage_split=stage_split_row(fz))
 
     # config 3: TPU-batch mutation + host forkserver pool
     workers = os.cpu_count() or 1
-    v, stats, crashes = run_config(
+    v, stats, crashes, fz = run_config(
         8192, 2048, "afl", json.dumps({"workers": workers}), "stdin",
         json.dumps({"path": test_bin}), "c3", warmup=2048)
     emit(3, f"tpu-batch mutate + forkserver pool x{workers}", v,
          baseline=FORKSERVER_BASELINE, host_cores=workers,
-         crashes=crashes)
+         crashes=crashes, stage_split=stage_split_row(fz))
 
 
 
@@ -223,12 +236,14 @@ def bench_device_fused(target, batch, steps, seed):
     return _time_fuzz_loop(fuzz_step, batch, steps)
 
 
-def bench_cli_product(target, batch, steps, seed):
+def bench_cli_product(target, batch, steps, seed, telemetry=None,
+                      out_name="cli_product", engine="pallas_fused"):
     """Config 4d: the PRODUCT path — the ordinary Fuzzer loop (what
     `python -m killerbeez_tpu.fuzzer file jit_harness havoc` runs)
     with engine=pallas_fused, measured post-warmup.  The flagship
     bench number must be reproducible here or it's a bench artifact
-    (round-2 verdict item 1)."""
+    (round-2 verdict item 1).  ``telemetry`` passes through to the
+    Fuzzer (None = default sink on, False = --no-stats)."""
     import shutil
     import json as _json
     from killerbeez_tpu.drivers.factory import driver_factory
@@ -240,13 +255,14 @@ def bench_cli_product(target, batch, steps, seed):
 
     instr = instrumentation_factory(
         "jit_harness", _json.dumps({
-            "target": target, "engine": "pallas_fused",
+            "target": target, "engine": engine,
             "novelty": "throughput"}))
     mut = mutator_factory("havoc", '{"seed": 3}', seed)
     drv = driver_factory("file", None, instr, mut)
-    out = os.path.join(REPO, "bench_out", "cli_product")
+    out = os.path.join(REPO, "bench_out", out_name)
     shutil.rmtree(out, ignore_errors=True)
-    fz = Fuzzer(drv, output_dir=out, batch_size=batch)
+    fz = Fuzzer(drv, output_dir=out, batch_size=batch,
+                telemetry=telemetry)
     # warmup must cover BOTH compiled paths (per-batch step + K-step
     # superbatch) AND end on a K boundary: a misaligned batch counter
     # would route the first timed batches through the per-batch path
@@ -257,7 +273,35 @@ def bench_cli_product(target, batch, steps, seed):
     t0 = time.time()
     fz.run(done + batch * steps)
     dt = time.time() - t0
-    return (fz.stats.iterations - done) / dt, fz.stats
+    return (fz.stats.iterations - done) / dt, fz.stats, fz
+
+
+def bench_stats_overhead(batch=65536, steps=32, target="tlvstack_vm",
+                         engine="pallas_fused"):
+    """--stats-overhead: the flagship CLI config telemetry-ON
+    (default sink, 5s interval) vs --no-stats, emitted as one JSON
+    line so BENCH rounds track observability cost over time.  The
+    acceptance bar is <= 3% execs/s."""
+    from killerbeez_tpu.models import targets_cgc
+    seed = targets_cgc.tlvstack_vm_seed()
+    v_on, _, fz = bench_cli_product(target, batch, steps, seed,
+                                    telemetry=None,
+                                    out_name="overhead_on",
+                                    engine=engine)
+    split = stage_split_row(fz)
+    v_off, _, _ = bench_cli_product(target, batch, steps, seed,
+                                    telemetry=False,
+                                    out_name="overhead_off",
+                                    engine=engine)
+    overhead = (v_off - v_on) / v_off * 100.0 if v_off else 0.0
+    emit("stats-overhead",
+         f"telemetry on vs --no-stats ({target}, -b {batch}, "
+         f"{steps} steps, {engine})", v_on, unit="execs/sec",
+         no_stats_value=round(v_off, 1),
+         overhead_pct=round(overhead, 2),
+         within_3pct=bool(overhead <= 3.0),
+         stage_split=split)
+    return overhead
 
 
 def bench_multichip_smoke():
@@ -343,6 +387,16 @@ def bench_qemu_tier():
 def main():
     from killerbeez_tpu.models import targets_cgc
 
+    if "--stats-overhead" in sys.argv[1:]:
+        # standalone observability-cost mode: optional trailing args
+        # override batch/steps (CPU verification uses small shapes)
+        rest = [a for a in sys.argv[1:] if a != "--stats-overhead"]
+        batch = int(rest[0]) if rest else 65536
+        steps = int(rest[1]) if len(rest) > 1 else 32
+        engine = rest[2] if len(rest) > 2 else "pallas_fused"
+        bench_stats_overhead(batch=batch, steps=steps, engine=engine)
+        return 0
+
     if build_corpus():
         try:
             bench_host_configs()
@@ -379,11 +433,12 @@ def main():
         # 64k lanes/batch + K=8 superbatch: the config that saturates
         # the kernel rate through the CLI (1.82M measured; 32k
         # batches read 1.3-1.6M depending on tunnel state)
-        vc_, st = bench_cli_product("tlvstack_vm", 65536, 32,
-                                    targets_cgc.tlvstack_vm_seed())
+        vc_, st, fz = bench_cli_product("tlvstack_vm", 65536, 32,
+                                        targets_cgc.tlvstack_vm_seed())
         emit("4d", "PRODUCT CLI loop (file+jit_harness+havoc, "
              "pallas_fused, -b 65536 -K 8) on tlvstack_vm", vc_,
-             baseline=FORKSERVER_BASELINE, new_paths=st.new_paths)
+             baseline=FORKSERVER_BASELINE, new_paths=st.new_paths,
+             stage_split=stage_split_row(fz))
     except Exception as e:
         emit("4d", "product CLI loop unavailable", 0.0, ok=False,
              error=str(e)[:200])

@@ -12,6 +12,7 @@ instrumentation support batching.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Dict, List, NamedTuple, Optional
 
 import numpy as np
@@ -35,6 +36,15 @@ class Driver:
     OPTION_SCHEMA: Dict[str, type] = {}
     OPTION_DESCS: Dict[str, str] = {}
     DEFAULTS: Dict[str, Any] = {}
+
+    #: telemetry StageTimer installed by the Fuzzer; drivers time the
+    #: mutate/execute boundary with it (dispatch-side only — device
+    #: calls return lazy arrays, so no sync is forced here)
+    stage_timer = None
+
+    def _span(self, stage: str):
+        t = self.stage_timer
+        return t(stage) if t is not None else contextlib.nullcontext()
 
     def __init__(self, options: Optional[str],
                  instrumentation: Instrumentation,
@@ -120,16 +130,18 @@ class Driver:
             # mutator's lanes inside the VM kernel (bit-identical
             # candidates, no HBM round-trip between mutate and exec)
             its = self.mutator.peek_iterations(n)
-            result, bufs, lens, compact = \
-                self.instrumentation.run_batch_fused(
-                    self.mutator, its, pad_to=pad_to)
+            with self._span("execute"):     # mutation is in-kernel
+                result, bufs, lens, compact = \
+                    self.instrumentation.run_batch_fused(
+                        self.mutator, its, pad_to=pad_to)
             self.mutator.advance(n)
             if n > 0:
                 self._last_batch_tail = (bufs, lens, n - 1)
                 self.last_input = None
             return BatchOutcome(result=result, inputs=bufs,
                                 lengths=lens, compact=compact)
-        bufs, lens = self.mutator.mutate_batch(n)
+        with self._span("mutate"):
+            bufs, lens = self.mutator.mutate_batch(n)
         if self.instrumentation.device_backed:
             if pad_to is not None and pad_to > n:
                 # keep lazy device arrays lazy (np.concatenate would
@@ -142,7 +154,8 @@ class Driver:
                 bufs = xp.concatenate(
                     [bufs, xp.repeat(bufs[:1], pad, axis=0)], axis=0)
                 lens = xp.concatenate([lens, xp.repeat(lens[:1], pad)])
-            result = self.instrumentation.run_batch(bufs, lens)
+            with self._span("execute"):
+                result = self.instrumentation.run_batch(bufs, lens)
         else:
             # idempotent per target key; re-binds if a single exec
             # rebuilt the instrumentation's target in between
@@ -150,10 +163,13 @@ class Driver:
             # generate the NEXT batch now: its device->host copies
             # land while this batch's target processes execute
             if prefetch_next:
-                self.mutator.prefetch_batch(
-                    n if prefetch_next is True else int(prefetch_next))
-            result = self.instrumentation.run_batch(bufs, lens,
-                                                    pad_to=pad_to)
+                with self._span("mutate"):
+                    self.mutator.prefetch_batch(
+                        n if prefetch_next is True
+                        else int(prefetch_next))
+            with self._span("execute"):
+                result = self.instrumentation.run_batch(bufs, lens,
+                                                        pad_to=pad_to)
         if n > 0:
             # defer materialization (get_last_input slices on demand):
             # .tobytes() here would sync the host to this batch and
@@ -183,9 +199,10 @@ class Driver:
         (packed[k, B], bufs[k, B, L], lens[k, B], stacked compact) —
         the Fuzzer loop owns slicing them into per-step triage."""
         its = self.mutator.peek_iterations(n)
-        packed, bufs, lens, compact = \
-            self.instrumentation.run_batch_fused_multi(
-                self.mutator, its, k, pad_to=n)
+        with self._span("execute"):
+            packed, bufs, lens, compact = \
+                self.instrumentation.run_batch_fused_multi(
+                    self.mutator, its, k, pad_to=n)
         self.mutator.advance(k * n)
         if n > 0:
             self._last_batch_tail = (bufs[k - 1], lens[k - 1], n - 1)

@@ -68,6 +68,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "details next to the repro (host targets)")
     p.add_argument("-b", "--batch-size", type=int, default=1024,
                    help="candidates per device step (batched backends)")
+    p.add_argument("--no-stats", action="store_true",
+                   help="disable the periodic campaign stats files "
+                        "(fuzzer_stats / plot_data / stats.jsonl in "
+                        "-o; counters still accumulate in-process)")
+    p.add_argument("--stats-interval", type=float, default=5.0,
+                   help="seconds between stats-file snapshots "
+                        "(default 5)")
     p.add_argument("-K", "--accumulate", type=int, default=0,
                    help="fused device path: accumulate K batches "
                         "per device dispatch so the host pulls one "
@@ -147,13 +154,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                         batch_size=args.batch_size,
                         debug_triage=args.debug_triage,
                         feedback=args.feedback,
-                        accumulate=args.accumulate)
+                        accumulate=args.accumulate,
+                        telemetry=(False if args.no_stats else None),
+                        stats_interval=args.stats_interval)
         stats = fuzzer.run(args.iterations)
+        # both rates read the SAME registry the loop recorded into —
+        # the CLI never recomputes from its own wall clock
         INFO_MSG(
             "results: %d crashes (%d unique), %d hangs (%d unique), "
-            "%d new paths",
+            "%d new paths; %.0f execs/s lifetime (%.0f recent)",
             stats.crashes, stats.unique_crashes, stats.hangs,
-            stats.unique_hangs, stats.new_paths)
+            stats.unique_hangs, stats.new_paths, stats.execs_per_sec,
+            stats.execs_per_sec_ema)
 
         # state dumps on exit (reference fuzzer/main.c:426-447)
         if args.instrumentation_state_dump:

@@ -12,14 +12,13 @@ reference-shaped scalar loop.
 from __future__ import annotations
 
 import os
-import time
-from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, Optional, Union
 
 import numpy as np
 
 from .. import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_NONE
 from ..drivers.base import Driver
+from ..telemetry import MetricsRegistry, Telemetry
 from ..utils.fileio import ensure_dir, md5_hex, write_buffer_to_file
 from ..utils.logging import CRITICAL_MSG, DEBUG_MSG, INFO_MSG, WARNING_MSG
 
@@ -62,19 +61,67 @@ class _LazyRow:
         return r.astype(dtype) if dtype is not None else r
 
 
-@dataclass
 class FuzzStats:
-    iterations: int = 0
-    crashes: int = 0
-    hangs: int = 0
-    new_paths: int = 0
-    unique_crashes: int = 0
-    unique_hangs: int = 0
-    errors: int = 0
-    elapsed: float = 0.0
+    """Thin live view over the telemetry ``MetricsRegistry`` — the
+    registry is the single source of truth, so the loop, the CLI, the
+    stats files and the manager heartbeat can never disagree about
+    counts or rates (they used to: the loop accumulated per-step
+    elapsed while callers recomputed rate from their own wall
+    clocks).  Field reads/writes map straight onto registry counters;
+    ``iterations`` is the registry's ``execs`` series (AFL naming on
+    the wire, reference naming in code)."""
+
+    _FIELD_TO_SERIES = {
+        "iterations": "execs", "crashes": "crashes", "hangs": "hangs",
+        "new_paths": "new_paths", "unique_crashes": "unique_crashes",
+        "unique_hangs": "unique_hangs", "errors": "errors",
+    }
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._reg = registry if registry is not None \
+            else MetricsRegistry()
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self._reg
+
+    @property
+    def elapsed(self) -> float:
+        """Accumulated in-run wall time (sum of ``run()`` windows,
+        not campaign age — warm-up gaps between runs don't count)."""
+        return self._reg.active_seconds()
+
+    @property
+    def execs_per_sec(self) -> float:
+        """Lifetime rate over in-run time."""
+        return self._reg.execs_per_sec()
+
+    @property
+    def execs_per_sec_ema(self) -> float:
+        """Recent rate (EMA over the registry's horizon)."""
+        return self._reg.execs_per_sec_ema()
 
     def as_dict(self) -> Dict[str, float]:
-        return dict(self.__dict__)
+        d: Dict[str, float] = {f: getattr(self, f)
+                               for f in self._FIELD_TO_SERIES}
+        d["elapsed"] = self.elapsed
+        d["execs_per_sec"] = self.execs_per_sec
+        d["execs_per_sec_ema"] = self.execs_per_sec_ema
+        return d
+
+
+def _stat_field(series: str) -> property:
+    def _get(self: FuzzStats) -> int:
+        return int(self._reg.counters.get(series, 0))
+
+    def _set(self: FuzzStats, v: int) -> None:
+        self._reg.counters[series] = int(v)
+
+    return property(_get, _set)
+
+
+for _f, _s in FuzzStats._FIELD_TO_SERIES.items():
+    setattr(FuzzStats, _f, _stat_field(_s))
 
 
 class Fuzzer:
@@ -106,12 +153,32 @@ class Fuzzer:
     def __init__(self, driver: Driver, output_dir: str = "output",
                  batch_size: int = 1024, write_findings: bool = True,
                  debug_triage: bool = False, feedback: int = -1,
-                 accumulate: int = 0):
+                 accumulate: int = 0,
+                 telemetry: Union[Telemetry, bool, None] = None,
+                 stats_interval: float = 5.0):
         self.driver = driver
         self.output_dir = output_dir
         self.batch_size = int(batch_size)
         self.write_findings = write_findings
         self.debug_triage = debug_triage
+        # observability: the registry ALWAYS runs (FuzzStats is a view
+        # over it); ``telemetry=False`` (CLI --no-stats) only disables
+        # the periodic fuzzer_stats/plot_data/stats.jsonl file sink.
+        # The default follows write_findings: a no-artifacts run
+        # (bench timing loops, library callers) must not grow a new
+        # filesystem side effect; telemetry=True forces the sink on.
+        if telemetry is None:
+            telemetry = Telemetry(
+                output_dir if write_findings else None,
+                interval_s=stats_interval)
+        elif telemetry is True:
+            telemetry = Telemetry(output_dir, interval_s=stats_interval)
+        elif telemetry is False:
+            telemetry = Telemetry(None)
+        self.telemetry = telemetry
+        # drivers time their mutate/execute phases with the loop's
+        # stage timer (base.Driver.test_batch)
+        driver.stage_timer = telemetry.timer
         if feedback < 0:
             mut = getattr(driver, "mutator", None)
             randomized = (mut is not None
@@ -140,7 +207,7 @@ class Fuzzer:
         import random as _random
         self._fb_rng = _random.Random(0x6b62)  # deterministic splices
         self._dbg = None
-        self.stats = FuzzStats()
+        self.stats = FuzzStats(telemetry.registry)
         self._seen = {k: set() for k in ("crashes", "hangs", "new_paths")}
         if write_findings:
             for sub in ("crashes", "hangs", "new_paths"):
@@ -158,7 +225,8 @@ class Fuzzer:
         if self.write_findings:
             if os.path.exists(path):  # left over from a previous run
                 return False
-            write_buffer_to_file(path, buf)
+            with self.telemetry.timer("fs_write"):
+                write_buffer_to_file(path, buf)
             CRITICAL_MSG("Found a %s! Saving result to %s",
                          kind.rstrip("es") if kind != "crashes"
                          else "crash", path)
@@ -228,7 +296,10 @@ class Fuzzer:
             WARNING_MSG("target exec error on iteration %d", s.iterations)
         if new_path > 0:
             s.new_paths += 1
+            reg = self.telemetry.registry
+            reg.rate("new_paths", 1)
             recorded = self._record("new_paths", buf)
+            reg.gauge("corpus_size", len(self._seen["new_paths"]))
             # corpus feedback keeps only EDGE-novel findings (ret 2:
             # a brand-new edge, not just a new hit-count bucket) —
             # bucket-only findings are overwhelmingly shallow
@@ -254,14 +325,23 @@ class Fuzzer:
     def run(self, n_iterations: int = -1) -> FuzzStats:
         """Run ``n_iterations`` executions (-1 = until the mutator
         exhausts). Uses the batched path when available."""
-        start = time.time()
-        if self.driver.supports_batch:
-            self._run_batched(n_iterations)
-        else:
-            self._run_single(n_iterations)
-        self.stats.elapsed = time.time() - start
-        INFO_MSG("Ran %d iterations in %.1f seconds",
-                 self.stats.iterations, self.stats.elapsed)
+        if self.stats.iterations == 0:
+            # baseline snapshot: plot_data's first row is all-zero so
+            # the sum of row deltas equals the cumulative counters
+            self.telemetry.flush()
+        self.telemetry.registry.run_started()
+        try:
+            if self.driver.supports_batch:
+                self._run_batched(n_iterations)
+            else:
+                self._run_single(n_iterations)
+        finally:
+            self.telemetry.registry.run_ended()
+            self.telemetry.flush()
+        INFO_MSG("Ran %d iterations in %.1f seconds "
+                 "(%.0f execs/s lifetime, %.0f recent)",
+                 self.stats.iterations, self.stats.elapsed,
+                 self.stats.execs_per_sec, self.stats.execs_per_sec_ema)
         return self.stats
 
     def _remaining(self, n_iterations: int) -> int:
@@ -299,51 +379,67 @@ class Fuzzer:
         batch actually has interesting lanes."""
         self._credit_arm = arm
         res = out.result
+        timer = self.telemetry.timer
         if packed is not None:
             from ..instrumentation.base import unpack_verdicts
-            pk = np.asarray(packed)          # prefetched: cache hit
+            with timer("host_transfer"):
+                pk = np.asarray(packed)      # prefetched: cache hit
             statuses, new_paths, uc, uh = unpack_verdicts(pk)
             statuses = statuses.astype(np.int32)
         else:
-            statuses = np.asarray(res.statuses)
-            new_paths = np.asarray(res.new_paths)
+            # host-backed results are already numpy (instant); device
+            # results without a prefetched pack block here — exactly
+            # the wait this stage exists to expose
+            with timer("host_transfer"):
+                statuses = np.asarray(res.statuses)
+                new_paths = np.asarray(res.new_paths)
             uc = uh = None
         interesting = np.flatnonzero(
             (statuses[:room] != FUZZ_NONE) | (new_paths[:room] > 0))
         if len(interesting):
-            rows = None
-            if out.compact is not None:
-                rows = self._compact_rows(out.compact)
-                if rows is not None:
-                    inputs = np.asarray(out.compact.bufs)
-                    lengths = np.asarray(out.compact.lens)
-            if rows is None:                 # full pull (host results,
-                inputs = np.asarray(out.inputs)   # or compact overflow)
-                lengths = np.asarray(out.lengths)
-            if uc is None:
-                uc = np.asarray(res.unique_crashes)
-                uh = np.asarray(res.unique_hangs)
-            for i in interesting:
-                if rows is not None:
-                    r = rows.get(int(i))
-                    if r is None:
-                        # device-side interesting predicate drifted
-                        # from the host one; don't lose the rest of
-                        # the pipelined drain — fall back to the full
-                        # candidate tensors for this batch
-                        WARNING_MSG(
-                            "compact report missing lane %d; pulling "
-                            "full batch", int(i))
-                        inputs = np.asarray(out.inputs)
-                        lengths = np.asarray(out.lengths)
-                        rows = None
-                        r = i
-                else:
-                    r = i
-                buf = inputs[r, :int(lengths[r])].tobytes()
-                self._triage_lane(int(statuses[i]), int(new_paths[i]),
-                                  buf, bool(uc[i]), bool(uh[i]))
+            with timer("triage"):
+                self._triage_interesting(out, interesting, statuses,
+                                         new_paths, uc, uh)
         DEBUG_MSG("batch done: %d iterations total", done_through)
+
+    def _triage_interesting(self, out, interesting, statuses,
+                            new_paths, uc, uh) -> None:
+        """Pull and record the interesting lanes of one batch (the
+        ``triage`` stage: compact-report reads, lane gathers, dedup +
+        finding writes)."""
+        res = out.result
+        rows = None
+        if out.compact is not None:
+            rows = self._compact_rows(out.compact)
+            if rows is not None:
+                inputs = np.asarray(out.compact.bufs)
+                lengths = np.asarray(out.compact.lens)
+        if rows is None:                 # full pull (host results,
+            inputs = np.asarray(out.inputs)   # or compact overflow)
+            lengths = np.asarray(out.lengths)
+        if uc is None:
+            uc = np.asarray(res.unique_crashes)
+            uh = np.asarray(res.unique_hangs)
+        for i in interesting:
+            if rows is not None:
+                r = rows.get(int(i))
+                if r is None:
+                    # device-side interesting predicate drifted
+                    # from the host one; don't lose the rest of
+                    # the pipelined drain — fall back to the full
+                    # candidate tensors for this batch
+                    WARNING_MSG(
+                        "compact report missing lane %d; pulling "
+                        "full batch", int(i))
+                    inputs = np.asarray(out.inputs)
+                    lengths = np.asarray(out.lengths)
+                    rows = None
+                    r = i
+            else:
+                r = i
+            buf = inputs[r, :int(lengths[r])].tobytes()
+            self._triage_lane(int(statuses[i]), int(new_paths[i]),
+                              buf, bool(uc[i]), bool(uh[i]))
 
     # batches kept in flight before results are pulled to the host:
     # device backends return LAZY arrays, so later batches' work is
@@ -522,6 +618,10 @@ class Fuzzer:
                             self._active_entry))
             if len(pending) >= depth:
                 self._triage_batch(*pending.popleft())
+        reg = self.telemetry.registry
+        reg.rate("execs", b * k)
+        reg.gauge("pipeline_depth", len(pending))
+        self.telemetry.maybe_flush()
 
     def _drain_ready(self, pending) -> None:
         """Triage every leading pending batch whose device results are
@@ -583,14 +683,16 @@ class Fuzzer:
                     # copy has had a cadence of compute time to land
                     # (a finding-free campaign then pays ~nothing per
                     # boundary instead of a fresh-transfer RTT)
-                    self._drain_ready(pending)
-                    if (not self._corpus and pending
-                            and self.stats.iterations - pending[0][2]
-                            >= self.feedback * self.batch_size):
-                        self._triage_batch(*pending.popleft())
-                    self._credit_period()
-                    if self._corpus:
-                        self._rotate_seed(mut)
+                    with self.telemetry.timer("corpus_feedback"):
+                        self._drain_ready(pending)
+                        if (not self._corpus and pending
+                                and self.stats.iterations
+                                - pending[0][2]
+                                >= self.feedback * self.batch_size):
+                            self._triage_batch(*pending.popleft())
+                        self._credit_period()
+                        if self._corpus:
+                            self._rotate_seed(mut)
                 # K-step accumulation may not stride over a feedback
                 # rotation boundary (the check above only fires at
                 # loop top): engage only when the next boundary is at
@@ -629,6 +731,10 @@ class Fuzzer:
                                 packed, self._active_entry))
                 if len(pending) >= depth:
                     self._triage_batch(*pending.popleft())
+                reg = self.telemetry.registry
+                reg.rate("execs", room)
+                reg.gauge("pipeline_depth", len(pending))
+                self.telemetry.maybe_flush()
         finally:
             # findings in already-executed batches must survive an
             # interrupt (Ctrl-C on an infinite run) or a raise
@@ -643,20 +749,26 @@ class Fuzzer:
         if rotate_every and self._base_seed is None and \
                 getattr(mut, "seed_bytes", None):
             self._base_seed = mut.seed_bytes
+        reg = self.telemetry.registry
         while self._remaining(n_iterations) > 0:
             if (rotate_every and self.stats.iterations
                     and self.stats.iterations % rotate_every == 0):
-                self._credit_period()
-                if self._corpus:
-                    self._rotate_seed(mut)
-            result = self.driver.test_next_input()
+                with self.telemetry.timer("corpus_feedback"):
+                    self._credit_period()
+                    if self._corpus:
+                        self._rotate_seed(mut)
+            with self.telemetry.timer("execute"):
+                result = self.driver.test_next_input()
             if result is None:  # mutator exhausted (reference -2)
                 INFO_MSG("mutator exhausted after %d iterations",
                          self.stats.iterations)
                 break
             self.stats.iterations += 1
+            reg.rate("execs", 1)
             buf = self.driver.get_last_input() or b""
             self._credit_arm = self._active_entry
-            self._triage_lane(result, instr.is_new_path(), buf,
-                              instr.last_unique_crash(),
-                              instr.last_unique_hang())
+            with self.telemetry.timer("triage"):
+                self._triage_lane(result, instr.is_new_path(), buf,
+                                  instr.last_unique_crash(),
+                                  instr.last_unique_hang())
+            self.telemetry.maybe_flush()

@@ -23,6 +23,8 @@ plus the work-queue routes that replace BOINC's scheduler
     POST /api/minimize               {target_id} -> {working_set}
     POST /api/work/claim             {worker} -> job+cmdline | 204
     POST /api/work/<id>/finish       {status, mutator_state?}
+    POST /api/stats/<campaign>       {worker, snapshot}  (heartbeat)
+    GET  /api/stats/<campaign>       -> {merged, workers, n_workers}
 """
 
 from __future__ import annotations
@@ -35,6 +37,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from ..telemetry import merge
 from ..tools.minimize import greedy_edge_cover
 from ..utils.logging import INFO_MSG
 from .db import ManagerDB
@@ -193,6 +196,27 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(200, {"working_set": kept,
                          "total_inputs": len(info)})
 
+    def h_stats(self, query, campaign):
+        """Worker heartbeat sink + fleet view: POST stores one
+        worker's cumulative registry snapshot (latest wins), GET
+        returns the telemetry.aggregate merge of every worker's
+        newest snapshot plus per-worker freshness — the
+        afl-whatsup-style campaign rollup."""
+        if self.command == "POST":
+            b = self._body()
+            self.db.upsert_campaign_stats(
+                campaign, b.get("worker", "anon"), b["snapshot"])
+            self._json(201, {"ok": True})
+            return
+        rows = self.db.get_campaign_stats(campaign)
+        self._json(200, {
+            "campaign": campaign,
+            "n_workers": len(rows),
+            "workers": {r["worker"]: {"updated": r["updated"]}
+                        for r in rows},
+            "merged": merge([r["snapshot"] for r in rows]),
+        })
+
     def h_work_claim(self, query):
         b = self._body()
         job = self.db.claim_job(b.get("worker", "anon"))
@@ -227,6 +251,8 @@ _ROUTES: Tuple = (
     (r"/api/state", {"POST": _Handler.h_state_collection}),
     (r"/api/state/(\d+)", {"GET": _Handler.h_state}),
     (r"/api/tracer_info", {"POST": _Handler.h_tracer_info}),
+    (r"/api/stats/([\w.-]+)", {"GET": _Handler.h_stats,
+                               "POST": _Handler.h_stats}),
     (r"/api/minimize", {"POST": _Handler.h_minimize}),
     (r"/api/work/claim", {"POST": _Handler.h_work_claim}),
     (r"/api/work/(\d+)/finish", {"POST": _Handler.h_work_finish}),

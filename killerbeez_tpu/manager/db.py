@@ -78,6 +78,14 @@ CREATE TABLE IF NOT EXISTS tracer_info (
     edges TEXT NOT NULL,          -- JSON list of edge ids
     UNIQUE(target_id, input_file)
 );
+CREATE TABLE IF NOT EXISTS campaign_stats (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    campaign TEXT NOT NULL,       -- campaign key (job id by default)
+    worker TEXT NOT NULL,
+    snapshot TEXT NOT NULL,       -- telemetry registry snapshot JSON
+    updated REAL NOT NULL,
+    UNIQUE(campaign, worker)      -- latest heartbeat per worker
+);
 """
 
 
@@ -292,6 +300,28 @@ class ManagerDB:
         return self._rows(
             "SELECT * FROM instrumentation_state WHERE target_id = ?",
             (target_id,))
+
+    # -- campaign stats (worker heartbeat snapshots) -------------------
+
+    def upsert_campaign_stats(self, campaign: str, worker: str,
+                              snapshot: Dict[str, Any]) -> None:
+        """Latest-wins per (campaign, worker): heartbeats carry full
+        cumulative snapshots, so only the newest matters."""
+        self._exec(
+            "INSERT INTO campaign_stats (campaign, worker, snapshot, "
+            "updated) VALUES (?,?,?,?) ON CONFLICT(campaign, worker) "
+            "DO UPDATE SET snapshot=excluded.snapshot, "
+            "updated=excluded.updated",
+            (str(campaign), worker, json.dumps(snapshot), time.time()))
+
+    def get_campaign_stats(self, campaign: str
+                           ) -> List[Dict[str, Any]]:
+        rows = self._rows(
+            "SELECT worker, snapshot, updated FROM campaign_stats "
+            "WHERE campaign = ? ORDER BY worker", (str(campaign),))
+        for r in rows:
+            r["snapshot"] = json.loads(r["snapshot"])
+        return rows
 
     # -- tracer info / minimization ------------------------------------
 

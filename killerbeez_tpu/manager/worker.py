@@ -20,7 +20,9 @@ import shlex
 import subprocess
 import sys
 import tempfile
+import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional
 
@@ -42,6 +44,79 @@ def _request(url: str, payload: Optional[Dict[str, Any]] = None,
             return None
         body = resp.read()
         return json.loads(body) if body else None
+
+
+def _request_retry(url: str, payload: Optional[Dict[str, Any]] = None,
+                   method: str = "POST", attempts: int = 5,
+                   base_delay: float = 0.5) -> Any:
+    """_request with exponential backoff on transport errors (manager
+    restarts, DCN blips): 0.5s, 1s, 2s, 4s between tries.  HTTP-level
+    errors (4xx/5xx with a response) are NOT retried — they mean the
+    manager saw the request and rejected it."""
+    last: Optional[Exception] = None
+    for attempt in range(attempts):
+        try:
+            return _request(url, payload, method)
+        except urllib.error.HTTPError:
+            raise
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            last = e
+            if attempt + 1 < attempts:
+                delay = base_delay * (2 ** attempt)
+                WARNING_MSG("request to %s failed (%s); retry in "
+                            "%.1fs", url, e, delay)
+                time.sleep(delay)
+    raise last  # type: ignore[misc]
+
+
+# the heartbeat's stats.jsonl tailer: O(1) tail window + torn-line
+# tolerance, shared with kb-stats (telemetry.sink)
+from ..telemetry import read_latest_snapshot  # noqa: E402
+
+
+class Heartbeat(threading.Thread):
+    """Progress reporter for one running job: every ``interval``
+    seconds, POST the job's latest telemetry snapshot to the
+    manager's ``/api/stats/<campaign>`` (retry-with-backoff; a dead
+    manager degrades to warnings — the fuzz run itself never stops
+    for observability)."""
+
+    def __init__(self, manager_url: str, campaign: str, worker: str,
+                 output_dir: str, interval: float = 5.0):
+        super().__init__(daemon=True)
+        self.url = f"{manager_url}/api/stats/{campaign}"
+        self.worker = worker
+        self.output_dir = output_dir
+        self.interval = interval
+        self._halt = threading.Event()
+        self.sent = 0
+
+    def beat(self) -> bool:
+        snap = read_latest_snapshot(self.output_dir)
+        if snap is None:
+            return False
+        try:
+            _request_retry(self.url,
+                           {"worker": self.worker, "snapshot": snap},
+                           attempts=3)
+            self.sent += 1
+            return True
+        except Exception as e:
+            WARNING_MSG("heartbeat to %s failed after retries: %s",
+                        self.url, e)
+            return False
+
+    def run(self) -> None:
+        while not self._halt.wait(self.interval):
+            self.beat()
+
+    def stop(self) -> None:
+        """Stop the loop and send one final snapshot (the job's
+        cumulative totals; makes short jobs visible even when they
+        finish inside the first interval)."""
+        self._halt.set()
+        self.join(timeout=self.interval + 1)
+        self.beat()
 
 
 def verify_repro(job: Dict[str, Any], content: bytes,
@@ -153,22 +228,34 @@ def assimilate(manager_url: str, job: Dict[str, Any],
 
 
 def run_job(manager_url: str, job: Dict[str, Any],
-            in_process: bool = False) -> str:
-    """Execute one claimed job; returns 'done' or 'failed'."""
+            in_process: bool = False, worker_name: str = "anon",
+            heartbeat_s: float = 5.0) -> str:
+    """Execute one claimed job; returns 'done' or 'failed'.  While
+    the fuzzer runs, a heartbeat thread tails its stats.jsonl and
+    POSTs progress snapshots to the manager (campaign key = job id),
+    so the fleet view updates DURING long campaigns, not just at
+    assimilation time."""
     with tempfile.TemporaryDirectory(prefix="kb_work_") as workdir:
         out_dir = os.path.join(workdir, "output")
         argv = shlex.split(job["cmdline"]) + ["-o", out_dir]
-        if in_process:
-            from ..fuzzer.cli import main as fuzzer_main
-            # strip the "python -m killerbeez_tpu.fuzzer" prefix
-            tail = argv[argv.index("killerbeez_tpu.fuzzer") + 1:] \
-                if "killerbeez_tpu.fuzzer" in argv else argv
-            rc = fuzzer_main(tail)
-        else:
-            rc = subprocess.run(argv).returncode
+        hb = Heartbeat(manager_url, str(job["id"]), worker_name,
+                       out_dir, interval=heartbeat_s)
+        hb.start()
+        try:
+            if in_process:
+                from ..fuzzer.cli import main as fuzzer_main
+                # strip the "python -m killerbeez_tpu.fuzzer" prefix
+                tail = argv[argv.index("killerbeez_tpu.fuzzer") + 1:] \
+                    if "killerbeez_tpu.fuzzer" in argv else argv
+                rc = fuzzer_main(tail)
+            else:
+                rc = subprocess.run(argv).returncode
+        finally:
+            hb.stop()
         status = "done" if rc == 0 else "failed"
         found = assimilate(manager_url, job, out_dir)
-        INFO_MSG("job %d %s: %d findings", job["id"], status, found)
+        INFO_MSG("job %d %s: %d findings, %d heartbeats",
+                 job["id"], status, found, hb.sent)
         return status
 
 
@@ -177,20 +264,21 @@ def work_loop(manager_url: str, worker_name: str, once: bool = False,
     """Claim-run-report until the queue drains (once) or forever."""
     done = 0
     while True:
-        job = _request(f"{manager_url}/api/work/claim",
-                       {"worker": worker_name})
+        job = _request_retry(f"{manager_url}/api/work/claim",
+                             {"worker": worker_name})
         if job is None:
             if once:
                 return done
             time.sleep(poll_s)
             continue
         try:
-            status = run_job(manager_url, job, in_process=in_process)
+            status = run_job(manager_url, job, in_process=in_process,
+                             worker_name=worker_name)
         except Exception as e:  # job must not wedge the worker
             WARNING_MSG("job %s failed: %s", job.get("id"), e)
             status = "failed"
-        _request(f"{manager_url}/api/work/{job['id']}/finish",
-                 {"status": status})
+        _request_retry(f"{manager_url}/api/work/{job['id']}/finish",
+                       {"status": status})
         done += 1
 
 

@@ -28,9 +28,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..drivers.base import BatchOutcome, Driver
 from ..instrumentation.base import BatchResult, CompactReport
+from ..telemetry import merge, merge_two
 from ..utils.logging import INFO_MSG
 from .distributed import (
     ShardedFuzzState, make_mesh, make_sharded_fuzz_step,
+    shard_stat_snapshots,
 )
 
 
@@ -104,6 +106,11 @@ class ShardedCampaignDriver(Driver):
                 spec),
             step=jnp.int32(0),
         )
+        #: accumulated mesh-wide stats: per-shard snapshots folded
+        #: through telemetry.aggregate each sync epoch (associative,
+        #: so per-epoch folds compose into the campaign total)
+        self.fleet_stats: dict = {}
+        self._host_step = 0   # mirrors state.step without device syncs
         INFO_MSG("sharded campaign: mesh dp=%d mp=%d, %d lanes/chip, "
                  "engine=%s", n_dp, n_mp, self.batch_per_device, engine)
 
@@ -133,6 +140,23 @@ class ShardedCampaignDriver(Driver):
         instr.virgin_crash = self.state.virgin_crash
         instr.virgin_tmout = self.state.virgin_tmout
         instr.total_execs += execs
+        # mesh telemetry fold: one merge of the dp shards' epoch
+        # snapshots, accumulated into the campaign view (host-side
+        # values only — never forces a device sync) and surfaced
+        # through the loop's registry so stats.jsonl / kb-stats show
+        # the mesh shape and shard clock alongside the loop counters
+        self._host_step += execs // max(self.batch_quantum, 1)
+        epoch = merge(shard_stat_snapshots(
+            self.mesh, execs // self.mesh.shape["dp"],
+            self._host_step))
+        if epoch is not None:
+            self.fleet_stats = merge_two(self.fleet_stats, epoch)
+            timer = self.stage_timer
+            if timer is not None:
+                for k, v in self.fleet_stats["gauges"].items():
+                    timer.reg.gauge(k, v)
+                timer.reg.gauge("mesh_dp", self.mesh.shape["dp"])
+                timer.reg.gauge("mesh_mp", self.mesh.shape["mp"])
         if n > 0:
             self._last_batch_tail = (bufs, lens, n - 1)
             self.last_input = None

@@ -28,8 +28,24 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:                                    # jax >= 0.6
+    from jax import shard_map as _shard_map_impl
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs,
+                               check_vma=check_vma)
+except ImportError:                     # jax 0.4.x: experimental home,
+    from jax.experimental.shard_map import (  # check_rep spelling
+        shard_map as _shard_map_impl,
+    )
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs,
+                               check_rep=check_vma)
 
 from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING, MAP_SIZE
 from ..instrumentation.base import pack_verdicts
@@ -40,6 +56,22 @@ from ..ops.sparse_coverage import (
     _first_occurrence_multi, stream_hash,
 )
 from ..ops.static_triage import counts_by_slot, make_static_maps
+
+
+def shard_stat_snapshots(mesh: Mesh, execs_per_shard: int,
+                         step: int) -> list:
+    """Per-dp-shard telemetry snapshots for one sync epoch, shaped
+    for ``telemetry.aggregate.merge``: each data-parallel shard
+    contributes its executed-lane count as a counter (summed by the
+    fold) and its step clock as a gauge (max'd — a straggling shard
+    shows up as a step gap in the merged view).  Host-side by
+    construction: every value here is already known to the host
+    without touching a device array, so the fold can run every epoch
+    without breaking the async pipeline."""
+    return [{"counters": {"execs": execs_per_shard},
+             "gauges": {"shard_step": step,
+                        "lanes_per_shard": execs_per_shard}}
+            for _ in range(mesh.shape["dp"])]
 
 
 def make_mesh(n_dp: int, n_mp: int = 1, devices=None) -> Mesh:

@@ -1,0 +1,71 @@
+"""Campaign observability subsystem.
+
+The reference fuzzer's only runtime signal is log lines plus a final
+stats struct (fuzzer/main.c prints iteration counts at exit); this
+package gives the port the AFL ecosystem's signals instead: a
+process-local metrics registry with stage timers (metrics.py),
+periodic AFL-compatible ``fuzzer_stats`` / ``plot_data`` /
+``stats.jsonl`` writers (sink.py), and an associative snapshot merge
+(aggregate.py) used by both the (dp, mp) mesh campaign fold and the
+manager's ``/api/stats/<campaign>`` fleet view.  ``kb-stats``
+(tools/stats_tui.py) renders either stream live.
+
+Typical wiring (the Fuzzer does this itself; ``telemetry=False``
+disables the file sink, the registry always runs):
+
+    tl = Telemetry(output_dir="output")
+    tl.registry.count("execs", 4096)
+    with tl.timer("triage"):
+        ...
+    tl.maybe_flush()
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .aggregate import merge, merge_two
+from .metrics import (
+    EmaRate, Histogram, MetricsRegistry, StageTimer, STAGES,
+)
+from .sink import StatsSink, parse_fuzzer_stats, read_latest_snapshot
+
+__all__ = [
+    "EmaRate", "Histogram", "MetricsRegistry", "StageTimer", "STAGES",
+    "StatsSink", "Telemetry", "merge", "merge_two",
+    "parse_fuzzer_stats", "read_latest_snapshot",
+]
+
+
+class Telemetry:
+    """One campaign's registry + optional file sink, bundled."""
+
+    def __init__(self, output_dir: Optional[str] = None,
+                 interval_s: float = 5.0,
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or MetricsRegistry()
+        self.timer = StageTimer(self.registry)
+        self.sink = (StatsSink(output_dir, self.registry, interval_s)
+                     if output_dir else None)
+
+    def maybe_flush(self) -> None:
+        if self.sink is not None:
+            self.sink.maybe_flush()
+
+    def flush(self) -> None:
+        if self.sink is not None:
+            self.sink.flush()
+
+    def snapshot(self) -> Dict[str, object]:
+        return self.registry.snapshot()
+
+    def stage_summary(self) -> str:
+        """One-line stage-time split, e.g.
+        ``stage split: execute 62% | triage 21% | ...`` (empty string
+        before any stage has been timed)."""
+        split = self.registry.stage_split()
+        if not split:
+            return ""
+        parts = [f"{s} {f:.0%}" for s, f in
+                 sorted(split.items(), key=lambda kv: -kv[1])]
+        return "stage split: " + " | ".join(parts)

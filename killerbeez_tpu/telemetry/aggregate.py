@@ -1,0 +1,117 @@
+"""Snapshot merging — fold N registry snapshots into one campaign
+view.
+
+Used twice: the (dp, mp) mesh campaign folds per-shard stat dicts
+each sync epoch (parallel/campaign.py), and the manager folds worker
+heartbeats into the ``/api/stats/<campaign>`` response.  The merge is
+associative and commutative (property-tested in
+tests/test_telemetry.py), so fold order — shard order, heartbeat
+arrival order, tree vs linear reduction — can never change the
+answer:
+
+  * counters    — summed (totals add across workers)
+  * gauges      — max (a fleet's corpus size / pipeline depth is the
+                  worst-case view; summing would double-count shared
+                  state)
+  * EMA rates   — weight-weighted mean, weights summed: a worker
+                  that has observed half a horizon contributes half
+                  strength.  (rate*weight, weight) pairs add, which
+                  is what makes the mean associative.
+  * histograms  — bucket-wise counts summed
+  * start_time  — min; ``t`` — max (the merged view spans the fleet)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def _merge_rates(a: Dict[str, Dict[str, float]],
+                 b: Dict[str, Dict[str, float]]
+                 ) -> Dict[str, Dict[str, float]]:
+    out = dict(a)
+    for k, rb in b.items():
+        ra = out.get(k)
+        if ra is None:
+            out[k] = dict(rb)
+            continue
+        w = ra.get("weight", 0.0) + rb.get("weight", 0.0)
+        if w <= 0:
+            out[k] = {"rate": 0.0, "weight": 0.0}
+        else:
+            out[k] = {
+                "rate": (ra.get("rate", 0.0) * ra.get("weight", 0.0)
+                         + rb.get("rate", 0.0) * rb.get("weight", 0.0)
+                         ) / w,
+                "weight": w,
+            }
+    return out
+
+
+def _merge_hists(a: Dict[str, Dict], b: Dict[str, Dict]
+                 ) -> Dict[str, Dict]:
+    out = {k: dict(v) for k, v in a.items()}
+    for k, hb in b.items():
+        ha = out.get(k)
+        if ha is None:
+            out[k] = dict(hb)
+            continue
+        ca, cb = list(ha.get("counts", [])), list(hb.get("counts", []))
+        if len(ca) < len(cb):
+            ca += [0] * (len(cb) - len(ca))
+        for i, v in enumerate(cb):
+            ca[i] += v
+        out[k] = {"counts": ca,
+                  "total": ha.get("total", 0) + hb.get("total", 0),
+                  "sum": ha.get("sum", 0.0) + hb.get("sum", 0.0)}
+    return out
+
+
+def merge_two(a: Dict[str, object], b: Dict[str, object]
+              ) -> Dict[str, object]:
+    ca, cb = a.get("counters", {}), b.get("counters", {})
+    counters = dict(ca)
+    for k, v in cb.items():
+        counters[k] = counters.get(k, 0) + v
+    ga, gb = a.get("gauges", {}), b.get("gauges", {})
+    gauges = dict(ga)
+    for k, v in gb.items():
+        gauges[k] = max(gauges.get(k, float("-inf")), v)
+    out: Dict[str, object] = {
+        "counters": counters,
+        "gauges": gauges,
+        "rates": _merge_rates(a.get("rates", {}), b.get("rates", {})),
+        "hists": _merge_hists(a.get("hists", {}), b.get("hists", {})),
+    }
+    st = [s.get("start_time") for s in (a, b)
+          if s.get("start_time") is not None]
+    ts = [s.get("t") for s in (a, b) if s.get("t") is not None]
+    if st:
+        out["start_time"] = min(st)
+    if ts:
+        out["t"] = max(ts)
+    if st and ts:
+        out["elapsed"] = out["t"] - out["start_time"]
+    # derived values are recomputed, never merged: a mean of ratios
+    # is not the ratio of the sums
+    rates = out["rates"]
+    execs_rate = rates.get("execs", {})
+    elapsed = out.get("elapsed") or 0
+    out["derived"] = {
+        "execs_per_sec": (counters.get("execs", 0) / elapsed
+                          if elapsed and elapsed > 0 else 0.0),
+        "execs_per_sec_ema": execs_rate.get("rate", 0.0),
+    }
+    return out
+
+
+def merge(snapshots: List[Dict[str, object]]
+          ) -> Optional[Dict[str, object]]:
+    """Fold any number of snapshots; [] -> None, [s] -> normalized s."""
+    if not snapshots:
+        return None
+    acc: Dict[str, object] = {"counters": {}, "gauges": {},
+                              "rates": {}, "hists": {}}
+    for s in snapshots:
+        acc = merge_two(acc, s)
+    return acc

@@ -1,0 +1,152 @@
+"""kb-stats — live campaign view (curses-free ANSI TUI).
+
+Tails a campaign's ``stats.jsonl`` (written by the fuzzer's telemetry
+sink) or polls a manager's ``/api/stats/<campaign>`` fleet endpoint,
+and redraws one compact dashboard frame per interval: exec rates
+(lifetime + EMA), finding counts, new-path rate, corpus size and the
+pipeline stage-time split.  No curses dependency — plain ANSI cursor
+control, so it works over any ssh/tmux and degrades to sequential
+frames when piped (``--once`` prints a single frame and exits).
+
+    kb-stats output/                         # local campaign
+    kb-stats output/stats.jsonl --interval 2
+    kb-stats --manager http://mgr:8650 --campaign 7   # fleet view
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from ..telemetry.metrics import STAGES
+from ..telemetry.sink import read_latest_snapshot as read_local
+
+BAR_W = 40
+
+
+def read_manager(url: str, campaign: str) -> Optional[Dict[str, Any]]:
+    """Merged fleet snapshot from the manager stats endpoint."""
+    try:
+        with urllib.request.urlopen(
+                f"{url}/api/stats/{campaign}", timeout=10) as resp:
+            body = json.loads(resp.read())
+        merged = body.get("merged")
+        if merged is not None:
+            merged["_n_workers"] = body.get("n_workers", 0)
+        return merged
+    except (OSError, ValueError):
+        return None
+
+
+def _fmt_n(v: float) -> str:
+    for unit, div in (("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{unit}"
+    return f"{v:.0f}" if v == int(v) else f"{v:.1f}"
+
+
+def _bar(frac: float, width: int = BAR_W) -> str:
+    n = int(round(max(0.0, min(1.0, frac)) * width))
+    return "#" * n + "-" * (width - n)
+
+
+def render(snap: Dict[str, Any]) -> str:
+    """One dashboard frame as a plain string (ANSI-free: the caller
+    owns cursor control, tests own assertions)."""
+    c = snap.get("counters", {})
+    g = snap.get("gauges", {})
+    d = snap.get("derived", {})
+    r = snap.get("rates", {})
+    elapsed = float(snap.get("elapsed", 0.0))
+    lines: List[str] = []
+    head = "kb-stats — campaign telemetry"
+    if "_n_workers" in snap:
+        head += f" ({snap['_n_workers']} workers, merged)"
+    lines.append(head)
+    lines.append("=" * len(head))
+    lines.append(
+        f"  run time : {int(elapsed) // 3600:02d}:"
+        f"{int(elapsed) % 3600 // 60:02d}:{int(elapsed) % 60:02d}"
+        f"    execs : {_fmt_n(c.get('execs', 0))}")
+    lines.append(
+        f"  execs/s  : {_fmt_n(d.get('execs_per_sec', 0.0))} lifetime"
+        f" | {_fmt_n(d.get('execs_per_sec_ema', 0.0))} recent")
+    lines.append(
+        f"  paths    : {_fmt_n(c.get('new_paths', 0))} total"
+        f" | {r.get('new_paths', {}).get('rate', 0.0):.2f}/s recent"
+        f" | corpus {_fmt_n(g.get('corpus_size', 0))}")
+    lines.append(
+        f"  crashes  : {_fmt_n(c.get('crashes', 0))}"
+        f" ({_fmt_n(c.get('unique_crashes', 0))} unique)"
+        f"    hangs : {_fmt_n(c.get('hangs', 0))}"
+        f" ({_fmt_n(c.get('unique_hangs', 0))} unique)"
+        f"    errors : {_fmt_n(c.get('errors', 0))}")
+    depth = g.get("pipeline_depth")
+    if depth is not None:
+        lines.append(f"  pipeline : {int(depth)} batches in flight")
+    totals = {s: c.get(s + "_seconds", 0.0) for s in STAGES}
+    acc = sum(totals.values())
+    if acc > 0:
+        lines.append("  stage split (host-attention seconds):")
+        for s, t in sorted(totals.items(), key=lambda kv: -kv[1]):
+            if t > 0:
+                lines.append(f"    {s:<15} {_bar(t / acc)} "
+                             f"{t / acc:6.1%}  ({t:.2f}s)")
+    return "\n".join(lines)
+
+
+def _frame(args) -> Optional[Dict[str, Any]]:
+    if args.manager:
+        return read_manager(args.manager, args.campaign)
+    return read_local(args.path)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="kb-stats",
+        description="live campaign stats view (tails stats.jsonl or "
+                    "polls a manager /api/stats endpoint)")
+    p.add_argument("path", nargs="?", default="output",
+                   help="campaign output dir or stats.jsonl path "
+                        "(default ./output)")
+    p.add_argument("--manager",
+                   help="manager base URL (e.g. http://mgr:8650); "
+                        "reads the merged fleet view instead of a "
+                        "local file")
+    p.add_argument("--campaign",
+                   help="campaign key for --manager (job id)")
+    p.add_argument("-i", "--interval", type=float, default=1.0,
+                   help="refresh seconds (default 1)")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit (no ANSI)")
+    args = p.parse_args(argv)
+    if args.manager and not args.campaign:
+        print("error: --manager needs --campaign", file=sys.stderr)
+        return 2
+    if args.once:
+        snap = _frame(args)
+        if snap is None:
+            print("no stats yet", file=sys.stderr)
+            return 1
+        print(render(snap))
+        return 0
+    try:
+        while True:
+            snap = _frame(args)
+            # home + clear-to-end redraw (no flicker, no curses)
+            sys.stdout.write("\x1b[H\x1b[J")
+            sys.stdout.write(render(snap) if snap is not None
+                             else "waiting for stats ...")
+            sys.stdout.write("\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,23 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CORPUS_BUILD = os.path.join(REPO_ROOT, "corpus", "build")
 
 
+def pytest_collection_modifyitems(items):
+    """Auto-apply the capability markers registered in pyproject:
+    ``native`` for anything that builds/uses the host toolchain
+    fixtures (the corpus_bin fixture is the tell), ``device`` for the
+    TPU-hardware gate file.  `-m 'not native'` then runs cleanly on
+    toolchain-less hosts without touching every test."""
+    for item in items:
+        if "corpus_bin" in getattr(item, "fixturenames", ()):
+            item.add_marker(pytest.mark.native)
+        if os.path.basename(str(item.fspath)) in (
+                "test_native_exec.py", "test_tpu_gate.py"):
+            item.add_marker(
+                pytest.mark.device
+                if "tpu_gate" in str(item.fspath)
+                else pytest.mark.native)
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)

@@ -208,9 +208,13 @@ def test_write_findings_false_still_dedups(tmp_path):
                 write_findings=False)
     stats = fz.run(16)
     assert stats.crashes == 16
-    # identical input -> recorded (logged) once, no files written
-    assert not os.path.exists(tmp_path / "o" / "crashes")
+    # identical input -> recorded (logged) once, no files written —
+    # including stats files: a no-artifacts run stays artifact-free
+    # (the registry still counts; only the sink is disabled)
+    assert not os.path.exists(tmp_path / "o")
     assert len(fz._seen["crashes"]) == 1
+    assert fz.telemetry.sink is None
+    assert fz.stats.execs_per_sec > 0
 
 
 def test_tail_batch_padding_keeps_counts(tmp_path):
@@ -511,6 +515,79 @@ def test_corpus_feedback_rotation_mechanism(tmp_path):
     assert instr.coverage_bytes() >= 0.75 * instr2.coverage_bytes()
 
 
+def test_stats_files_written_and_consistent(tmp_path):
+    """Acceptance gate for the telemetry subsystem: a short campaign
+    writes AFL-compatible fuzzer_stats + plot_data + stats.jsonl, and
+    the streams AGREE — the sum of plot_data row deltas equals the
+    fuzzer_stats cumulative counters, and the registry's lifetime
+    rate is consistent with execs/elapsed.  Runs the CGC-grade
+    flagship target on the CPU backend with a small batch — the
+    telemetry acceptance configuration."""
+    from killerbeez_tpu.models import targets_cgc
+    from killerbeez_tpu.telemetry import parse_fuzzer_stats
+    instr = instrumentation_factory(
+        "jit_harness",
+        '{"target": "tlvstack_vm", "novelty": "throughput"}')
+    mut = mutator_factory("havoc", '{"seed": 4}',
+                          targets_cgc.tlvstack_vm_seed())
+    drv = driver_factory("file", None, instr, mut)
+    out = tmp_path / "out"
+    fz = Fuzzer(drv, output_dir=str(out), batch_size=64,
+                stats_interval=0.0)      # flush every batch
+    stats = fz.run(256)
+
+    fs = parse_fuzzer_stats(str(out / "fuzzer_stats"))
+    assert int(fs["execs_done"]) == stats.iterations == 256
+    assert int(fs["paths_total"]) == stats.new_paths
+    assert int(fs["crashes"]) == stats.crashes
+    assert int(fs["unique_crashes"]) == stats.unique_crashes
+    assert float(fs["execs_per_sec"]) == pytest.approx(
+        stats.execs_per_sec, rel=0.05)
+
+    rows = [[float(v) for v in r.split(",")] for r in
+            (out / "plot_data").read_text().splitlines()
+            if not r.startswith("#")]
+    assert len(rows) >= 3                # baseline + >=1 mid + final
+    execs_col = [r[1] for r in rows]
+    paths_col = [r[2] for r in rows]
+    assert execs_col[0] == 0             # baseline row: deltas sum to
+    assert execs_col == sorted(execs_col)       # the cumulative total
+    assert paths_col == sorted(paths_col)
+    assert sum(b - a for a, b in zip(execs_col, execs_col[1:])) \
+        == int(fs["execs_done"])
+    assert sum(b - a for a, b in zip(paths_col, paths_col[1:])) \
+        == int(fs["paths_total"])
+
+    snaps = [json.loads(l) for l in
+             (out / "stats.jsonl").read_text().splitlines()]
+    assert len(snaps) >= 2
+    assert snaps[-1]["counters"]["execs"] == 256
+    assert snaps[-1]["derived"]["execs_per_sec_ema"] >= 0
+    # stage timers saw the loop's phases without forcing syncs
+    assert snaps[-1]["counters"].get("execute_seconds", 0) > 0
+
+
+def test_cli_no_stats_flag(tmp_path):
+    seed_path = tmp_path / "seed"
+    seed_path.write_bytes(SEED)
+    out = tmp_path / "out"
+    rc = cli_main(["file", "jit_harness", "bit_flip",
+                   "-i", '{"target": "test"}', "-sf", str(seed_path),
+                   "-n", "32", "-o", str(out), "-b", "16",
+                   "--no-stats"])
+    assert rc == 0
+    assert len(os.listdir(out / "crashes")) == 1   # fuzzing unaffected
+    for f in ("fuzzer_stats", "plot_data", "stats.jsonl"):
+        assert not (out / f).exists()
+    # default run DOES write them
+    rc = cli_main(["file", "jit_harness", "bit_flip",
+                   "-i", '{"target": "test"}', "-sf", str(seed_path),
+                   "-n", "32", "-o", str(tmp_path / "out2"), "-b", "16"])
+    assert rc == 0
+    for f in ("fuzzer_stats", "plot_data", "stats.jsonl"):
+        assert (tmp_path / "out2" / f).exists()
+
+
 def test_cli_inline_mutator_state(tmp_path):
     """Reference -ms parity: mutator state as an inline string (the
     same JSON -msf reads from a file)."""
@@ -562,7 +639,9 @@ def test_mutator_sweep_runs_clean(mutator, driver, tmp_path, capfd):
     assert not bad, bad
 
 
+@pytest.mark.slow  # ~65s interpret-mode pallas sweep (same family as
 def test_superbatch_matches_per_batch(tmp_path, monkeypatch):
+    # test_fused_cli_path_matches_unfused): nightly lane
     """K-step device-side accumulation (Fuzzer accumulate=K,
     jit_harness._fused_fuzz_multi): candidate/verdict streams and
     on-disk findings must be IDENTICAL to K sequential fused

@@ -213,6 +213,18 @@ def test_cli_mesh_campaign_writes_findings(tmp_path):
     d = json.loads(state_file.read_text())
     assert d["total_execs"] == 256
     assert d["target"] == "cgc_like"
+    # telemetry rode along: stats files written, the stream agrees
+    # with the mesh exec count, and the per-shard fold surfaced the
+    # mesh shape + shard clock as gauges
+    from killerbeez_tpu.telemetry import (
+        parse_fuzzer_stats, read_latest_snapshot,
+    )
+    assert int(parse_fuzzer_stats(
+        str(out / "fuzzer_stats"))["execs_done"]) == 256
+    g = read_latest_snapshot(str(out))["gauges"]
+    assert g["mesh_dp"] == 4 and g["mesh_mp"] == 2
+    assert g["shard_step"] == 4          # 256 execs / 64-lane quantum
+    assert g["lanes_per_shard"] == 16
 
 
 def test_mesh_campaign_state_roundtrips_through_merger(tmp_path):

@@ -1,0 +1,461 @@
+"""Telemetry subsystem tests — registry math (EMA decay, histogram
+buckets), merge algebra (associative + commutative under random
+snapshots), atomic fuzzer_stats writes (a reader never sees a torn
+file), sink file formats, worker heartbeat retry/backoff, and the
+kb-stats renderer."""
+
+import json
+import os
+import random
+import threading
+
+import pytest
+
+from killerbeez_tpu.telemetry import (
+    MetricsRegistry, StageTimer, Telemetry, merge, merge_two,
+    parse_fuzzer_stats,
+)
+from killerbeez_tpu.telemetry.metrics import (
+    EmaRate, HIST_BUCKETS, Histogram,
+)
+from killerbeez_tpu.telemetry.sink import (
+    PLOT_FIELDS, StatsSink, plot_row, write_fuzzer_stats,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- EMA rate ----------------------------------------------------------
+
+
+def test_ema_rate_converges_to_steady_rate():
+    clk = FakeClock()
+    r = EmaRate(tau=10.0, time_fn=clk)
+    r.add(0)                             # anchor t0
+    for _ in range(200):                 # 100/s steady stream
+        clk.advance(1.0)
+        r.add(100)
+    assert r.rate == pytest.approx(100.0, rel=0.01)
+    assert 0.99 < r.weight <= 1.0
+
+
+def test_ema_rate_decays_toward_recent_rate():
+    clk = FakeClock()
+    r = EmaRate(tau=5.0, time_fn=clk)
+    r.add(0)
+    for _ in range(50):
+        clk.advance(1.0)
+        r.add(1000)                      # fast phase: 1000/s
+    fast = r.rate
+    for _ in range(50):
+        clk.advance(1.0)
+        r.add(10)                        # slow phase: 10/s
+    assert fast == pytest.approx(1000.0, rel=0.05)
+    assert r.rate == pytest.approx(10.0, rel=0.05)  # forgot the past
+
+
+def test_ema_rate_first_sample_only_anchors():
+    clk = FakeClock()
+    r = EmaRate(time_fn=clk)
+    r.add(500)
+    assert r.rate == 0.0 and r.weight == 0.0
+
+
+# -- histogram ---------------------------------------------------------
+
+
+def test_histogram_bucket_edges_inclusive():
+    h = Histogram()
+    h.observe(HIST_BUCKETS[0])           # == first edge -> bucket 0
+    h.observe(HIST_BUCKETS[0] * 1.001)   # just above -> bucket 1
+    h.observe(HIST_BUCKETS[-1] * 2)      # beyond all edges -> overflow
+    assert h.counts[0] == 1
+    assert h.counts[1] == 1
+    assert h.counts[-1] == 1
+    assert h.total == 3
+    assert h.sum == pytest.approx(
+        HIST_BUCKETS[0] * 2.001 + HIST_BUCKETS[-1] * 2)
+
+
+def test_histogram_matches_linear_scan():
+    rng = random.Random(7)
+    h = Histogram()
+    vals = [rng.uniform(0, 1e-1) for _ in range(500)]
+    for v in vals:
+        h.observe(v)
+    for i, edge in enumerate(HIST_BUCKETS):
+        lo = HIST_BUCKETS[i - 1] if i else float("-inf")
+        want = sum(1 for v in vals if lo < v <= edge)
+        assert h.counts[i] == want, f"bucket {i}"
+
+
+# -- registry + stage timer -------------------------------------------
+
+
+def test_registry_counters_and_run_windows():
+    clk = FakeClock()
+    reg = MetricsRegistry(time_fn=clk)
+    reg.count("execs", 100)
+    reg.count("execs", 28)
+    clk.advance(100.0)                   # idle gap: not active time
+    reg.run_started()
+    clk.advance(4.0)
+    reg.run_ended()
+    assert reg.counters["execs"] == 128
+    assert reg.active_seconds() == pytest.approx(4.0)
+    assert reg.execs_per_sec() == pytest.approx(32.0)  # active, not age
+    assert reg.elapsed() == pytest.approx(104.0)
+
+
+def test_stage_timer_records_histogram_and_total():
+    reg = MetricsRegistry()
+    t = StageTimer(reg)
+    with t("triage"):
+        pass
+    with t("triage"):
+        with t("fs_write"):              # spans nest
+            pass
+    assert reg.hists["triage"].total == 2
+    assert reg.hists["fs_write"].total == 1
+    assert reg.counters["triage_seconds"] >= 0
+    split = reg.stage_split()
+    assert set(split) <= {"triage", "fs_write"}
+    assert sum(split.values()) == pytest.approx(1.0)
+
+
+def test_snapshot_shape_round_trips_json():
+    reg = MetricsRegistry()
+    reg.count("execs", 5)
+    reg.gauge("corpus_size", 3)
+    reg.rate("execs", 5)
+    reg.observe("execute", 0.01)
+    snap = json.loads(json.dumps(reg.snapshot()))
+    assert snap["counters"]["execs"] == 5
+    assert snap["gauges"]["corpus_size"] == 3
+    assert "execs" in snap["rates"]
+    assert "execute" in snap["hists"]
+    assert "execs_per_sec" in snap["derived"]
+
+
+# -- merge algebra -----------------------------------------------------
+
+
+def _rand_snapshot(rng):
+    names = ["execs", "crashes", "new_paths", "hangs"]
+    return {
+        "t": rng.uniform(1000, 2000),
+        "start_time": rng.uniform(0, 1000),
+        "counters": {n: rng.randrange(0, 10000)
+                     for n in rng.sample(names, rng.randrange(1, 4))},
+        "gauges": {n: rng.uniform(0, 50)
+                   for n in rng.sample(["corpus_size", "depth"],
+                                       rng.randrange(0, 3))},
+        "rates": {n: {"rate": rng.uniform(0, 1e6),
+                      "weight": rng.uniform(0, 1)}
+                  for n in rng.sample(names, rng.randrange(0, 3))},
+        "hists": {n: {"counts": [rng.randrange(0, 9)
+                                 for _ in range(4)],
+                      "total": rng.randrange(0, 30),
+                      "sum": rng.uniform(0, 5)}
+                  for n in rng.sample(["execute", "triage"],
+                                      rng.randrange(0, 3))},
+    }
+
+
+def _assert_snap_equal(a, b):
+    assert a["counters"] == pytest.approx(b["counters"])
+    assert a["gauges"] == pytest.approx(b["gauges"])
+    assert set(a["rates"]) == set(b["rates"])
+    for k in a["rates"]:
+        assert a["rates"][k]["rate"] == \
+            pytest.approx(b["rates"][k]["rate"])
+        assert a["rates"][k]["weight"] == \
+            pytest.approx(b["rates"][k]["weight"])
+    assert set(a["hists"]) == set(b["hists"])
+    for k in a["hists"]:
+        assert a["hists"][k]["counts"] == b["hists"][k]["counts"]
+        assert a["hists"][k]["total"] == b["hists"][k]["total"]
+    assert a.get("t") == pytest.approx(b.get("t"))
+    assert a.get("start_time") == pytest.approx(b.get("start_time"))
+
+
+def test_merge_is_associative_and_commutative():
+    rng = random.Random(0xbee5)
+    for _ in range(40):                  # property check over randoms
+        a, b, c = (_rand_snapshot(rng) for _ in range(3))
+        _assert_snap_equal(merge_two(a, b), merge_two(b, a))
+        _assert_snap_equal(merge_two(merge_two(a, b), c),
+                           merge_two(a, merge_two(b, c)))
+        _assert_snap_equal(merge([a, b, c]), merge([c, b, a]))
+
+
+def test_shard_stat_snapshots_fold():
+    """The mesh campaign's per-epoch fold: dp shards' snapshots merge
+    into the fleet view (execs sum across shards, step clock max's)."""
+    from killerbeez_tpu.parallel.distributed import (
+        shard_stat_snapshots,
+    )
+
+    class FakeMesh:
+        shape = {"dp": 4, "mp": 2}
+
+    snaps = shard_stat_snapshots(FakeMesh(), 16, 3)
+    assert len(snaps) == 4               # one per dp shard
+    m = merge(snaps)
+    assert m["counters"]["execs"] == 64  # 4 shards x 16 lanes
+    assert m["gauges"]["shard_step"] == 3
+    assert m["gauges"]["lanes_per_shard"] == 16
+    # epoch folds accumulate associatively into the campaign total
+    acc = merge_two(m, merge(shard_stat_snapshots(FakeMesh(), 16, 4)))
+    assert acc["counters"]["execs"] == 128
+    assert acc["gauges"]["shard_step"] == 4
+
+
+def test_merge_semantics():
+    a = {"counters": {"execs": 100, "crashes": 1},
+         "gauges": {"corpus_size": 5},
+         "rates": {"execs": {"rate": 1000.0, "weight": 1.0}}}
+    b = {"counters": {"execs": 50},
+         "gauges": {"corpus_size": 9},
+         "rates": {"execs": {"rate": 400.0, "weight": 0.5}}}
+    m = merge([a, b])
+    assert m["counters"]["execs"] == 150         # summed
+    assert m["counters"]["crashes"] == 1
+    assert m["gauges"]["corpus_size"] == 9       # max
+    # weight-weighted mean: (1000*1 + 400*0.5) / 1.5
+    assert m["rates"]["execs"]["rate"] == pytest.approx(800.0)
+    assert m["rates"]["execs"]["weight"] == pytest.approx(1.5)
+    assert merge([]) is None
+
+
+# -- sink: atomicity + formats ----------------------------------------
+
+
+def _snap(execs, paths=0, t=1000.0):
+    return {"t": t, "start_time": 0.0, "elapsed": t,
+            "counters": {"execs": execs, "new_paths": paths},
+            "gauges": {}, "rates": {},
+            "derived": {"execs_per_sec": execs / t,
+                        "execs_per_sec_ema": 0.0}}
+
+
+def test_fuzzer_stats_write_is_atomic_under_reader(tmp_path):
+    """A tailer hammering the file during 200 rewrites must always
+    parse a COMPLETE snapshot — os.replace publishes whole files
+    only (the torn-write guarantee external dashboards rely on)."""
+    path = str(tmp_path / "fuzzer_stats")
+    write_fuzzer_stats(path, _snap(0))
+    keys = set(parse_fuzzer_stats(path))
+    stop = threading.Event()
+    torn = []
+
+    def reader():
+        while not stop.is_set():
+            got = parse_fuzzer_stats(path)
+            if set(got) != keys or not all(v for v in got.values()):
+                torn.append(got)
+
+    th = threading.Thread(target=reader)
+    th.start()
+    try:
+        for i in range(1, 201):
+            write_fuzzer_stats(path, _snap(i * 1000, paths=i))
+    finally:
+        stop.set()
+        th.join()
+    assert not torn, torn[:3]
+    assert not os.path.exists(path + ".tmp")  # tmp never left behind
+    assert parse_fuzzer_stats(path)["execs_done"] == "200000"
+
+
+def test_failed_write_leaves_previous_stats_intact(tmp_path,
+                                                   monkeypatch):
+    path = str(tmp_path / "fuzzer_stats")
+    write_fuzzer_stats(path, _snap(42))
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        write_fuzzer_stats(path, _snap(999))
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert parse_fuzzer_stats(path)["execs_done"] == "42"
+
+
+def test_sink_files_and_plot_monotone(tmp_path):
+    clk = FakeClock()
+    reg = MetricsRegistry(time_fn=clk)
+    sink = StatsSink(str(tmp_path), reg, interval_s=10.0)
+    for i in range(5):
+        reg.count("execs", 100)
+        reg.count("new_paths", 2)
+        clk.advance(11.0)
+        assert sink.maybe_flush()
+        assert not sink.maybe_flush()    # within the interval: no-op
+    rows = [r for r in
+            (tmp_path / "plot_data").read_text().splitlines()
+            if not r.startswith("#")]
+    assert len(rows) == 5
+    execs = [int(r.split(",")[1]) for r in rows]
+    assert execs == sorted(execs)        # monotone cumulative
+    assert execs[-1] == 500
+    jl = [json.loads(l) for l in
+          (tmp_path / "stats.jsonl").read_text().splitlines()]
+    assert len(jl) == 5
+    assert jl[-1]["counters"]["execs"] == 500
+    stats = parse_fuzzer_stats(str(tmp_path / "fuzzer_stats"))
+    assert stats["execs_done"] == "500"
+    assert stats["paths_total"] == "10"
+    assert len(plot_row(_snap(1)).split(", ")) == len(PLOT_FIELDS)
+
+
+# -- worker heartbeat retry -------------------------------------------
+
+
+def test_request_retry_backs_off_then_succeeds(monkeypatch):
+    from killerbeez_tpu.manager import worker as w
+    calls = {"n": 0}
+    sleeps = []
+
+    def flaky(url, payload=None, method="POST"):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("refused")
+        return {"ok": True}
+
+    monkeypatch.setattr(w, "_request", flaky)
+    monkeypatch.setattr(w.time, "sleep", sleeps.append)
+    assert w._request_retry("http://x/api", {}) == {"ok": True}
+    assert calls["n"] == 3
+    assert sleeps == [0.5, 1.0]          # exponential backoff
+
+
+def test_request_retry_exhausts_and_raises(monkeypatch):
+    from killerbeez_tpu.manager import worker as w
+
+    def down(url, payload=None, method="POST"):
+        raise ConnectionError("refused")
+
+    monkeypatch.setattr(w, "_request", down)
+    monkeypatch.setattr(w.time, "sleep", lambda s: None)
+    with pytest.raises(ConnectionError):
+        w._request_retry("http://x/api", {}, attempts=4)
+
+
+def test_heartbeat_reads_latest_snapshot(tmp_path, monkeypatch):
+    from killerbeez_tpu.manager import worker as w
+    out = tmp_path / "output"
+    out.mkdir()
+    assert w.read_latest_snapshot(str(out)) is None   # no file yet
+    with open(out / "stats.jsonl", "w") as f:
+        f.write(json.dumps(_snap(100)) + "\n")
+        f.write(json.dumps(_snap(900)) + "\n")
+    assert w.read_latest_snapshot(str(out))["counters"]["execs"] == 900
+    posts = []
+    monkeypatch.setattr(
+        w, "_request_retry",
+        lambda url, payload=None, **kw: posts.append((url, payload)))
+    hb = w.Heartbeat("http://mgr", "7", "w1", str(out), interval=99)
+    assert hb.beat()
+    (url, payload), = posts
+    assert url == "http://mgr/api/stats/7"
+    assert payload["worker"] == "w1"
+    assert payload["snapshot"]["counters"]["execs"] == 900
+    # a record torn mid-append falls back to the previous complete
+    # one (the final heartbeat must never be dropped over a tail race)
+    with open(out / "stats.jsonl", "a") as f:
+        f.write(json.dumps(_snap(950))[:40])          # no newline, torn
+    assert w.read_latest_snapshot(str(out))["counters"]["execs"] == 900
+    # O(1) tail: only the last window bytes are read on a long stream
+    with open(out / "stats.jsonl", "a") as f:
+        f.write("\n")
+        for i in range(2000):
+            f.write(json.dumps(_snap(i)) + "\n")
+    assert w.read_latest_snapshot(
+        str(out), window=4096)["counters"]["execs"] == 1999
+
+
+def test_heartbeat_survives_dead_manager(tmp_path, monkeypatch):
+    from killerbeez_tpu.manager import worker as w
+    out = tmp_path / "o"
+    out.mkdir()
+    (out / "stats.jsonl").write_text(json.dumps(_snap(1)) + "\n")
+
+    def down(url, payload=None, **kw):
+        raise ConnectionError("refused")
+
+    monkeypatch.setattr(w, "_request_retry", down)
+    hb = w.Heartbeat("http://gone", "1", "w", str(out), interval=99)
+    assert hb.beat() is False            # warns, never raises
+
+
+# -- kb-stats renderer -------------------------------------------------
+
+
+def test_stats_tui_render_and_once(tmp_path, capsys):
+    from killerbeez_tpu.tools import stats_tui
+    snap = _snap(1_500_000, paths=42, t=3700.0)
+    snap["counters"].update(crashes=3, unique_crashes=2,
+                            execute_seconds=8.0, triage_seconds=2.0)
+    snap["gauges"] = {"corpus_size": 42, "pipeline_depth": 24}
+    frame = stats_tui.render(snap)
+    assert "1.50M" in frame              # execs humanized
+    assert "01:01:40" in frame           # 3700s
+    assert "crashes" in frame and "(2 unique)" in frame
+    assert "stage split" in frame
+    assert "execute" in frame and "80.0%" in frame
+    # --once against a real stats.jsonl
+    (tmp_path / "stats.jsonl").write_text(json.dumps(snap) + "\n")
+    assert stats_tui.main([str(tmp_path), "--once"]) == 0
+    assert "1.50M" in capsys.readouterr().out
+    # missing file: clean nonzero exit, no traceback
+    assert stats_tui.main([str(tmp_path / "nope"), "--once"]) == 1
+
+
+def test_stats_tui_reads_manager_merge(tmp_path):
+    from killerbeez_tpu.manager import ManagerServer
+    from killerbeez_tpu.tools.stats_tui import read_manager
+    s = ManagerServer(port=0)
+    s.start()
+    try:
+        s.db.upsert_campaign_stats("c1", "w1", _snap(100))
+        s.db.upsert_campaign_stats("c1", "w2", _snap(50))
+        merged = read_manager(f"http://127.0.0.1:{s.port}", "c1")
+    finally:
+        s.stop()
+    assert merged["counters"]["execs"] == 150
+    assert merged["_n_workers"] == 2
+
+
+# -- Telemetry facade --------------------------------------------------
+
+
+def test_telemetry_facade_and_fuzzstats_view(tmp_path):
+    from killerbeez_tpu.fuzzer.loop import FuzzStats
+    tl = Telemetry(str(tmp_path / "out"), interval_s=0.0)
+    st = FuzzStats(tl.registry)
+    st.iterations += 64                  # property writes hit the
+    st.crashes += 1                      # registry directly
+    assert tl.registry.counters["execs"] == 64
+    assert tl.registry.counters["crashes"] == 1
+    tl.registry.count("execs", 36)
+    assert st.iterations == 100          # ...and reads see them
+    d = st.as_dict()
+    assert d["iterations"] == 100 and d["crashes"] == 1
+    assert "execs_per_sec" in d and "execs_per_sec_ema" in d
+    tl.flush()
+    assert (tmp_path / "out" / "fuzzer_stats").exists()
+    disabled = Telemetry(None)
+    disabled.maybe_flush()               # no sink: clean no-op
+    assert disabled.stage_summary() == ""

@@ -304,6 +304,63 @@ def bench_stats_overhead(batch=65536, steps=32, target="tlvstack_vm",
     return overhead
 
 
+def bench_schedulers(schedules, targets=None, batch=1024, execs=131072,
+                     seed_tag="minimal"):
+    """--schedule: coverage-at-budget comparison of the seed
+    scheduling policies (corpus/schedule.py) on the CGC-class
+    targets — the fb_gate.py protocol (coverage bytes at a fixed exec
+    budget, minimal-seed regime: the scenario coverage-guided
+    scheduling exists for), one row per (target, policy).  rare-edge
+    signs each admitted entry with one extra exec on a side
+    instrumentation instance (the same wiring as the CLI)."""
+    import json as _json
+    import shutil
+    from killerbeez_tpu.drivers.factory import driver_factory
+    from killerbeez_tpu.fuzzer.cli import _wire_rare_edge_signer
+    from killerbeez_tpu.fuzzer.loop import Fuzzer
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    from killerbeez_tpu.models import targets_cgc
+    from killerbeez_tpu.mutators.factory import mutator_factory
+
+    seeds = {
+        "tlvstack_vm": targets_cgc.tlvstack_vm_seed(),
+        "rledec_vm": targets_cgc.rledec_vm_seed(),
+        "imgparse_vm": targets_cgc.imgparse_vm_seed(),
+    }
+    for target in (targets or list(seeds)):
+        seed = seeds[target]
+        if seed_tag == "minimal":
+            seed = seed[:8]             # the standard minimal-seed cut
+        for policy in schedules:
+            instr = instrumentation_factory(
+                "jit_harness", _json.dumps(
+                    {"target": target, "novelty": "throughput"}))
+            mut = mutator_factory("havoc", '{"seed": 7}', seed)
+            drv = driver_factory("file", None, instr, mut)
+            out = os.path.join(REPO, "bench_out",
+                               f"sched_{target}_{policy}")
+            shutil.rmtree(out, ignore_errors=True)
+            fz = Fuzzer(drv, output_dir=out, batch_size=batch,
+                        write_findings=False, scheduler=policy)
+            if policy == "rare-edge":
+                _wire_rare_edge_signer(fz, drv)
+            t0 = time.time()
+            stats = fz.run(execs)
+            dt = time.time() - t0
+            emit(f"sched-{policy}",
+                 f"{policy} scheduler on {target} ({seed_tag} seed, "
+                 f"-b {batch}, {execs} execs)",
+                 stats.iterations / dt,
+                 coverage_bytes=instr.coverage_bytes(),
+                 new_paths=stats.new_paths,
+                 crashes=stats.crashes,
+                 corpus_arms=len(fz.scheduler.arms),
+                 rotations=fz.scheduler.rotations,
+                 target=target)
+
+
 def bench_multichip_smoke():
     """Config 5: sharded step on a virtual 8-device CPU mesh, run in a
     subprocess (the driver env exposes one real chip; see
@@ -386,6 +443,52 @@ def bench_qemu_tier():
 
 def main():
     from killerbeez_tpu.models import targets_cgc
+
+    if "--schedule" in sys.argv[1:]:
+        # scheduler-comparison mode:
+        #   python bench.py --schedule bandit,rare-edge,rr \
+        #       [target ...] [-b BATCH] [-n EXECS]
+        from killerbeez_tpu.corpus.schedule import SCHEDULERS
+        rest = sys.argv[1:]
+        i = rest.index("--schedule")
+        nxt = rest[i + 1] if i + 1 < len(rest) else ""
+        cand = [s for s in nxt.split(",") if s]
+        # the next token is a policy list when it looks like one
+        # (contains a comma or names a policy); a policy-looking
+        # token with a typo is an ERROR, not a silent fallback to
+        # all-policies-on-a-nonexistent-target; anything else is a
+        # target/flag and the default policies apply
+        looks_like_policies = "," in nxt or (
+            cand and cand[0] in SCHEDULERS)
+        if looks_like_policies:
+            bad = [s for s in cand if s not in SCHEDULERS]
+            if bad:
+                print(f"error: unknown scheduler(s) {bad} "
+                      f"(choose from {sorted(SCHEDULERS)})",
+                      file=sys.stderr)
+                return 2
+            schedules, tail = cand, rest[i + 2:]
+        else:
+            schedules, tail = list(SCHEDULERS), rest[i + 1:]
+        tail = rest[:i] + tail          # targets may precede the flag
+        batch, execs, tgts = 1024, 131072, []
+        j = 0
+        while j < len(tail):
+            if tail[j] == "-b":
+                batch = int(tail[j + 1]); j += 2
+            elif tail[j] == "-n":
+                execs = int(tail[j + 1]); j += 2
+            else:
+                tgts.append(tail[j]); j += 1
+        known = ("tlvstack_vm", "rledec_vm", "imgparse_vm")
+        bad_t = [t for t in tgts if t not in known]
+        if bad_t:
+            print(f"error: unknown target(s) {bad_t} "
+                  f"(choose from {list(known)})", file=sys.stderr)
+            return 2
+        bench_schedulers(schedules, targets=tgts or None,
+                        batch=batch, execs=execs)
+        return 0
 
     if "--stats-overhead" in sys.argv[1:]:
         # standalone observability-cost mode: optional trailing args

@@ -1,0 +1,38 @@
+"""Persistent corpus subsystem.
+
+The reference treats the corpus as a durable artifact (``new_paths/``
+on disk, merger/picker tools, manager-distributed seed sets); the
+loop's in-memory rotation list lost every arm, its bandit stats and
+its lineage on exit, and fleet workers never saw each other's
+findings.  This package makes the corpus first-class:
+
+  * ``store.py``    — on-disk corpus store: one buffer file per entry
+    keyed by md5 plus a JSON metadata sidecar (coverage signature,
+    bandit stats, lineage, discovery order), atomic-rename writes,
+    and a campaign-state record that lets ``--resume`` continue a
+    killed campaign exactly where it stopped.
+  * ``schedule.py`` — the seed-scheduling policy behind the loop's
+    rotation, extracted into a ``Scheduler`` interface: ``bandit``
+    (the default greedy-optimistic decay bandit, behavior-preserving),
+    ``rare-edge`` (FairFuzz-style rarest-edge preference) and ``rr``
+    (round-robin baseline).
+  * ``sync.py``     — manager-mediated corpus exchange: workers POST
+    edge-novel entries to ``/api/corpus/<campaign>`` and periodically
+    pull peers' entries into their local store (coverage-hash dedup,
+    heartbeat-style retry/backoff).
+"""
+
+from __future__ import annotations
+
+from .schedule import (
+    Arm, BanditScheduler, RareEdgeScheduler, RoundRobinScheduler,
+    SCHEDULERS, Scheduler, make_scheduler,
+)
+from .store import CorpusEntry, CorpusStore
+from .sync import CorpusSync
+
+__all__ = [
+    "Arm", "BanditScheduler", "CorpusEntry", "CorpusStore",
+    "CorpusSync", "RareEdgeScheduler", "RoundRobinScheduler",
+    "SCHEDULERS", "Scheduler", "make_scheduler",
+]

@@ -1,0 +1,370 @@
+"""Pluggable seed-scheduling policies for the fuzzing loop.
+
+Extracted from ``Fuzzer._rotate_seed`` / ``_credit_period``: the loop
+owns WHEN to rotate (cadence, pipeline safety, shape-stable seed
+swaps); the scheduler owns WHICH seed the next period fuzzes.  Angora
+frames search strategy as a swappable policy and FairFuzz shows the
+choice dominates coverage growth (PAPERS.md) — so the policy is an
+interface, not a hard-coded heuristic:
+
+  * ``bandit``    — the default: greedy optimistic bandit with
+    per-period decay, an exact port of the in-loop behavior it
+    replaces (same arm scores, same tie-breaks, same splice RNG
+    stream — ``--schedule bandit`` reproduces the old rotation
+    decisions bit-for-bit on a fixed seed).
+  * ``rare-edge`` — FairFuzz-style: prefer arms whose coverage
+    signature contains the globally rarest edges (hit by the fewest
+    corpus entries), probing unsigned arms once.
+  * ``rr``        — round-robin over base + arms, the baseline
+    ``bench.py --schedule`` compares against.
+
+Arms are ``Arm`` objects — ``list`` subclasses holding the loop's
+historical ``[buf, selections, finds]`` triple (credit pointers keep
+working across cap evictions exactly as before) plus the store
+metadata (md5, signature, lineage) the persistence tier needs.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from .store import CorpusEntry, coverage_hash
+from ..utils.fileio import md5_hex
+
+
+class Arm(list):
+    """One rotation arm: ``[buf, selections, finds]`` (the loop's
+    historical shape — credits write through indices 1/2) plus store
+    metadata as attributes."""
+
+    __slots__ = ("md5", "seq", "sig", "parent", "source", "discovered")
+
+    def __init__(self, buf: bytes, selections: float = 0.0,
+                 finds: float = 0.0, md5: Optional[str] = None,
+                 seq: int = 0, sig: Optional[List[int]] = None,
+                 parent: Optional[str] = None, source: str = "local",
+                 discovered: Optional[float] = None):
+        super().__init__([bytes(buf), selections, finds])
+        self.md5 = md5 or md5_hex(buf)
+        self.seq = int(seq)
+        self.sig = sorted(set(int(s) for s in sig)) if sig else None
+        self.parent = parent
+        self.source = source
+        self.discovered = discovered
+
+    @property
+    def buf(self) -> bytes:
+        return self[0]
+
+    @property
+    def cov_hash(self) -> str:
+        return coverage_hash(self.sig, self[0])
+
+    def to_entry(self) -> CorpusEntry:
+        return CorpusEntry(
+            self[0], md5=self.md5, seq=self.seq, sig=self.sig,
+            edge_hits=None, selections=float(self[1]),
+            finds=float(self[2]), parent=self.parent,
+            source=self.source, discovered=self.discovered)
+
+    @classmethod
+    def from_entry(cls, e: CorpusEntry) -> "Arm":
+        return cls(e.buf, selections=e.selections, finds=e.finds,
+                   md5=e.md5, seq=e.seq, sig=e.sig, parent=e.parent,
+                   source=e.source, discovered=e.discovered)
+
+
+class Scheduler:
+    """Seed-scheduling policy: owns the arm list, the base-seed stats
+    and the per-period credit fold; ``select()`` names the next
+    period's seed.  The loop calls, in order per feedback period:
+    ``credit_find`` per edge-novel finding (to the GENERATING arm),
+    ``admit`` per finding entering rotation, ``credit_period`` at the
+    boundary, then ``select``."""
+
+    name = "base"
+
+    #: rotation keeps at most this many arms (oldest evicted; the
+    #: loop's historical CORPUS_CAP)
+    CAP = 256
+
+    #: per-period decay of arm stats (bandit scoring; kept for every
+    #: policy so observability and resume see comparable stats)
+    DECAY = 0.8
+
+    def __init__(self, cap: Optional[int] = None):
+        self.arms: List[Arm] = []
+        self.base_stats: List[float] = [0.0, 0.0]  # [selections, finds]
+        self.base_seed: Optional[bytes] = None
+        self.rotations = 0
+        if cap is not None:
+            self.CAP = int(cap)
+        # deterministic splice/choice stream — the loop's historical
+        # seed, so the default policy replays old campaigns exactly
+        self.rng = random.Random(0x6b62)
+        self._seq = 0
+
+    # -- corpus membership ---------------------------------------------
+
+    def admit(self, arm: Arm) -> Optional[Arm]:
+        """Add an arm; returns the evicted oldest arm when over cap
+        (the eviction only drops it from ROTATION — the store keeps
+        the entry on disk)."""
+        arm.seq = max(arm.seq, self._seq)
+        self._seq = arm.seq + 1
+        self.arms.append(arm)
+        if len(self.arms) > self.CAP:
+            return self.arms.pop(0)
+        return None
+
+    def drop(self, index: int) -> Arm:
+        """Remove an arm that cannot be scheduled (e.g. wider than the
+        candidate buffer)."""
+        return self.arms.pop(index)
+
+    # -- credit fold (shared by every policy) ---------------------------
+
+    def credit_find(self, arm: Optional[list]) -> None:
+        """One edge-novel find, credited to the arm whose candidates
+        produced it (None = the base seed).  A capped-out arm's entry
+        may already be off the list — the credit is then a harmless
+        write to a dead object, exactly as before the extraction."""
+        if arm is None:
+            self.base_stats[1] += 1
+        else:
+            arm[2] += 1
+
+    def credit_period(self, active: Optional[list],
+                      period: int = 1) -> None:
+        """Close one feedback period: decay every arm's stats and
+        charge the period's selection to the arm that generated it."""
+        g = self.DECAY ** min(period or 1, 16)
+        self.base_stats[0] *= g
+        self.base_stats[1] *= g
+        for e in self.arms:
+            e[1] *= g
+            e[2] *= g
+        if active is None:
+            self.base_stats[0] += 1
+        else:
+            active[1] += 1
+
+    # -- selection ------------------------------------------------------
+
+    def select(self) -> Tuple[Optional[int], Optional[bytes]]:
+        """(arm index or None for the base seed, candidate bytes).
+        ``(None, None)`` means nothing schedulable (no base, no arms).
+        The candidate may differ from the arm's buffer (splice)."""
+        raise NotImplementedError
+
+    def favored_count(self) -> int:
+        """How many arms the policy currently considers frontier
+        (the ``corpus_favored`` gauge)."""
+        return len(self.arms)
+
+    # -- persistence ----------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        st = self.rng.getstate()
+        return {
+            "scheduler": self.name,
+            "base_stats": list(self.base_stats),
+            "rotations": self.rotations,
+            "rng_state": [st[0], list(st[1]), st[2]],
+            "seq": self._seq,
+        }
+
+    def load_state(self, d: Dict[str, Any]) -> None:
+        self.base_stats = [float(v) for v in
+                           d.get("base_stats", [0.0, 0.0])]
+        self.rotations = int(d.get("rotations", 0))
+        self._seq = int(d.get("seq", self._seq))
+        rs = d.get("rng_state")
+        if rs:
+            self.rng.setstate((rs[0], tuple(rs[1]), rs[2]))
+
+    def load_entries(self, entries: List[CorpusEntry]) -> None:
+        """Rebuild the arm list from stored entries (resume): entries
+        in admission order, rotation keeps the newest CAP of them —
+        exactly what a continuously-running loop would hold."""
+        for e in sorted(entries, key=lambda e: e.seq):
+            self.admit(Arm.from_entry(e))
+
+
+class BanditScheduler(Scheduler):
+    """Greedy optimistic decay bandit — the loop's historical policy,
+    ported verbatim.  Each arm scores ``(finds+1)/(selections+1)``
+    (unexplored arms score 1.0 — every new frontier probed once),
+    ties break toward the NEWEST discovery, and when two or more
+    findings exist half the corpus-arm turns fuzz an AFL-style splice
+    of the arm with a random partner (crossover inside the differing
+    region so magic bytes / headers survive)."""
+
+    name = "bandit"
+
+    def select(self) -> Tuple[Optional[int], Optional[bytes]]:
+        best, best_score = None, 0.0
+        if self.base_seed is not None:
+            best_score = ((self.base_stats[1] + 1.0)
+                          / (self.base_stats[0] + 1.0))
+        for i, (buf, sel, finds) in enumerate(self.arms):
+            score = (finds + 1.0) / (sel + 1.0)
+            if score >= best_score:     # >= : newest wins ties
+                best, best_score = i, score
+        if best is None:
+            return None, self.base_seed
+        cand = self.arms[best][0]
+        if len(self.arms) >= 2 and self.rng.random() < 0.5:
+            partner = self.rng.choice(
+                [e[0] for j, e in enumerate(self.arms) if j != best])
+            # AFL-style splice (afl locate_diffs semantics): cross
+            # over INSIDE the differing region so the common prefix
+            # — magic bytes, headers — survives
+            n = min(len(cand), len(partner))
+            fd = next((i for i in range(n)
+                       if cand[i] != partner[i]), None)
+            if fd is not None:
+                ld = next(i for i in range(n - 1, -1, -1)
+                          if cand[i] != partner[i])
+                if ld > fd + 1:
+                    k = self.rng.randrange(fd + 1, ld)
+                    cand = cand[:k] + partner[k:]
+        return best, cand
+
+    def favored_count(self) -> int:
+        """Arms whose score matches or beats the base seed's — the
+        frontier the greedy choice draws from."""
+        base = ((self.base_stats[1] + 1.0)
+                / (self.base_stats[0] + 1.0)) \
+            if self.base_seed is not None else 0.0
+        return sum(1 for _, sel, finds in self.arms
+                   if (finds + 1.0) / (sel + 1.0) >= base)
+
+
+class RoundRobinScheduler(Scheduler):
+    """Round-robin over the base seed plus every arm, in admission
+    order — the uniform-budget baseline coverage-guided policies are
+    measured against (``bench.py --schedule``)."""
+
+    name = "rr"
+
+    def __init__(self, cap: Optional[int] = None):
+        super().__init__(cap)
+        self._cursor = 0
+
+    def select(self) -> Tuple[Optional[int], Optional[bytes]]:
+        slots = (1 if self.base_seed is not None else 0) + len(self.arms)
+        if slots == 0:
+            return None, None
+        pos = self._cursor % slots
+        self._cursor += 1
+        if self.base_seed is not None:
+            if pos == 0:
+                return None, self.base_seed
+            pos -= 1
+        return pos, self.arms[pos][0]
+
+    def state_dict(self) -> Dict[str, Any]:
+        d = super().state_dict()
+        d["cursor"] = self._cursor
+        return d
+
+    def load_state(self, d: Dict[str, Any]) -> None:
+        super().load_state(d)
+        self._cursor = int(d.get("cursor", 0))
+
+
+class RareEdgeScheduler(Scheduler):
+    """FairFuzz-style rarity scheduling: prefer arms whose coverage
+    signature contains the edges hit by the FEWEST corpus entries —
+    the rare-branch frontier rarity targeting dominates coverage
+    growth on (PAPERS.md).  Global hit counts fold over every
+    admitted signature (local and synced), so a fleet's pulls sharpen
+    each worker's rarity estimate.  Unsigned arms (no signature
+    available on this tier) are probed once, then fall behind signed
+    arms; among equal rarity the least-selected arm wins, ties toward
+    the newest."""
+
+    name = "rare-edge"
+
+    def __init__(self, cap: Optional[int] = None):
+        super().__init__(cap)
+        self.edge_hits: Dict[int, int] = {}
+
+    def _forget(self, arm: Optional[Arm]) -> None:
+        if arm is None or not arm.sig:
+            return
+        for e in arm.sig:
+            n = self.edge_hits.get(e, 0) - 1
+            if n <= 0:
+                self.edge_hits.pop(e, None)
+            else:
+                self.edge_hits[e] = n
+
+    def admit(self, arm: Arm) -> Optional[Arm]:
+        if arm.sig:
+            for e in arm.sig:
+                self.edge_hits[e] = self.edge_hits.get(e, 0) + 1
+        evicted = super().admit(arm)
+        self._forget(evicted)
+        return evicted
+
+    def drop(self, index: int) -> Arm:
+        """Arms dropped from rotation (e.g. wider than the candidate
+        buffer) must release their edge counts too, or surviving
+        arms' rarity reads permanently stale."""
+        arm = super().drop(index)
+        self._forget(arm)
+        return arm
+
+    def _rarity(self, arm: Arm) -> float:
+        if not arm.sig:
+            # unsigned: probe once (rarity 0 beats everything), then
+            # deprioritize below any signed arm
+            return 0.0 if arm[1] == 0 else float("inf")
+        return min(self.edge_hits.get(e, 1) for e in arm.sig)
+
+    def select(self) -> Tuple[Optional[int], Optional[bytes]]:
+        if not self.arms:
+            return None, self.base_seed
+        best, best_key = None, None
+        for i, arm in enumerate(self.arms):
+            key = (self._rarity(arm), float(arm[1]), -arm.seq)
+            if best_key is None or key < best_key:
+                best, best_key = i, key
+        if best_key is not None and best_key[0] == float("inf") \
+                and self.base_seed is not None:
+            # every arm is unsigned and already probed: split budget
+            # with the base seed instead of thrashing blind arms
+            if self.rng.random() < 0.5:
+                return None, self.base_seed
+        return best, self.arms[best][0]
+
+    def favored_count(self) -> int:
+        if not self.edge_hits:
+            return len(self.arms)
+        rarest = min(self.edge_hits.values())
+        return sum(1 for a in self.arms if a.sig and
+                   min(self.edge_hits.get(e, 1) for e in a.sig)
+                   <= rarest)
+
+    def load_entries(self, entries: List[CorpusEntry]) -> None:
+        super().load_entries(entries)   # admit() folds edge_hits
+
+
+SCHEDULERS = {
+    "bandit": BanditScheduler,
+    "rare-edge": RareEdgeScheduler,
+    "rr": RoundRobinScheduler,
+}
+
+
+def make_scheduler(name: str, cap: Optional[int] = None) -> Scheduler:
+    try:
+        cls = SCHEDULERS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scheduler {name!r} (choose from "
+            f"{', '.join(sorted(SCHEDULERS))})")
+    return cls(cap)

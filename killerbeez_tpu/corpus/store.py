@@ -1,0 +1,278 @@
+"""On-disk corpus store — the campaign's durable seed set.
+
+Layout mirrors the reference's ``new_paths/`` (one file per entry
+named by its input md5) and adds what the reference kept only in
+operator heads: a ``<md5>.json`` metadata sidecar per entry and a
+``campaign.json`` state record, so a killed campaign resumes with its
+full corpus, bandit stats and lineage instead of starting over.
+
+    <corpus-dir>/
+        <md5>            raw input bytes (same naming as new_paths/)
+        <md5>.json       metadata sidecar (schema below)
+        campaign.json    scheduler + campaign state (atomic snapshot)
+        mutator.state    mutator resume state (JSON string)
+        instrumentation.state   coverage resume state (JSON string)
+
+Sidecar schema (docs/CORPUS.md):
+
+    {"md5": ..., "seq": N,            # admission order (monotone)
+     "cov_hash": ...,                 # coverage dedup key (sync)
+     "sig": [slot, ...] | null,       # coverage signature (edge slots)
+     "edge_hits": {slot: count} | null,   # edge-hit summary
+     "selections": float, "finds": float, # bandit arm stats (decayed)
+     "parent": md5 | "base" | null,   # lineage: generating arm
+     "source": "local" | "sync",
+     "discovered": unix_time}
+
+Every write is atomic (tmp file + ``os.replace``, the telemetry
+sink's discipline) so a tailer or a crash mid-write never leaves a
+torn entry; ``load()`` skips unreadable sidecars instead of dying —
+a store survives its own worst write.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.fileio import ensure_dir, md5_hex
+from ..utils.logging import WARNING_MSG
+
+STATE_FILE = "campaign.json"
+MUTATOR_STATE_FILE = "mutator.state"
+INSTR_STATE_FILE = "instrumentation.state"
+_RESERVED = (STATE_FILE, MUTATOR_STATE_FILE, INSTR_STATE_FILE)
+
+
+def coverage_hash(sig: Optional[List[int]],
+                  buf: Optional[bytes] = None) -> str:
+    """Dedup key for cross-worker exchange: the sha1 of the sorted
+    edge-slot signature when one exists (two different inputs hitting
+    the same edge set are one frontier), else the content md5 — an
+    unsigned entry still dedups exactly."""
+    if sig:
+        h = hashlib.sha1(
+            ",".join(str(s) for s in sorted(set(sig))).encode())
+        return "sig:" + h.hexdigest()
+    return "md5:" + (md5_hex(buf) if buf is not None else "")
+
+
+class CorpusEntry:
+    """One stored corpus entry: input bytes + metadata sidecar."""
+
+    __slots__ = ("buf", "md5", "seq", "sig", "edge_hits", "selections",
+                 "finds", "parent", "source", "discovered", "cov_hash")
+
+    def __init__(self, buf: bytes, md5: Optional[str] = None,
+                 seq: int = 0, sig: Optional[List[int]] = None,
+                 edge_hits: Optional[Dict[int, int]] = None,
+                 selections: float = 0.0, finds: float = 0.0,
+                 parent: Optional[str] = None, source: str = "local",
+                 discovered: Optional[float] = None,
+                 cov_hash: Optional[str] = None):
+        self.buf = bytes(buf)
+        self.md5 = md5 or md5_hex(self.buf)
+        self.seq = int(seq)
+        self.sig = sorted(set(int(s) for s in sig)) if sig else None
+        self.edge_hits = ({int(k): int(v) for k, v in edge_hits.items()}
+                          if edge_hits else None)
+        self.selections = float(selections)
+        self.finds = float(finds)
+        self.parent = parent
+        self.source = source
+        self.discovered = (time.time() if discovered is None
+                           else float(discovered))
+        self.cov_hash = cov_hash or coverage_hash(self.sig, self.buf)
+
+    def meta_dict(self) -> Dict[str, Any]:
+        return {
+            "md5": self.md5, "seq": self.seq, "cov_hash": self.cov_hash,
+            "sig": self.sig,
+            "edge_hits": ({str(k): v for k, v in self.edge_hits.items()}
+                          if self.edge_hits else None),
+            "selections": self.selections, "finds": self.finds,
+            "parent": self.parent, "source": self.source,
+            "discovered": self.discovered,
+        }
+
+    @classmethod
+    def from_meta(cls, buf: bytes, meta: Dict[str, Any]) -> "CorpusEntry":
+        return cls(buf, md5=meta.get("md5"), seq=meta.get("seq", 0),
+                   sig=meta.get("sig"),
+                   edge_hits=meta.get("edge_hits"),
+                   selections=meta.get("selections", 0.0),
+                   finds=meta.get("finds", 0.0),
+                   parent=meta.get("parent"),
+                   source=meta.get("source", "local"),
+                   discovered=meta.get("discovered"),
+                   cov_hash=meta.get("cov_hash"))
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)               # atomic on POSIX
+
+
+class CorpusStore:
+    """Directory-backed corpus with atomic entry/state writes.
+
+    The store is the durable tier under the in-memory scheduler arms:
+    admissions write through immediately (a kill after an admission
+    loses nothing), arm stats and campaign state flush periodically
+    (bounded staleness, bandit scores re-converge within one decay
+    period).  All store I/O degrades to warnings — persistence must
+    never kill a campaign over a full disk.
+    """
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        ensure_dir(self.root)
+        # continue the admission counter past any existing entries:
+        # writing into a pre-populated store without load() (e.g.
+        # --corpus-dir reused without --resume) must not mint
+        # colliding seq numbers — resume's seq-ordered rebuild
+        # depends on a monotone timeline
+        self._next_seq = 0
+        try:
+            for name in os.listdir(self.root):
+                if not name.endswith(".json") or name in _RESERVED:
+                    continue
+                try:
+                    with open(os.path.join(self.root, name)) as f:
+                        seq = int(json.load(f).get("seq", -1))
+                    self._next_seq = max(self._next_seq, seq + 1)
+                except (OSError, ValueError):
+                    continue
+        except OSError:
+            pass
+
+    # -- entries --------------------------------------------------------
+
+    def entry_path(self, md5: str) -> str:
+        return os.path.join(self.root, md5)
+
+    def meta_path(self, md5: str) -> str:
+        return os.path.join(self.root, md5 + ".json")
+
+    def next_seq(self) -> int:
+        n = self._next_seq
+        self._next_seq += 1
+        return n
+
+    def put(self, entry: CorpusEntry) -> bool:
+        """Write one entry (buf + sidecar, both atomic); returns False
+        when an entry with this md5 already exists (content dedup)."""
+        path = self.entry_path(entry.md5)
+        if os.path.exists(path):
+            return False
+        try:
+            _atomic_write(path, entry.buf)
+            _atomic_write(self.meta_path(entry.md5),
+                          json.dumps(entry.meta_dict()).encode())
+        except OSError as e:
+            WARNING_MSG("corpus store write failed for %s: %s",
+                        entry.md5, e)
+            return False
+        self._next_seq = max(self._next_seq, entry.seq + 1)
+        return True
+
+    def update_meta(self, entry: CorpusEntry) -> None:
+        """Rewrite one entry's sidecar (stats flush)."""
+        try:
+            _atomic_write(self.meta_path(entry.md5),
+                          json.dumps(entry.meta_dict()).encode())
+        except OSError as e:
+            WARNING_MSG("corpus sidecar update failed for %s: %s",
+                        entry.md5, e)
+
+    def remove(self, md5: str) -> None:
+        for p in (self.entry_path(md5), self.meta_path(md5)):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def load(self) -> List[CorpusEntry]:
+        """Every readable entry, in admission (seq) order.  A missing
+        or torn sidecar degrades to default metadata — the input bytes
+        are the artifact that must never be lost."""
+        entries: List[CorpusEntry] = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return entries
+        for name in sorted(names):
+            if name in _RESERVED or name.endswith((".json", ".tmp")):
+                continue
+            path = os.path.join(self.root, name)
+            if not os.path.isfile(path):
+                continue
+            try:
+                with open(path, "rb") as f:
+                    buf = f.read()
+            except OSError as e:
+                WARNING_MSG("corpus entry %s unreadable: %s", name, e)
+                continue
+            meta: Dict[str, Any] = {}
+            try:
+                with open(self.meta_path(name)) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                meta = {"md5": name, "seq": self._next_seq}
+            entries.append(CorpusEntry.from_meta(buf, meta))
+        entries.sort(key=lambda e: e.seq)
+        if entries:
+            self._next_seq = max(self._next_seq,
+                                 max(e.seq for e in entries) + 1)
+        return entries
+
+    def __len__(self) -> int:
+        try:
+            return sum(1 for n in os.listdir(self.root)
+                       if n not in _RESERVED
+                       and not n.endswith((".json", ".tmp"))
+                       and os.path.isfile(os.path.join(self.root, n)))
+        except OSError:
+            return 0
+
+    # -- campaign state -------------------------------------------------
+
+    def save_state(self, state: Dict[str, Any]) -> None:
+        try:
+            _atomic_write(os.path.join(self.root, STATE_FILE),
+                          json.dumps(state).encode())
+        except OSError as e:
+            WARNING_MSG("campaign state write failed: %s", e)
+
+    def load_state(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(os.path.join(self.root, STATE_FILE)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def save_component_state(self, which: str, state: str) -> None:
+        """Persist a component's serialized resume state (``mutator``
+        or ``instrumentation``) next to the corpus."""
+        name = (MUTATOR_STATE_FILE if which == "mutator"
+                else INSTR_STATE_FILE)
+        try:
+            _atomic_write(os.path.join(self.root, name), state.encode())
+        except OSError as e:
+            WARNING_MSG("%s state write failed: %s", which, e)
+
+    def load_component_state(self, which: str) -> Optional[str]:
+        name = (MUTATOR_STATE_FILE if which == "mutator"
+                else INSTR_STATE_FILE)
+        try:
+            with open(os.path.join(self.root, name)) as f:
+                return f.read()
+        except OSError:
+            return None

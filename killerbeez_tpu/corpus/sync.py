@@ -1,0 +1,212 @@
+"""Manager-mediated corpus exchange — fleet workers share findings.
+
+The reference's fleet shares coverage only through operators running
+the merger tool between campaigns; here workers exchange the corpus
+itself while running: every edge-novel entry is POSTed to the
+manager's ``/api/corpus/<campaign>`` (deduped server-side by coverage
+hash), and each worker periodically pulls peers' entries into its
+local store and rotation — one worker's frontier becomes every
+worker's next seed.
+
+Transport discipline adapts the stats heartbeats' to an IN-LOOP
+caller: HTTP-level rejections fail fast per entry (the manager saw
+the request — retrying is a poison pill), transport errors abort the
+ROUND, and — because ``maybe_sync()`` runs on the fuzzing-loop
+thread, not a heartbeat thread — the in-loop default is a single
+attempt per request (``attempts=1``): the interval gate already
+retries at round granularity, so a dead manager costs one failed
+connection per round instead of inline backoff sleeps.  Everything
+degrades to warnings — corpus sync must never stall or kill the
+fuzzing loop.
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+from typing import Any, Dict, List, Optional, Set
+
+from ..utils.logging import DEBUG_MSG, WARNING_MSG
+from .schedule import Arm
+from .store import CorpusEntry
+
+
+class CorpusSync:
+    """One campaign's exchange client: push local edge-novel entries,
+    pull peers' entries into the local store + scheduler."""
+
+    def __init__(self, manager_url: str, campaign: str,
+                 worker: str = "anon", interval_s: float = 30.0,
+                 attempts: int = 1):
+        self.url = f"{manager_url.rstrip('/')}/api/corpus/{campaign}"
+        self.campaign = str(campaign)
+        self.worker = worker
+        self.interval_s = float(interval_s)
+        self.attempts = int(attempts)
+        self._last_sync = 0.0
+        self._pushed: Set[str] = set()      # cov_hashes sent (or known)
+        self._pending: List[CorpusEntry] = []   # admitted, not yet sent
+        self._store_scanned = False
+        self._cursor = 0                     # server-side id high-water
+        self.pushed_n = 0
+        self.pulled_n = 0
+
+    def note_entry(self, entry: CorpusEntry) -> None:
+        """The loop hands every admitted entry here at triage time;
+        the next sync round pushes it.  O(1) — no store rescans."""
+        self._pending.append(entry)
+
+    # -- transport (heartbeat discipline) -------------------------------
+
+    def _request(self, payload: Optional[Dict[str, Any]] = None,
+                 method: str = "POST",
+                 query: str = "") -> Any:
+        from ..manager.worker import _request_retry
+        return _request_retry(self.url + query, payload, method,
+                              attempts=self.attempts)
+
+    # -- push -----------------------------------------------------------
+
+    def push_entry(self, entry: CorpusEntry) -> Optional[bool]:
+        """POST one entry; True when the manager stored it as new,
+        False when it was a coverage-hash duplicate or the manager
+        REJECTED it (HTTP error: the request arrived and was refused
+        — retrying the same entry forever would poison every future
+        round), None on transport failure (the caller aborts the
+        round — one failed request must not become one backoff cycle
+        PER entry)."""
+        import urllib.error
+        if entry.cov_hash in self._pushed:
+            return False
+        try:
+            resp = self._request({
+                "worker": self.worker,
+                "md5": entry.md5,
+                "cov_hash": entry.cov_hash,
+                "content_b64": base64.b64encode(entry.buf).decode(),
+                "meta": entry.meta_dict(),
+            })
+        except urllib.error.HTTPError as e:
+            WARNING_MSG("corpus push rejected by %s (%s): dropping "
+                        "entry %s from sync", self.url, e, entry.md5)
+            self._pushed.add(entry.cov_hash)    # never retried
+            return False
+        except Exception as e:
+            WARNING_MSG("corpus push to %s failed: %s", self.url, e)
+            return None
+        self._pushed.add(entry.cov_hash)
+        if resp and resp.get("new"):
+            self.pushed_n += 1
+            return True
+        return False
+
+    # -- pull -----------------------------------------------------------
+
+    def pull(self) -> List[CorpusEntry]:
+        """GET peers' entries newer than the cursor; returns the new
+        (locally unseen, not self-authored) ones."""
+        from urllib.parse import quote
+        try:
+            resp = self._request(
+                None, method="GET",
+                query=f"?since={self._cursor}"
+                      f"&exclude={quote(self.worker, safe='')}")
+        except Exception as e:
+            WARNING_MSG("corpus pull from %s failed: %s", self.url, e)
+            return []
+        if not resp:
+            return []
+        self._cursor = max(self._cursor, int(resp.get("latest", 0)))
+        out: List[CorpusEntry] = []
+        for row in resp.get("entries", []):
+            cov = row.get("cov_hash", "")
+            if cov in self._pushed:
+                continue                 # already have this frontier
+            self._pushed.add(cov)        # don't push it back either
+            try:
+                buf = base64.b64decode(row["content_b64"])
+            except (KeyError, ValueError):
+                continue
+            meta = row.get("meta") or {}
+            meta.setdefault("md5", row.get("md5"))
+            meta["source"] = "sync"
+            out.append(CorpusEntry.from_meta(buf, meta))
+        return out
+
+    # -- loop hook ------------------------------------------------------
+
+    def maybe_sync(self, fuzzer, force: bool = False) -> bool:
+        """Called by the loop between batches: when the interval has
+        elapsed, push unsynced local arms/store entries and fold
+        peers' entries into the local store, scheduler and dedup set.
+        ``force`` skips the interval gate — the loop forces one round
+        after its end-of-run drain, so findings triaged after the
+        last in-loop sync (short campaigns triage EVERYTHING in the
+        drain) still reach the fleet.  Returns True when a sync round
+        ran."""
+        now = time.time()
+        if not force and now - self._last_sync < self.interval_s:
+            return False
+        self._last_sync = now
+        reg = fuzzer.telemetry.registry
+        # push set: entries the loop admitted since the last round
+        # (note_entry, O(1)) plus — ONCE, for resumed campaigns — the
+        # pre-existing store and rotation arms; never a per-round
+        # store rescan
+        batch: List[CorpusEntry] = self._pending
+        self._pending = []
+        if not self._store_scanned:
+            self._store_scanned = True
+            batch = batch + [a.to_entry()
+                             for a in fuzzer.scheduler.arms]
+            if fuzzer.store is not None:
+                batch = batch + fuzzer.store.load()
+        sent = 0
+        failed = False
+        seen_local: Set[str] = set()
+        for i, e in enumerate(batch):
+            if e.source == "sync":
+                # a previously-PULLED entry (resume): known frontier —
+                # never pushed back, and the pull loop must not
+                # re-admit it after a restart resets the cursor
+                self._pushed.add(e.cov_hash)
+                continue
+            if e.cov_hash in seen_local or e.cov_hash in self._pushed:
+                continue
+            seen_local.add(e.cov_hash)
+            ok = self.push_entry(e)
+            if ok is None:
+                # transport down: requeue the remainder and bail —
+                # one backoff cycle per ROUND, not per entry
+                self._pending = [x for x in batch[i:]
+                                 if x.cov_hash not in self._pushed] \
+                    + self._pending
+                failed = True
+                break
+            sent += int(ok)
+        # pull: peers' frontier into store + rotation
+        pulled = 0
+        if not failed:
+            for e in self.pull():
+                if e.md5 in fuzzer._seen["new_paths"]:
+                    continue        # already local (e.g. post-resume)
+                pulled += 1
+                self.pulled_n += 1
+                if fuzzer.store is not None:
+                    e.seq = fuzzer.store.next_seq()
+                    fuzzer.store.put(e)
+                # a pulled entry is a known path now: don't re-record
+                # it as a local finding if this worker reproduces it
+                fuzzer._seen["new_paths"].add(e.md5)
+                if fuzzer.feedback:
+                    fuzzer.scheduler.admit(Arm.from_entry(e))
+                DEBUG_MSG("corpus sync: pulled %s from %s", e.md5,
+                          e.parent or "peer")
+        # per-round deltas: restored cumulative counters (--resume)
+        # keep counting up instead of snapping to process-local totals
+        if sent:
+            reg.count("corpus_synced_out", sent)
+        if pulled:
+            reg.count("corpus_synced_in", pulled)
+        reg.gauge("corpus_arms", len(fuzzer.scheduler.arms))
+        return True

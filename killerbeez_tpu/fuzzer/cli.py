@@ -10,6 +10,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import List, Optional
 
@@ -62,6 +63,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "batches, rotate the seed through new-path "
                         "findings (default: ON for randomized "
                         "mutators, every 8 batches; 0 = off)")
+    p.add_argument("--corpus-dir",
+                   help="persistent corpus store directory: every "
+                        "edge-novel finding is written through with "
+                        "its metadata sidecar (bandit stats, coverage "
+                        "signature, lineage) so a campaign can be "
+                        "resumed or inspected offline (kb-corpus)")
+    p.add_argument("--resume", action="store_true",
+                   help="continue a killed campaign from --corpus-dir "
+                        "(default <output>/corpus): restores rotation "
+                        "arms, scheduler stats, lifetime counters and "
+                        "— when the previous run exited through its "
+                        "finally block — mutator/instrumentation "
+                        "state; -n counts THIS invocation's execs")
+    p.add_argument("--schedule", default="bandit",
+                   choices=["bandit", "rare-edge", "rr"],
+                   help="seed-scheduling policy for corpus feedback "
+                        "(default bandit = the historical greedy-"
+                        "optimistic decay bandit; rare-edge = "
+                        "FairFuzz-style rarest-edge preference; rr = "
+                        "round-robin baseline)")
+    p.add_argument("--sync-manager",
+                   help="manager base URL for fleet corpus exchange "
+                        "(POST/GET /api/corpus/<campaign>); requires "
+                        "--sync-campaign")
+    p.add_argument("--sync-campaign",
+                   help="campaign key for --sync-manager (job id)")
+    p.add_argument("--sync-worker", default=None,
+                   help="worker name for corpus sync (default "
+                        "worker-<pid>)")
+    p.add_argument("--sync-interval", type=float, default=30.0,
+                   help="seconds between corpus sync rounds "
+                        "(default 30)")
     p.add_argument("-dt", "--debug-triage", action="store_true",
                    help="re-run each unique crash once under the "
                         "ptrace debug tier and save signal-level "
@@ -95,6 +128,49 @@ def build_parser() -> argparse.ArgumentParser:
 def list_components() -> str:
     return (driver_help() + "\n" + instrumentation_help() + "\n"
             + mutator_help())
+
+
+def _wire_rare_edge_signer(fuzzer, driver) -> None:
+    """``--schedule rare-edge`` needs per-entry coverage signatures.
+    Each admitted finding is signed with ONE extra execution —
+    admissions are rare, so the batched hot path stays untouched:
+    device tiers sign on a side instrumentation instance with edge
+    reporting forced on (the main instance keeps its fused/superbatch
+    eligibility); host tiers re-run the input on the live target and
+    read the raw trace.  Tiers that cannot report edges (ipt hash
+    mode) leave entries unsigned — the scheduler probes those once
+    and falls back gracefully."""
+    import json as _json
+
+    import numpy as _np
+
+    instr = driver.instrumentation
+    side: dict = {}
+
+    def sign(buf: bytes):
+        if instr.device_backed:
+            s = side.get("instr")
+            if s is None:
+                from ..tools.tracer import force_edges_option
+                s = instrumentation_factory(
+                    instr.name,
+                    force_edges_option(_json.dumps(instr.options)))
+                side["instr"] = s
+            s.enable(buf)
+            edges = s.get_edges()
+            return [e for e, _ in edges] if edges else None
+        # host tier: one extra exec on the live target (novelty fold
+        # is idempotent — the entry was just executed)
+        driver.test_input(buf)
+        trace_fn = getattr(instr, "last_trace", None)
+        if trace_fn is not None:
+            trace = trace_fn()
+            if trace is not None:
+                return [int(i) for i in _np.flatnonzero(trace)]
+        edges = instr.get_edges()
+        return [e for e, _ in edges] if edges else None
+
+    fuzzer._signer = sign
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -150,13 +226,34 @@ def main(argv: Optional[List[str]] = None) -> int:
             driver = driver_factory(args.driver, args.driver_options,
                                     instrumentation, mutator)
 
+        corpus_dir = args.corpus_dir
+        if args.resume and not corpus_dir:
+            corpus_dir = os.path.join(args.output, "corpus")
+        sync = None
+        if args.sync_manager:
+            if not args.sync_campaign:
+                print("error: --sync-manager needs --sync-campaign",
+                      file=sys.stderr)
+                return 2
+            from ..corpus.sync import CorpusSync
+            sync = CorpusSync(args.sync_manager, args.sync_campaign,
+                              worker=(args.sync_worker
+                                      or f"worker-{os.getpid()}"),
+                              interval_s=args.sync_interval)
+
         fuzzer = Fuzzer(driver, output_dir=args.output,
                         batch_size=args.batch_size,
                         debug_triage=args.debug_triage,
                         feedback=args.feedback,
                         accumulate=args.accumulate,
                         telemetry=(False if args.no_stats else None),
-                        stats_interval=args.stats_interval)
+                        stats_interval=args.stats_interval,
+                        scheduler=args.schedule,
+                        corpus_dir=corpus_dir,
+                        resume=args.resume,
+                        sync=sync)
+        if args.schedule == "rare-edge":
+            _wire_rare_edge_signer(fuzzer, driver)
         stats = fuzzer.run(args.iterations)
         # both rates read the SAME registry the loop recorded into —
         # the CLI never recomputes from its own wall clock

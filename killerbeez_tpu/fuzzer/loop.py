@@ -11,12 +11,16 @@ reference-shaped scalar loop.
 
 from __future__ import annotations
 
+import base64
 import os
+import time
 from typing import Dict, Optional, Union
 
 import numpy as np
 
 from .. import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_NONE
+from ..corpus.schedule import Arm, Scheduler, make_scheduler
+from ..corpus.store import CorpusStore
 from ..drivers.base import Driver
 from ..telemetry import MetricsRegistry, Telemetry
 from ..utils.fileio import ensure_dir, md5_hex, write_buffer_to_file
@@ -155,7 +159,12 @@ class Fuzzer:
                  debug_triage: bool = False, feedback: int = -1,
                  accumulate: int = 0,
                  telemetry: Union[Telemetry, bool, None] = None,
-                 stats_interval: float = 5.0):
+                 stats_interval: float = 5.0,
+                 scheduler: Union[Scheduler, str, None] = None,
+                 corpus_dir: Optional[str] = None,
+                 resume: bool = False,
+                 sync=None,
+                 persist_interval: float = 5.0):
         self.driver = driver
         self.output_dir = output_dir
         self.batch_size = int(batch_size)
@@ -190,28 +199,201 @@ class Fuzzer:
         #: every `feedback` batches, rotate the mutator seed through
         #: new-path findings (coverage-guided corpus loop; 0 = off)
         self.feedback = int(feedback)
-        # corpus arms: [buf, selections, edge_novel_finds] — the
-        # rotation is a greedy optimistic bandit over these plus the
-        # base seed (see _rotate_seed)
-        self._corpus: list = []
-        self._base_stats = [0, 0]       # [selections, finds]
+        # seed scheduling lives in the corpus subsystem: the scheduler
+        # owns the arms ([buf, selections, finds] + metadata), the
+        # base-seed stats and the per-period credit fold; the loop
+        # owns WHEN to rotate and the shape-stable seed swap.  The
+        # default bandit policy is the exact in-loop behavior it
+        # replaced (corpus/schedule.py).
+        if isinstance(scheduler, Scheduler):
+            self.scheduler = scheduler
+        else:
+            self.scheduler = make_scheduler(scheduler or "bandit",
+                                            cap=self.CORPUS_CAP)
+        #: durable corpus tier: admissions write through immediately;
+        #: scheduler/campaign state flushes on `persist_interval`
+        self.store = CorpusStore(corpus_dir) if corpus_dir else None
+        #: manager-mediated corpus exchange (corpus/sync.py); polled
+        #: between batches, time-gated internally
+        self.sync = sync
+        #: optional signature hook: bytes -> [edge slot, ...] for the
+        #: entry sidecar (rare-edge scheduling, sync coverage dedup)
+        self._signer = None
+        self._persist_interval = float(persist_interval)
+        self._last_persist = 0.0
         # the arm whose candidates the batch being TRIAGED came from:
         # with a deep pipeline, triage lags generation, so finds must
         # credit the GENERATING arm (entry object, robust to corpus
         # index shifts), not whichever arm is active at triage time
         self._credit_arm: Optional[list] = None
         self._active_entry: Optional[list] = None
-        self._base_seed = None
-        self._rotations = 0
+        self._iter_base = 0             # execs restored by --resume
         self._fb_batches = 0
-        import random as _random
-        self._fb_rng = _random.Random(0x6b62)  # deterministic splices
         self._dbg = None
         self.stats = FuzzStats(telemetry.registry)
         self._seen = {k: set() for k in ("crashes", "hangs", "new_paths")}
         if write_findings:
             for sub in ("crashes", "hangs", "new_paths"):
                 ensure_dir(os.path.join(output_dir, sub))
+        if resume:
+            if self.store is None:
+                raise ValueError("resume requires a corpus_dir")
+            self._restore_campaign()
+
+    # -- historical aliases (the scheduler owns this state now) ---------
+
+    @property
+    def _corpus(self) -> list:
+        return self.scheduler.arms
+
+    @property
+    def _base_stats(self) -> list:
+        return self.scheduler.base_stats
+
+    @property
+    def _base_seed(self) -> Optional[bytes]:
+        return self.scheduler.base_seed
+
+    @_base_seed.setter
+    def _base_seed(self, v: Optional[bytes]) -> None:
+        self.scheduler.base_seed = v
+
+    @property
+    def _rotations(self) -> int:
+        return self.scheduler.rotations
+
+    @_rotations.setter
+    def _rotations(self, v: int) -> None:
+        self.scheduler.rotations = v
+
+    @property
+    def _fb_rng(self):
+        return self.scheduler.rng
+
+    # -- campaign persistence / resume (corpus/store.py) ----------------
+
+    def _persist_campaign(self, force: bool = False) -> None:
+        """Flush scheduler + campaign state to the corpus store.
+        Interval writes cover a hard kill (scheduler stats, counters,
+        arm sidecars — all host-side, no device sync); ``force`` (run
+        end, including interrupts) adds the mutator/instrumentation
+        resume states, whose serialization may join the device
+        pipeline."""
+        if self.store is None:
+            return
+        now = time.time()
+        if not force and now - self._last_persist < self._persist_interval:
+            return
+        self._last_persist = now
+        base = self.scheduler.base_seed
+        reg = self.telemetry.registry
+        counters = dict(reg.counters)
+        # run_seconds is normally folded at run_ended(); a hard kill
+        # never gets there, so snapshot the LIVE active time — else a
+        # resumed campaign divides restored execs by a near-zero
+        # denominator and reports an absurd lifetime rate
+        counters["run_seconds"] = reg.active_seconds()
+        self.store.save_state({
+            "version": 1,
+            "scheduler_state": self.scheduler.state_dict(),
+            "counters": counters,
+            # arm stats ride in THIS snapshot (one atomic write per
+            # interval); per-arm sidecars rewrite only on force — 256
+            # fsyncs per 5s interval would stall the loop
+            "arm_stats": {a.md5: [float(a[1]), float(a[2])]
+                          for a in self.scheduler.arms},
+            "fb_batches": self._fb_batches,
+            "feedback": self.feedback,
+            "base_seed_b64": (base64.b64encode(base).decode()
+                              if base else None),
+            "saved_at": now,
+        })
+        if not force:
+            return
+        for arm in self.scheduler.arms:
+            self.store.update_meta(arm.to_entry())
+        mut = getattr(self.driver, "mutator", None)
+        instr = getattr(self.driver, "instrumentation", None)
+        for which, comp in (("mutator", mut),
+                            ("instrumentation", instr)):
+            if comp is None:
+                continue
+            try:
+                self.store.save_component_state(which,
+                                                comp.get_state())
+            except NotImplementedError:
+                pass
+            except Exception as e:
+                WARNING_MSG("%s state persist failed: %s", which, e)
+
+    def _restore_campaign(self) -> None:
+        """Rebuild scheduler arms, campaign counters and component
+        states from the corpus store — ``--resume`` continues a killed
+        campaign where it stopped."""
+        entries = self.store.load()
+        self.scheduler.load_entries(entries)
+        for e in entries:
+            self._seen["new_paths"].add(e.md5)
+        # the output dir carries findings the store does not (bucket-
+        # only new paths, crashes, hangs) — recover their md5 names so
+        # dedup and the corpus_seen gauge continue exactly
+        for kind in self._seen:
+            d = os.path.join(self.output_dir, kind)
+            try:
+                names = os.listdir(d)
+            except OSError:
+                continue
+            for name in names:
+                if len(name) == 32 and all(
+                        c in "0123456789abcdef" for c in name):
+                    self._seen[kind].add(name)
+        st = self.store.load_state()
+        if st:
+            ss = st.get("scheduler_state") or {}
+            if ss.get("scheduler") not in (None, self.scheduler.name):
+                WARNING_MSG(
+                    "resuming a %s-scheduled campaign with %s: arm "
+                    "stats carry over, policy state starts fresh",
+                    ss.get("scheduler"), self.scheduler.name)
+            else:
+                self.scheduler.load_state(ss)
+            reg = self.telemetry.registry
+            for k, v in (st.get("counters") or {}).items():
+                reg.counters[k] = v
+            # arm stats from the campaign snapshot (fresher than the
+            # sidecars between force-persists)
+            stats = st.get("arm_stats") or {}
+            for a in self.scheduler.arms:
+                if a.md5 in stats:
+                    a[1], a[2] = stats[a.md5]
+            self._fb_batches = int(st.get("fb_batches", 0))
+            b64 = st.get("base_seed_b64")
+            if b64:
+                self.scheduler.base_seed = base64.b64decode(b64)
+        mut = getattr(self.driver, "mutator", None)
+        instr = getattr(self.driver, "instrumentation", None)
+        for which, comp in (("mutator", mut),
+                            ("instrumentation", instr)):
+            if comp is None:
+                continue
+            state = self.store.load_component_state(which)
+            if state is None:
+                continue
+            try:
+                comp.set_state(state)
+            except Exception as e:
+                WARNING_MSG("%s state restore failed (fresh %s "
+                            "state): %s", which, which, e)
+        # -n counts THIS invocation's executions; restored lifetime
+        # counters keep stats files and rates cumulative
+        self._iter_base = int(self.stats.iterations)
+        reg = self.telemetry.registry
+        reg.gauge("corpus_seen", len(self._seen["new_paths"]))
+        reg.gauge("corpus_arms", len(self.scheduler.arms))
+        INFO_MSG("resumed campaign: %d stored entries, %d rotation "
+                 "arms, %d execs done",
+                 len(entries), len(self.scheduler.arms),
+                 self.stats.iterations)
 
     # -- finding triage (reference fuzzer/main.c:393-417) ---------------
 
@@ -299,26 +481,43 @@ class Fuzzer:
             reg = self.telemetry.registry
             reg.rate("new_paths", 1)
             recorded = self._record("new_paths", buf)
-            reg.gauge("corpus_size", len(self._seen["new_paths"]))
+            # corpus_seen: distinct new-path inputs ever recorded;
+            # corpus_arms: entries actually in rotation (they used to
+            # be conflated in one misleading corpus_size gauge)
+            reg.gauge("corpus_seen", len(self._seen["new_paths"]))
             # corpus feedback keeps only EDGE-novel findings (ret 2:
             # a brand-new edge, not just a new hit-count bucket) —
             # bucket-only findings are overwhelmingly shallow
             # variants that dilute the rotation
-            if recorded and self.feedback and new_path == 2:
-                self._corpus.append([buf, 0, 0])
-                if len(self._corpus) > self.CORPUS_CAP:
-                    # the active arm may be the popped entry; the
+            if recorded and new_path == 2 and \
+                    (self.feedback or self.store is not None):
+                arm = Arm(buf,
+                          parent=getattr(self._credit_arm, "md5",
+                                         None) or "base",
+                          discovered=time.time())
+                if self._signer is not None:
+                    try:
+                        arm.sig = self._signer(buf)
+                    except Exception as e:
+                        WARNING_MSG("corpus signer failed: %s", e)
+                if self.store is not None:
+                    arm.seq = self.store.next_seq()
+                    with self.telemetry.timer("fs_write"):
+                        self.store.put(arm.to_entry())
+                if self.sync is not None:
+                    self.sync.note_entry(arm.to_entry())
+                if self.feedback:
+                    # admission evicts the oldest arm beyond the cap
+                    # (rotation only — the store keeps it); the
                     # ENTRY-object credit pointers (_active_entry,
                     # per-batch _credit_arm) stay valid regardless
-                    self._corpus.pop(0)
-                # credit the arm whose candidates PRODUCED this find
-                # (set per triaged batch; a capped-out arm's entry may
-                # already be off the corpus list — the credit is then
-                # a harmless write to a dead object)
-                if self._credit_arm is None:
-                    self._base_stats[1] += 1
-                else:
-                    self._credit_arm[2] += 1
+                    self.scheduler.admit(arm)
+                    # credit the arm whose candidates PRODUCED this
+                    # find (set per triaged batch; a capped-out arm's
+                    # entry may already be off the list — the credit
+                    # is then a harmless write to a dead object)
+                    self.scheduler.credit_find(self._credit_arm)
+                    reg.gauge("corpus_arms", len(self.scheduler.arms))
 
     # -- loops ----------------------------------------------------------
 
@@ -338,6 +537,15 @@ class Fuzzer:
         finally:
             self.telemetry.registry.run_ended()
             self.telemetry.flush()
+            # full campaign snapshot (scheduler + component states):
+            # runs on clean exits AND interrupts, so --resume
+            # continues exactly here
+            self._persist_campaign(force=True)
+            # one forced sync round AFTER the drain: entries triaged
+            # there (a short campaign triages everything in it) must
+            # still reach the fleet
+            if self.sync is not None:
+                self.sync.maybe_sync(self, force=True)
         INFO_MSG("Ran %d iterations in %.1f seconds "
                  "(%.0f execs/s lifetime, %.0f recent)",
                  self.stats.iterations, self.stats.elapsed,
@@ -345,9 +553,12 @@ class Fuzzer:
         return self.stats
 
     def _remaining(self, n_iterations: int) -> int:
+        """Executions still owed to THIS run() call: a resumed
+        campaign restores lifetime counters, so -n counts from the
+        resume point, not from zero."""
         if n_iterations < 0:
             return 2**62 - self.stats.iterations
-        return n_iterations - self.stats.iterations
+        return n_iterations - (self.stats.iterations - self._iter_base)
 
     @staticmethod
     def _compact_rows(compact):
@@ -479,86 +690,36 @@ class Fuzzer:
                     fn()
         return packed
 
-    #: per-period decay of bandit stats: scores track the RECENT
-    #: discovery rate, so the base seed's productive warm-up can't
-    #: lock the greedy choice forever, and a stale arm's score
-    #: relaxes back toward the optimistic 1.0 (periodic re-probe)
-    FEEDBACK_DECAY = 0.8
-
     def _credit_period(self) -> None:
-        """Close one feedback period: decay every arm's stats and
-        charge the period to the arm that was active during it."""
-        g = self.FEEDBACK_DECAY ** min(self.feedback or 1, 16)
-        self._base_stats[0] *= g
-        self._base_stats[1] *= g
-        for e in self._corpus:
-            e[1] *= g
-            e[2] *= g
-        # charge the period's selection to the arm ENTRY that actually
-        # generated it: when CORPUS_CAP pops the active arm, the index
-        # goes stale but the entry object is still the generator —
-        # charging base instead would depress base's score for batches
-        # it never produced (the find credits go to the same object)
-        if self._active_entry is None:
-            self._base_stats[0] += 1
-        else:
-            self._active_entry[1] += 1
+        """Close one feedback period: the scheduler decays every
+        arm's stats and charges the period to the arm ENTRY that
+        actually generated it — when the cap pops the active arm the
+        index goes stale but the entry object is still the generator
+        (the find credits go to the same object)."""
+        self.scheduler.credit_period(self._active_entry, self.feedback)
+        reg = self.telemetry.registry
+        reg.gauge("corpus_arms", len(self.scheduler.arms))
+        reg.gauge("corpus_favored", self.scheduler.favored_count())
 
     def _rotate_seed(self, mut) -> None:
         """Coverage-guided corpus feedback (beyond reference parity:
         the reference's equivalent is operators re-seeding campaigns
         from new_paths/ by hand or via manager jobs).
 
-        Seed selection is a greedy optimistic bandit over the base
-        seed plus every edge-novel finding: each arm scores
-        (finds + 1) / (selections + 1), where ``finds`` counts the
-        brand-new edges discovered while that arm's batches were
-        being triaged.  Unexplored arms score 1.0, so every new
-        frontier gets probed once; ties break toward the NEWEST
-        discovery; a productive base seed keeps most of the budget
-        instead of being diluted round-robin (round-3's rotation
-        measurably lost to single-seed havoc for exactly that
-        reason).  When at least two findings exist, half the
-        corpus-arm turns fuzz an AFL-style SPLICE of the arm with a
-        random partner — mutants then draw material from two
-        lineages, which plain single-seed havoc cannot do.
-
-        Seed swaps keep the candidate buffer width so compiled steps
-        never retrace (mutator.set_input(keep_length=True)); findings
-        too long for the buffer are dropped from rotation."""
-        self._rotations += 1
+        WHICH seed fuzzes next is the scheduler's call
+        (corpus/schedule.py — the default ``bandit`` policy is the
+        greedy optimistic decay bandit this loop used to hard-code,
+        ported verbatim; ``rare-edge`` and ``rr`` plug in through the
+        same interface).  The loop keeps the mechanics: seed swaps
+        hold the candidate buffer width so compiled steps never
+        retrace (mutator.set_input(keep_length=True)), the walk
+        position stays monotonic, and findings too wide for the
+        buffer are dropped from rotation and retried."""
+        self.scheduler.rotations += 1
         while True:
-            best, best_score = None, 0.0
-            if self._base_seed is not None:
-                best_score = ((self._base_stats[1] + 1.0)
-                              / (self._base_stats[0] + 1.0))
-            for i, (buf, sel, finds) in enumerate(self._corpus):
-                score = (finds + 1.0) / (sel + 1.0)
-                if score >= best_score:   # >= : newest wins ties
-                    best, best_score = i, score
-            if best is None:
-                if self._base_seed is None:
-                    return
-                cand = self._base_seed
-            else:
-                arm = self._corpus[best]
-                cand = arm[0]
-                if len(self._corpus) >= 2 and self._fb_rng.random() < 0.5:
-                    partner = self._fb_rng.choice(
-                        [e[0] for j, e in enumerate(self._corpus)
-                         if j != best])
-                    # AFL-style splice (afl locate_diffs semantics):
-                    # cross over INSIDE the differing region so the
-                    # common prefix — magic bytes, headers — survives
-                    n = min(len(cand), len(partner))
-                    fd = next((i for i in range(n)
-                               if cand[i] != partner[i]), None)
-                    if fd is not None:
-                        ld = next(i for i in range(n - 1, -1, -1)
-                                  if cand[i] != partner[i])
-                        if ld > fd + 1:
-                            k = self._fb_rng.randrange(fd + 1, ld)
-                            cand = cand[:k] + partner[k:]
+            best, cand = self.scheduler.select()
+            if cand is None:
+                return                # nothing schedulable
             try:
                 it = mut.get_current_iteration()
                 mut.set_input(cand, keep_length=True)
@@ -568,14 +729,14 @@ class Fuzzer:
                 # already executed
                 mut.iteration = it
                 self._active_entry = (None if best is None
-                                      else self._corpus[best])
-                DEBUG_MSG("feedback: arm %s (score %.2f), %d-byte "
-                          "input", best, best_score, len(cand))
+                                      else self.scheduler.arms[best])
+                DEBUG_MSG("feedback: arm %s (%s), %d-byte input",
+                          best, self.scheduler.name, len(cand))
                 return
             except ValueError:       # finding wider than the buffer
                 if best is None:
                     return            # base seed itself doesn't fit
-                self._corpus.pop(best)
+                self.scheduler.drop(best)
 
     def _resolve_accumulate(self) -> int:
         """Effective superbatch depth K.  Auto engages only on the
@@ -622,6 +783,9 @@ class Fuzzer:
         reg.rate("execs", b * k)
         reg.gauge("pipeline_depth", len(pending))
         self.telemetry.maybe_flush()
+        self._persist_campaign()
+        if self.sync is not None:
+            self.sync.maybe_sync(self)
 
     def _drain_ready(self, pending) -> None:
         """Triage every leading pending batch whose device results are
@@ -735,6 +899,9 @@ class Fuzzer:
                 reg.rate("execs", room)
                 reg.gauge("pipeline_depth", len(pending))
                 self.telemetry.maybe_flush()
+                self._persist_campaign()
+                if self.sync is not None:
+                    self.sync.maybe_sync(self)
         finally:
             # findings in already-executed batches must survive an
             # interrupt (Ctrl-C on an infinite run) or a raise
@@ -772,3 +939,6 @@ class Fuzzer:
                                   instr.last_unique_crash(),
                                   instr.last_unique_hang())
             self.telemetry.maybe_flush()
+            self._persist_campaign()
+            if self.sync is not None:
+                self.sync.maybe_sync(self)

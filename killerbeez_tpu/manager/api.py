@@ -25,6 +25,10 @@ plus the work-queue routes that replace BOINC's scheduler
     POST /api/work/<id>/finish       {status, mutator_state?}
     POST /api/stats/<campaign>       {worker, snapshot}  (heartbeat)
     GET  /api/stats/<campaign>       -> {merged, workers, n_workers}
+    POST /api/corpus/<campaign>      {worker, md5, cov_hash,
+                                      content_b64, meta} -> {id, new}
+    GET  /api/corpus/<campaign>?since=&exclude=
+                                     -> {entries, latest}
 """
 
 from __future__ import annotations
@@ -217,6 +221,37 @@ class _Handler(BaseHTTPRequestHandler):
             "merged": merge([r["snapshot"] for r in rows]),
         })
 
+    def h_corpus(self, query, campaign):
+        """Fleet corpus exchange: POST stores one edge-novel entry
+        (deduped by coverage hash — two workers hitting the same
+        frontier store one row; the duplicate POST gets
+        ``new: false``), GET returns entries newer than the caller's
+        cursor so workers pull only each other's fresh findings."""
+        if self.command == "POST":
+            b = self._body()
+            content = base64.b64decode(b["content_b64"])
+            rid, new = self.db.add_corpus_entry(
+                campaign, b["cov_hash"], b.get("md5", ""),
+                b.get("worker", "anon"), content, b.get("meta"))
+            self._json(201 if new else 200, {"id": rid, "new": new})
+            return
+        since = int(query.get("since", ["0"])[0])
+        exclude = query.get("exclude", [None])[0]
+        rows = self.db.get_corpus_entries(campaign, since, exclude)
+        latest = max((r["id"] for r in rows),
+                     default=self.db.corpus_latest_id(campaign))
+        self._json(200, {
+            "campaign": campaign,
+            "latest": latest,
+            "entries": [{
+                "id": r["id"], "md5": r["md5"],
+                "cov_hash": r["cov_hash"], "worker": r["worker"],
+                "content_b64":
+                    base64.b64encode(r["content"]).decode(),
+                "meta": r.get("meta"),
+            } for r in rows],
+        })
+
     def h_work_claim(self, query):
         b = self._body()
         job = self.db.claim_job(b.get("worker", "anon"))
@@ -253,6 +288,8 @@ _ROUTES: Tuple = (
     (r"/api/tracer_info", {"POST": _Handler.h_tracer_info}),
     (r"/api/stats/([\w.-]+)", {"GET": _Handler.h_stats,
                                "POST": _Handler.h_stats}),
+    (r"/api/corpus/([\w.-]+)", {"GET": _Handler.h_corpus,
+                                "POST": _Handler.h_corpus}),
     (r"/api/minimize", {"POST": _Handler.h_minimize}),
     (r"/api/work/claim", {"POST": _Handler.h_work_claim}),
     (r"/api/work/(\d+)/finish", {"POST": _Handler.h_work_finish}),

@@ -86,6 +86,17 @@ CREATE TABLE IF NOT EXISTS campaign_stats (
     updated REAL NOT NULL,
     UNIQUE(campaign, worker)      -- latest heartbeat per worker
 );
+CREATE TABLE IF NOT EXISTS corpus_entries (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    campaign TEXT NOT NULL,
+    cov_hash TEXT NOT NULL,       -- coverage-signature dedup key
+    md5 TEXT NOT NULL,
+    worker TEXT NOT NULL,
+    content BLOB NOT NULL,
+    meta TEXT,                    -- entry sidecar JSON (corpus store)
+    created REAL NOT NULL,
+    UNIQUE(campaign, cov_hash)    -- one row per coverage frontier
+);
 """
 
 
@@ -322,6 +333,60 @@ class ManagerDB:
         for r in rows:
             r["snapshot"] = json.loads(r["snapshot"])
         return rows
+
+    # -- corpus exchange (fleet seed sharing) --------------------------
+
+    def add_corpus_entry(self, campaign: str, cov_hash: str, md5: str,
+                         worker: str, content: bytes,
+                         meta: Optional[Dict[str, Any]] = None
+                         ) -> tuple:
+        """Store one corpus entry; dedup by (campaign, cov_hash) —
+        two workers hitting the same coverage frontier store ONE row.
+        Returns (row id, stored_as_new)."""
+        with self._lock:
+            conn = self._conn()
+            cur = conn.execute(
+                "INSERT INTO corpus_entries (campaign, cov_hash, md5, "
+                "worker, content, meta, created) VALUES (?,?,?,?,?,?,?) "
+                "ON CONFLICT(campaign, cov_hash) DO NOTHING",
+                (str(campaign), cov_hash, md5, worker, content,
+                 json.dumps(meta) if meta is not None else None,
+                 time.time()))
+            conn.commit()
+            if cur.rowcount:
+                return cur.lastrowid, True
+            row = conn.execute(
+                "SELECT id FROM corpus_entries WHERE campaign=? AND "
+                "cov_hash=?", (str(campaign), cov_hash)).fetchone()
+            return (row["id"] if row else None), False
+
+    def get_corpus_entries(self, campaign: str, since_id: int = 0,
+                           exclude_worker: Optional[str] = None
+                           ) -> List[Dict[str, Any]]:
+        """Entries newer than ``since_id`` (the puller's cursor),
+        optionally excluding the puller's own uploads."""
+        if exclude_worker is not None:
+            rows = self._rows(
+                "SELECT * FROM corpus_entries WHERE campaign=? AND "
+                "id>? AND worker != ? ORDER BY id",
+                (str(campaign), int(since_id), exclude_worker))
+        else:
+            rows = self._rows(
+                "SELECT * FROM corpus_entries WHERE campaign=? AND "
+                "id>? ORDER BY id", (str(campaign), int(since_id)))
+        for r in rows:
+            if r.get("meta"):
+                try:
+                    r["meta"] = json.loads(r["meta"])
+                except ValueError:
+                    r["meta"] = None
+        return rows
+
+    def corpus_latest_id(self, campaign: str) -> int:
+        rows = self._rows(
+            "SELECT MAX(id) AS m FROM corpus_entries WHERE campaign=?",
+            (str(campaign),))
+        return int(rows[0]["m"] or 0) if rows else 0
 
     # -- tracer info / minimization ------------------------------------
 

@@ -229,15 +229,26 @@ def assimilate(manager_url: str, job: Dict[str, Any],
 
 def run_job(manager_url: str, job: Dict[str, Any],
             in_process: bool = False, worker_name: str = "anon",
-            heartbeat_s: float = 5.0) -> str:
+            heartbeat_s: float = 5.0,
+            corpus_sync_s: float = 10.0) -> str:
     """Execute one claimed job; returns 'done' or 'failed'.  While
     the fuzzer runs, a heartbeat thread tails its stats.jsonl and
     POSTs progress snapshots to the manager (campaign key = job id),
     so the fleet view updates DURING long campaigns, not just at
-    assimilation time."""
+    assimilation time.  The fuzzer also runs with a local corpus
+    store synced through the manager's ``/api/corpus/<job id>``
+    (``corpus_sync_s`` cadence; 0 disables) — fleet workers on the
+    same campaign fuzz each other's frontiers instead of rediscovering
+    them."""
     with tempfile.TemporaryDirectory(prefix="kb_work_") as workdir:
         out_dir = os.path.join(workdir, "output")
         argv = shlex.split(job["cmdline"]) + ["-o", out_dir]
+        if corpus_sync_s > 0:
+            argv += ["--corpus-dir", os.path.join(workdir, "corpus"),
+                     "--sync-manager", manager_url,
+                     "--sync-campaign", str(job["id"]),
+                     "--sync-worker", worker_name,
+                     "--sync-interval", str(corpus_sync_s)]
         hb = Heartbeat(manager_url, str(job["id"]), worker_name,
                        out_dir, interval=heartbeat_s)
         hb.start()
@@ -260,7 +271,8 @@ def run_job(manager_url: str, job: Dict[str, Any],
 
 
 def work_loop(manager_url: str, worker_name: str, once: bool = False,
-              poll_s: float = 2.0, in_process: bool = False) -> int:
+              poll_s: float = 2.0, in_process: bool = False,
+              corpus_sync_s: float = 10.0) -> int:
     """Claim-run-report until the queue drains (once) or forever."""
     done = 0
     while True:
@@ -273,7 +285,8 @@ def work_loop(manager_url: str, worker_name: str, once: bool = False,
             continue
         try:
             status = run_job(manager_url, job, in_process=in_process,
-                             worker_name=worker_name)
+                             worker_name=worker_name,
+                             corpus_sync_s=corpus_sync_s)
         except Exception as e:  # job must not wedge the worker
             WARNING_MSG("job %s failed: %s", job.get("id"), e)
             status = "failed"
@@ -292,11 +305,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="drain the queue then exit")
     p.add_argument("--in-process", action="store_true",
                    help="run jobs in this interpreter (no subprocess)")
+    p.add_argument("--corpus-sync", type=float, default=10.0,
+                   help="seconds between fleet corpus-sync rounds "
+                        "through /api/corpus/<job id> (0 disables; "
+                        "default 10)")
     p.add_argument("-l", "--logging-options")
     args = p.parse_args(argv)
     setup_logging(args.logging_options)
     n = work_loop(args.manager_url, args.name, once=args.once,
-                  in_process=args.in_process)
+                  in_process=args.in_process,
+                  corpus_sync_s=args.corpus_sync)
     INFO_MSG("worker finished: %d jobs", n)
     return 0
 

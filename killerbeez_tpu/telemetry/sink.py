@@ -32,6 +32,13 @@ PLOT_FIELDS = ("unix_time", "execs_done", "paths_total", "crashes",
                "corpus_count", "execs_per_sec")
 
 
+def _corpus_seen(snap: Dict[str, object]) -> float:
+    """The seen-corpus gauge, tolerating pre-split snapshots that
+    still carry the conflated ``corpus_size`` name."""
+    g = snap.get("gauges", {})
+    return g.get("corpus_seen", g.get("corpus_size", 0))
+
+
 def write_fuzzer_stats(path: str, snap: Dict[str, object],
                        extra: Optional[Dict[str, object]] = None
                        ) -> None:
@@ -52,8 +59,12 @@ def write_fuzzer_stats(path: str, snap: Dict[str, object],
         "hangs": int(c.get("hangs", 0)),
         "unique_hangs": int(c.get("unique_hangs", 0)),
         "exec_errors": int(c.get("errors", 0)),
-        "corpus_count": int(snap.get("gauges", {})
-                            .get("corpus_size", 0)),
+        # corpus_count stays the AFL wire name; the source gauge is
+        # corpus_seen (distinct new-path inputs ever recorded —
+        # corpus_size is the pre-split name, read for old snapshots)
+        "corpus_count": int(_corpus_seen(snap)),
+        "corpus_arms": int(snap.get("gauges", {})
+                           .get("corpus_arms", 0)),
         "afl_version": "killerbeez-tpu",
     }
     if extra:
@@ -69,13 +80,12 @@ def write_fuzzer_stats(path: str, snap: Dict[str, object],
 
 def plot_row(snap: Dict[str, object]) -> str:
     c = snap.get("counters", {})
-    g = snap.get("gauges", {})
     d = snap.get("derived", {})
     vals = (int(snap.get("t", 0)), int(c.get("execs", 0)),
             int(c.get("new_paths", 0)), int(c.get("crashes", 0)),
             int(c.get("unique_crashes", 0)), int(c.get("hangs", 0)),
             int(c.get("unique_hangs", 0)),
-            int(g.get("corpus_size", 0)),
+            int(_corpus_seen(snap)),
             round(d.get("execs_per_sec", 0.0), 2))
     return ", ".join(str(v) for v in vals)
 

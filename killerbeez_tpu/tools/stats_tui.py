@@ -75,10 +75,20 @@ def render(snap: Dict[str, Any]) -> str:
     lines.append(
         f"  execs/s  : {_fmt_n(d.get('execs_per_sec', 0.0))} lifetime"
         f" | {_fmt_n(d.get('execs_per_sec_ema', 0.0))} recent")
+    seen = g.get("corpus_seen", g.get("corpus_size", 0))
     lines.append(
         f"  paths    : {_fmt_n(c.get('new_paths', 0))} total"
         f" | {r.get('new_paths', {}).get('rate', 0.0):.2f}/s recent"
-        f" | corpus {_fmt_n(g.get('corpus_size', 0))}")
+        f" | corpus {_fmt_n(seen)} seen")
+    if "corpus_arms" in g or "corpus_favored" in g \
+            or c.get("corpus_synced_in") or c.get("corpus_synced_out"):
+        line = (f"  corpus   : {int(g.get('corpus_arms', 0))} arms"
+                f" | {int(g.get('corpus_favored', 0))} favored")
+        if c.get("corpus_synced_in") or c.get("corpus_synced_out"):
+            line += (f" | synced {_fmt_n(c.get('corpus_synced_in', 0))}"
+                     f" in / {_fmt_n(c.get('corpus_synced_out', 0))}"
+                     " out")
+        lines.append(line)
     lines.append(
         f"  crashes  : {_fmt_n(c.get('crashes', 0))}"
         f" ({_fmt_n(c.get('unique_crashes', 0))} unique)"

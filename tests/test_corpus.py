@@ -1,0 +1,548 @@
+"""Corpus subsystem tests (killerbeez_tpu/corpus/): store round-trip
+and crash-safe writes, scheduler policies (bandit parity with the
+historical in-loop behavior, rare-edge rarity preference, rr cycling),
+kill/--resume continuation, and manager-mediated corpus sync with
+coverage-hash dedup."""
+
+import base64
+import json
+import os
+import random
+import urllib.request
+
+import pytest
+
+from killerbeez_tpu.corpus import (
+    Arm, BanditScheduler, CorpusEntry, CorpusStore, CorpusSync,
+    RareEdgeScheduler, RoundRobinScheduler, make_scheduler,
+)
+from killerbeez_tpu.drivers.factory import driver_factory
+from killerbeez_tpu.fuzzer.cli import main as cli_main
+from killerbeez_tpu.fuzzer.loop import Fuzzer
+from killerbeez_tpu.instrumentation.factory import instrumentation_factory
+from killerbeez_tpu.mutators.factory import mutator_factory
+
+SEED = b"CG\x02\x04\x05\x41xx"
+
+
+# -- store -------------------------------------------------------------
+
+
+def test_store_roundtrip(tmp_path):
+    store = CorpusStore(str(tmp_path / "c"))
+    e1 = CorpusEntry(b"AAAA", seq=store.next_seq(), sig=[5, 9, 2],
+                     parent="base", selections=1.5, finds=0.25)
+    e2 = CorpusEntry(b"BBBBBB", seq=store.next_seq(), parent=e1.md5,
+                     source="sync")
+    assert store.put(e1) and store.put(e2)
+    assert not store.put(CorpusEntry(b"AAAA"))   # md5 dedup
+    assert len(store) == 2
+
+    loaded = CorpusStore(str(tmp_path / "c")).load()
+    assert [e.md5 for e in loaded] == [e1.md5, e2.md5]  # seq order
+    l1 = loaded[0]
+    assert l1.buf == b"AAAA"
+    assert l1.sig == [2, 5, 9]                   # sorted, deduped
+    assert l1.selections == 1.5 and l1.finds == 0.25
+    assert l1.parent == "base" and l1.cov_hash.startswith("sig:")
+    assert loaded[1].source == "sync"
+    assert loaded[1].cov_hash.startswith("md5:")  # unsigned fallback
+
+
+def test_store_survives_torn_writes(tmp_path):
+    """Crash-safety: leftover .tmp files and a torn sidecar must not
+    lose the store — the entry bytes are the artifact."""
+    store = CorpusStore(str(tmp_path / "c"))
+    e = CorpusEntry(b"DATA", seq=0, sig=[1])
+    store.put(e)
+    # simulate a crash mid-write: stray tmp + corrupt sidecar
+    (tmp_path / "c" / "deadbeef.tmp").write_bytes(b"partial")
+    (tmp_path / "c" / (e.md5 + ".json")).write_text('{"md5": trunc')
+    loaded = store.load()
+    assert len(loaded) == 1
+    assert loaded[0].buf == b"DATA"              # bytes survive
+    assert loaded[0].sig is None                 # metadata degraded
+
+
+def test_store_state_roundtrip(tmp_path):
+    store = CorpusStore(str(tmp_path / "c"))
+    store.save_state({"counters": {"execs": 42}})
+    assert store.load_state()["counters"]["execs"] == 42
+    store.save_component_state("mutator", '{"iteration": 7}')
+    assert json.loads(store.load_component_state("mutator")) \
+        == {"iteration": 7}
+    assert store.load_component_state("instrumentation") is None
+
+
+# -- bandit parity -----------------------------------------------------
+
+
+def _reference_bandit_pick(corpus, base_stats, base_seed, rng):
+    """The pre-extraction in-loop selection (fuzzer/loop.py history):
+    greedy optimistic bandit + AFL-style splice, verbatim."""
+    best, best_score = None, 0.0
+    if base_seed is not None:
+        best_score = (base_stats[1] + 1.0) / (base_stats[0] + 1.0)
+    for i, (buf, sel, finds) in enumerate(corpus):
+        score = (finds + 1.0) / (sel + 1.0)
+        if score >= best_score:
+            best, best_score = i, score
+    if best is None:
+        return None, base_seed
+    cand = corpus[best][0]
+    if len(corpus) >= 2 and rng.random() < 0.5:
+        partner = rng.choice(
+            [e[0] for j, e in enumerate(corpus) if j != best])
+        n = min(len(cand), len(partner))
+        fd = next((i for i in range(n) if cand[i] != partner[i]), None)
+        if fd is not None:
+            ld = next(i for i in range(n - 1, -1, -1)
+                      if cand[i] != partner[i])
+            if ld > fd + 1:
+                k = rng.randrange(fd + 1, ld)
+                cand = cand[:k] + partner[k:]
+    return best, cand
+
+
+def test_bandit_parity_with_historical_inloop_behavior():
+    """--schedule bandit must reproduce the old rotation decisions:
+    drive the extracted scheduler and a verbatim copy of the
+    pre-extraction algorithm through the same scripted episode (same
+    admissions, finds, periods, RNG seed) and require the SAME arm
+    index and candidate bytes at every rotation."""
+    sched = BanditScheduler()
+    sched.base_seed = b"BASE_SEED_0"
+    ref_corpus, ref_stats = [], [0.0, 0.0]
+    ref_rng = random.Random(0x6b62)     # the loop's historical seed
+
+    script_rng = random.Random(1)
+    ref_active = None                   # arm list obj or None
+    for step in range(200):
+        # random admissions (edge-novel findings) with random credit
+        if script_rng.random() < 0.4:
+            buf = bytes(script_rng.randrange(256) for _ in range(12))
+            sched.admit(Arm(buf))
+            sched.credit_find(sched.arms[ref_active]
+                              if ref_active is not None else None)
+            ref_corpus.append([buf, 0, 0])
+            if ref_active is None:
+                ref_stats[1] += 1
+            else:
+                ref_corpus[ref_active][2] += 1
+        # period close (the old _credit_period with feedback=8)
+        g = 0.8 ** 8
+        ref_stats[0] *= g
+        ref_stats[1] *= g
+        for e in ref_corpus:
+            e[1] *= g
+            e[2] *= g
+        active_entry = (sched.arms[ref_active]
+                        if ref_active is not None else None)
+        sched.credit_period(active_entry, 8)
+        if ref_active is None:
+            ref_stats[0] += 1
+        else:
+            ref_corpus[ref_active][1] += 1
+        # rotation
+        got_best, got_cand = sched.select()
+        ref_best, ref_cand = _reference_bandit_pick(
+            ref_corpus, ref_stats, b"BASE_SEED_0", ref_rng)
+        assert got_best == ref_best, f"arm diverged at step {step}"
+        assert got_cand == ref_cand, f"splice diverged at step {step}"
+        ref_active = ref_best
+        # stats must stay numerically identical too
+        assert ref_stats == pytest.approx(sched.base_stats)
+        assert [list(a) for a in sched.arms] == \
+            [[b, pytest.approx(s), pytest.approx(f)]
+             for b, s, f in ref_corpus]
+
+
+def test_bandit_cap_evicts_oldest():
+    sched = BanditScheduler(cap=3)
+    arms = [Arm(bytes([i]) * 4) for i in range(5)]
+    evicted = [sched.admit(a) for a in arms]
+    assert len(sched.arms) == 3
+    assert sched.arms == arms[2:]
+    assert evicted[3] is arms[0] and evicted[4] is arms[1]
+
+
+# -- rr / rare-edge policies -------------------------------------------
+
+
+def test_rr_cycles_base_and_arms():
+    sched = RoundRobinScheduler()
+    sched.base_seed = b"BASE"
+    a1, a2 = Arm(b"ONE1"), Arm(b"TWO2")
+    sched.admit(a1)
+    sched.admit(a2)
+    picks = [sched.select() for _ in range(6)]
+    assert picks == [(None, b"BASE"), (0, b"ONE1"), (1, b"TWO2")] * 2
+
+
+def test_rare_edge_prefers_rarest_signature():
+    sched = RareEdgeScheduler()
+    sched.base_seed = b"BASE"
+    common = Arm(b"AAAA", sig=[1, 2])
+    also_common = Arm(b"BBBB", sig=[1, 2, 3])
+    rare = Arm(b"CCCC", sig=[3, 99])    # 99 hit by this entry only
+    for a in (common, also_common, rare):
+        sched.admit(a)
+    assert sched.edge_hits == {1: 2, 2: 2, 3: 2, 99: 1}
+    best, cand = sched.select()
+    assert sched.arms[best] is rare and cand == b"CCCC"
+    # equal rarity: the least-selected arm gets the turn, newest
+    # breaks remaining ties
+    other_rare = Arm(b"DDDD", sig=[98])     # also a singleton edge
+    sched.admit(other_rare)
+    rare[1] += 10                           # heavily selected
+    best, _ = sched.select()
+    assert sched.arms[best] is other_rare
+    assert sched.favored_count() >= 1
+
+
+def test_rare_edge_unsigned_probe_once():
+    sched = RareEdgeScheduler()
+    sched.base_seed = b"BASE"
+    blind = Arm(b"XXXX")                # no signature available
+    sched.admit(blind)
+    best, _ = sched.select()
+    assert sched.arms[best] is blind    # probed once
+    blind[1] += 1                       # now selected
+    picks = {sched.select()[0] for _ in range(8)}
+    # deprioritized: budget splits with the base seed
+    assert None in picks
+
+
+def test_rare_edge_drop_releases_edge_counts():
+    """Arms dropped from rotation (too-wide findings) must release
+    their edge_hits, or surviving arms' rarity reads stale."""
+    sched = RareEdgeScheduler()
+    wide = Arm(b"W" * 64, sig=[1, 7])
+    small = Arm(b"SSSS", sig=[7])
+    sched.admit(wide)
+    sched.admit(small)
+    assert sched.edge_hits == {1: 1, 7: 2}
+    sched.drop(0)                       # the wide arm
+    assert sched.edge_hits == {7: 1}    # counts released
+    # eviction releases too
+    capped = RareEdgeScheduler(cap=1)
+    a, b = Arm(b"AAAA", sig=[5]), Arm(b"BBBB", sig=[6])
+    capped.admit(a)
+    capped.admit(b)                     # evicts a
+    assert capped.edge_hits == {6: 1}
+
+
+def test_make_scheduler_names():
+    for name in ("bandit", "rare-edge", "rr"):
+        assert make_scheduler(name).name == name
+    with pytest.raises(ValueError, match="unknown scheduler"):
+        make_scheduler("nope")
+
+
+# -- loop integration: store write-through + resume --------------------
+
+
+def _make_fuzzer(tmp_path, corpus_dir=None, resume=False,
+                 scheduler=None, seed_n=11, feedback=2, sync=None):
+    instr = instrumentation_factory(
+        "jit_harness", '{"target": "cgc_like", "novelty": "throughput"}')
+    mut = mutator_factory("havoc", json.dumps({"seed": seed_n}), SEED)
+    drv = driver_factory("file", None, instr, mut)
+    return Fuzzer(drv, output_dir=str(tmp_path / "out"),
+                  batch_size=256, feedback=feedback,
+                  corpus_dir=corpus_dir, resume=resume,
+                  scheduler=scheduler, sync=sync,
+                  persist_interval=0.0)
+
+
+def test_loop_writes_store_and_resumes_in_process(tmp_path):
+    """The resume acceptance gate: a campaign's corpus, bandit stats
+    and lifetime counters survive a kill and continue."""
+    cdir = str(tmp_path / "corpus")
+    fz = _make_fuzzer(tmp_path, corpus_dir=cdir)
+    fz.run(2048)
+    arms = len(fz.scheduler.arms)
+    seen = set(fz._seen["new_paths"])
+    execs = fz.stats.iterations
+    paths = fz.stats.new_paths
+    base_stats = list(fz.scheduler.base_stats)
+    rotations = fz.scheduler.rotations
+    assert arms > 0 and execs == 2048
+    # store holds every rotation arm (write-through at admission)
+    stored = {e.md5 for e in CorpusStore(cdir).load()}
+    assert {a.md5 for a in fz.scheduler.arms} <= stored
+
+    # "kill": drop the object, rebuild from disk alone
+    fz2 = _make_fuzzer(tmp_path, corpus_dir=cdir, resume=True)
+    assert len(fz2.scheduler.arms) == arms          # same arm count
+    assert fz2._seen["new_paths"] >= seen           # no findings lost
+    assert fz2.stats.iterations == execs            # counters continue
+    assert fz2.stats.new_paths == paths
+    assert fz2.scheduler.rotations == rotations
+    assert fz2.scheduler.base_stats == \
+        pytest.approx(base_stats)                   # bandit stats
+    assert fz2.scheduler.base_seed == fz.scheduler.base_seed
+    # mutator walk position restored -> no candidate replay
+    assert fz2.driver.mutator.get_current_iteration() == 2048
+
+    fz2.run(512)                                    # -n is per-run
+    assert fz2.stats.iterations == execs + 512
+    # replayed known paths are not re-recorded as new findings
+    assert fz2.stats.new_paths >= paths
+
+
+def test_cli_resume_smoke(tmp_path):
+    """Fast tier-1 guard for the CLI resume path: --corpus-dir run,
+    then --resume continues counters and corpus (fuzzer_stats shows
+    the cumulative totals)."""
+    from killerbeez_tpu.telemetry import parse_fuzzer_stats
+    seed_path = tmp_path / "seed"
+    seed_path.write_bytes(SEED)
+    out = tmp_path / "out"
+    common = ["file", "jit_harness", "havoc",
+              "-i", '{"target": "cgc_like", "novelty": "throughput"}',
+              "-m", '{"seed": 11}', "-sf", str(seed_path),
+              "-o", str(out), "-b", "256", "-fb", "2"]
+    assert cli_main(common + ["-n", "1024",
+                              "--corpus-dir", str(out / "corpus")]) == 0
+    n_entries = len(CorpusStore(str(out / "corpus")).load())
+    assert n_entries > 0
+    assert cli_main(common + ["-n", "512", "--resume"]) == 0
+    fs = parse_fuzzer_stats(str(out / "fuzzer_stats"))
+    assert int(fs["execs_done"]) == 1536            # 1024 + 512
+    assert int(fs["corpus_count"]) >= n_entries
+    assert int(fs["corpus_arms"]) > 0
+
+
+def test_interval_persist_snapshots_live_run_seconds(tmp_path):
+    """A hard kill never reaches run_ended(): the interval persist
+    must snapshot LIVE active time, or a resumed campaign divides
+    restored execs by ~zero and reports an absurd lifetime rate."""
+    import time as _time
+    fz = _make_fuzzer(tmp_path, corpus_dir=str(tmp_path / "c"))
+    reg = fz.telemetry.registry
+    reg.run_started()                   # mid-run, never ended
+    _time.sleep(0.05)
+    fz._persist_campaign()
+    st = CorpusStore(str(tmp_path / "c")).load_state()
+    assert st["counters"]["run_seconds"] >= 0.05
+
+
+def test_resume_requires_corpus_dir(tmp_path):
+    with pytest.raises(ValueError, match="corpus_dir"):
+        _make_fuzzer(tmp_path, resume=True)
+
+
+def test_scheduler_choice_changes_policy_not_findings(tmp_path):
+    """--schedule rr on the same candidate stream still fuzzes and
+    admits the same first-period findings (policy only changes
+    SELECTION; admission and triage are scheduler-independent)."""
+    fz = _make_fuzzer(tmp_path, scheduler="rr")
+    stats = fz.run(2048)
+    assert stats.new_paths > 0
+    assert fz.scheduler.name == "rr"
+    assert fz.scheduler.rotations > 0
+    assert len(fz.scheduler.arms) > 0
+
+
+# -- corpus gauges -----------------------------------------------------
+
+
+def test_corpus_gauges_split(tmp_path):
+    """The misleading corpus_size gauge is gone: corpus_seen counts
+    distinct recorded new-path inputs, corpus_arms the rotation
+    corpus; fuzzer_stats carries both (corpus_count keeps the AFL
+    wire name)."""
+    fz = _make_fuzzer(tmp_path, corpus_dir=str(tmp_path / "c"))
+    fz.run(2048)
+    g = fz.telemetry.registry.gauges
+    assert "corpus_size" not in g
+    assert g["corpus_seen"] == len(fz._seen["new_paths"])
+    assert g["corpus_arms"] == len(fz.scheduler.arms)
+    assert "corpus_favored" in g
+    from killerbeez_tpu.telemetry.sink import write_fuzzer_stats
+    from killerbeez_tpu.telemetry import parse_fuzzer_stats
+    path = str(tmp_path / "fs")
+    write_fuzzer_stats(path, fz.telemetry.snapshot())
+    fs = parse_fuzzer_stats(path)
+    assert int(fs["corpus_count"]) == int(g["corpus_seen"])
+    assert int(fs["corpus_arms"]) == int(g["corpus_arms"])
+
+
+# -- manager corpus sync -----------------------------------------------
+
+
+@pytest.fixture
+def server():
+    from killerbeez_tpu.manager import ManagerServer
+    s = ManagerServer(port=0)
+    s.start()
+    yield s
+    s.stop()
+
+
+def _post(server, path, payload):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    r = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_corpus_endpoint_dedups_by_coverage_hash(server):
+    """Two workers, one shared finding: stored ONCE (the acceptance
+    dedup gate) — different md5s, same coverage signature."""
+    def entry(worker, md5, content):
+        return {"worker": worker, "md5": md5,
+                "cov_hash": "sig:deadbeef",
+                "content_b64": base64.b64encode(content).decode(),
+                "meta": {"seq": 0}}
+
+    code, r1 = _post(server, "/api/corpus/j1", entry("w1", "m1", b"A"))
+    assert code == 201 and r1["new"] is True
+    code, r2 = _post(server, "/api/corpus/j1", entry("w2", "m2", b"B"))
+    assert code == 200 and r2["new"] is False       # dedup
+    assert r2["id"] == r1["id"]
+    # the row is w1's; w2 pulling with exclude=w2 still sees it,
+    # w1 pulling with exclude=w1 does not (it authored it)
+    db = server.db
+    assert len(db.get_corpus_entries("j1", 0, "w2")) == 1
+    assert len(db.get_corpus_entries("j1", 0, "w1")) == 0
+    # a different campaign is a separate namespace
+    code, r3 = _post(server, "/api/corpus/j2", entry("w1", "m1", b"A"))
+    assert r3["new"] is True
+
+
+def test_two_worker_sync_exchanges_frontier(server, tmp_path):
+    """Fleet e2e: worker 2's scheduler ends up rotating through
+    worker 1's findings (pulled via /api/corpus), and shared
+    frontiers are stored once server-side."""
+    url = f"http://127.0.0.1:{server.port}"
+
+    def worker(name, seed_n):
+        sync = CorpusSync(url, "campX", worker=name, interval_s=0.0)
+        return _make_fuzzer(tmp_path / name,
+                            corpus_dir=str(tmp_path / name / "c"),
+                            seed_n=seed_n, sync=sync)
+
+    f1 = worker("w1", 11)
+    f1.run(1024)
+    assert f1.sync.pushed_n > 0
+    f2 = worker("w2", 22)
+    f2.run(1024)
+    assert f2.sync.pulled_n > 0
+    sources = [a.source for a in f2.scheduler.arms]
+    assert "sync" in sources            # peer entries joined rotation
+    # pulled entries persist in w2's local store
+    stored = CorpusStore(str(tmp_path / "w2" / "c")).load()
+    assert any(e.source == "sync" for e in stored)
+    # server kept one row per coverage hash
+    rows = server.db.get_corpus_entries("campX", 0)
+    hashes = [r["cov_hash"] for r in rows]
+    assert len(hashes) == len(set(hashes))
+    c = f2.telemetry.registry.counters
+    assert c.get("corpus_synced_in", 0) == f2.sync.pulled_n
+    assert c.get("corpus_synced_out", 0) == f2.sync.pushed_n
+
+
+def test_resumed_worker_does_not_readmit_pulled_entries(server,
+                                                        tmp_path):
+    """Restarting a resumed syncing worker must not re-admit
+    previously-pulled peer entries: the fresh CorpusSync's cursor is
+    0, but store-known md5s / cov_hashes gate the pull loop."""
+    url = f"http://127.0.0.1:{server.port}"
+    f1 = _make_fuzzer(tmp_path / "w1",
+                      corpus_dir=str(tmp_path / "w1" / "c"),
+                      sync=CorpusSync(url, "campR", worker="w1",
+                                      interval_s=0.0))
+    f1.run(1024)
+    f2 = _make_fuzzer(tmp_path / "w2",
+                      corpus_dir=str(tmp_path / "w2" / "c"),
+                      seed_n=22,
+                      sync=CorpusSync(url, "campR", worker="w2",
+                                      interval_s=0.0))
+    f2.run(1024)
+    assert f2.sync.pulled_n > 0
+    arms_before = len(f2.scheduler.arms)
+    # restart worker 2: fresh sync client, resumed campaign
+    f2b = _make_fuzzer(tmp_path / "w2",
+                       corpus_dir=str(tmp_path / "w2" / "c"),
+                       seed_n=22, resume=True,
+                       sync=CorpusSync(url, "campR", worker="w2",
+                                       interval_s=1e9))
+    assert len(f2b.scheduler.arms) == arms_before
+    synced_in = f2b.telemetry.registry.counters["corpus_synced_in"]
+    assert f2b.sync.maybe_sync(f2b, force=True)
+    assert len(f2b.scheduler.arms) == arms_before   # no re-admission
+    assert f2b.sync.pulled_n == 0
+    assert f2b.telemetry.registry.counters["corpus_synced_in"] \
+        == synced_in
+
+
+def test_sync_survives_dead_manager(tmp_path, monkeypatch):
+    """A dead manager degrades to warnings AND costs one transport
+    failure per sync ROUND, not per entry: the round aborts on the
+    first failed push and requeues the rest for the next round."""
+    import killerbeez_tpu.manager.worker as w
+    calls = {"n": 0}
+    orig = w._request_retry
+
+    def counting(url, payload=None, method="POST", **kw):
+        calls["n"] += 1
+        return orig(url, payload, method, **kw)
+
+    monkeypatch.setattr(w, "_request_retry", counting)
+    sync = CorpusSync("http://127.0.0.1:1", "c", worker="w",
+                      interval_s=0.0, attempts=1)
+    fz = _make_fuzzer(tmp_path, sync=sync)
+    stats = fz.run(512)
+    assert stats.iterations == 512
+    assert sync.pushed_n == 0 and sync.pulled_n == 0
+    # entries admitted during the run are requeued, not lost
+    assert len(sync._pending) == len(fz.scheduler.arms) > 0
+    # rounds that had nothing to push cost zero requests; rounds with
+    # entries cost exactly ONE failed push (abort + requeue) — far
+    # fewer total requests than entries*rounds
+    assert calls["n"] <= 2 * (512 // 256 + 1)
+
+
+def test_sync_counters_survive_resume(tmp_path):
+    """corpus_synced_in/out are per-round deltas onto the registry:
+    a resumed campaign's restored cumulative totals keep counting up
+    instead of snapping back to process-local values."""
+    sync = CorpusSync("http://127.0.0.1:1", "c", worker="w",
+                      interval_s=1e9, attempts=1)   # rounds gated off
+    fz = _make_fuzzer(tmp_path, sync=sync)
+    fz.telemetry.registry.counters["corpus_synced_in"] = 100.0
+    assert sync.maybe_sync(fz, force=True)          # round runs, no peers
+    assert fz.telemetry.registry.counters["corpus_synced_in"] == 100.0
+
+
+# -- kb-corpus tool ----------------------------------------------------
+
+
+def test_kb_corpus_ls_stats_compact(tmp_path, capsys):
+    from killerbeez_tpu.tools.corpus_tool import main as kbc
+    cdir = str(tmp_path / "c")
+    store = CorpusStore(cdir)
+    # b's edges are a subset of a's -> compact removes b; c unsigned
+    store.put(CorpusEntry(b"AAAA", seq=0, sig=[1, 2, 3]))
+    b = CorpusEntry(b"BBBB", seq=1, sig=[2])
+    store.put(b)
+    store.put(CorpusEntry(b"CCCC", seq=2))
+    assert kbc(["ls", cdir]) == 0
+    out = capsys.readouterr().out
+    assert b.md5 in out and "parent" in out
+    assert kbc(["stats", cdir]) == 0
+    out = capsys.readouterr().out
+    assert "entries        : 3 (2 signed, 1 unsigned)" in out
+    assert "distinct edges : 3" in out
+    # dry run removes nothing
+    assert kbc(["compact", cdir, "--dry-run"]) == 0
+    assert capsys.readouterr().out.strip() == b.md5
+    assert len(store.load()) == 3
+    # real compaction drops the covered entry, keeps the unsigned one
+    assert kbc(["compact", cdir]) == 0
+    kept = {e.md5 for e in store.load()}
+    assert b.md5 not in kept and len(kept) == 2

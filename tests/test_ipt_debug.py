@@ -116,7 +116,7 @@ def test_ipt_without_target_mentions_host_mode():
         instrumentation_factory("ipt", None)
 
 
-def test_ipt_host_binary_hash_coverage(corpus_bin):
+def test_ipt_host_binary_hash_coverage(corpus_bin, kb_trace_usable):
     """The host-binary ipt tier (reference
     linux_ipt_instrumentation.c:212-426 role): an UNINSTRUMENTED
     binary under kb-trace hash mode gets path-sensitive (tip, tnt)
@@ -150,7 +150,8 @@ def test_ipt_host_binary_hash_coverage(corpus_bin):
         instr.cleanup()
 
 
-def test_ipt_host_state_merge_is_set_union(corpus_bin):
+def test_ipt_host_state_merge_is_set_union(corpus_bin,
+                                           kb_trace_usable):
     """Host-tier states merge as set union (reference merger fold)
     and carry their own hash-space tag."""
     tgt = corpus_bin("test-plain")

@@ -210,7 +210,7 @@ def test_afl_workers_option(corpus_bin):
     instr.cleanup()
 
 
-def test_qemu_mode_binary_only_coverage(corpus_bin):
+def test_qemu_mode_binary_only_coverage(corpus_bin, kb_trace_usable):
     """Binary-only targets (reference afl_progs qemu_mode): with
     qemu_mode=1 the UNINSTRUMENTED test-plain binary runs under the
     bundled kb-trace ptrace tracer, which acts as the forkserver and
@@ -245,7 +245,8 @@ def test_qemu_mode_binary_only_coverage(corpus_bin):
         instr.cleanup()
 
 
-def test_untracer_mode_map_parity(corpus_bin, monkeypatch):
+def test_untracer_mode_map_parity(corpus_bin, kb_trace_usable,
+                                  monkeypatch):
     """UnTracer mode (default) vs full block-stepping
     (KB_TRACE_FULL=1): for a novelty-bearing input the re-run must
     rebuild the IDENTICAL map the full engine produces, and a
@@ -277,7 +278,7 @@ def test_untracer_mode_map_parity(corpus_bin, monkeypatch):
     assert fast_bytes == full_bytes
 
 
-def test_qemu_mode_plain_exec(corpus_bin):
+def test_qemu_mode_plain_exec(corpus_bin, kb_trace_usable):
     """qemu_mode with use_fork_server=0: one tracer process per exec
     (the reference's -Q without forkserver); verdicts still come
     from the traced child's status."""

@@ -312,11 +312,16 @@ def bench_schedulers(schedules, targets=None, batch=1024, execs=131072,
     budget, minimal-seed regime: the scenario coverage-guided
     scheduling exists for), one row per (target, policy).  rare-edge
     signs each admitted entry with one extra exec on a side
-    instrumentation instance (the same wiring as the CLI)."""
+    instrumentation instance (the same wiring as the CLI);
+    rare-edge-static is rare-edge with the static edge-frequency
+    prior installed (analysis.static_edge_prior), so the cold-start
+    benefit is measurable against the unprimed policy."""
     import json as _json
     import shutil
     from killerbeez_tpu.drivers.factory import driver_factory
-    from killerbeez_tpu.fuzzer.cli import _wire_rare_edge_signer
+    from killerbeez_tpu.fuzzer.cli import (
+        _wire_rare_edge_signer, _wire_static_prior,
+    )
     from killerbeez_tpu.fuzzer.loop import Fuzzer
     from killerbeez_tpu.instrumentation.factory import (
         instrumentation_factory,
@@ -343,9 +348,14 @@ def bench_schedulers(schedules, targets=None, batch=1024, execs=131072,
                                f"sched_{target}_{policy}")
             shutil.rmtree(out, ignore_errors=True)
             fz = Fuzzer(drv, output_dir=out, batch_size=batch,
-                        write_findings=False, scheduler=policy)
-            if policy == "rare-edge":
+                        write_findings=False,
+                        scheduler=("rare-edge"
+                                   if policy == "rare-edge-static"
+                                   else policy))
+            if policy in ("rare-edge", "rare-edge-static"):
                 _wire_rare_edge_signer(fz, drv)
+            if policy == "rare-edge-static":
+                _wire_static_prior(fz, drv)
             t0 = time.time()
             stats = fz.run(execs)
             dt = time.time() - t0
@@ -449,6 +459,9 @@ def main():
         #   python bench.py --schedule bandit,rare-edge,rr \
         #       [target ...] [-b BATCH] [-n EXECS]
         from killerbeez_tpu.corpus.schedule import SCHEDULERS
+        # rare-edge-static: rare-edge + the static edge-frequency
+        # prior (not a separate Scheduler class — a wiring variant)
+        policies = sorted(SCHEDULERS) + ["rare-edge-static"]
         rest = sys.argv[1:]
         i = rest.index("--schedule")
         nxt = rest[i + 1] if i + 1 < len(rest) else ""
@@ -459,17 +472,17 @@ def main():
         # all-policies-on-a-nonexistent-target; anything else is a
         # target/flag and the default policies apply
         looks_like_policies = "," in nxt or (
-            cand and cand[0] in SCHEDULERS)
+            cand and cand[0] in policies)
         if looks_like_policies:
-            bad = [s for s in cand if s not in SCHEDULERS]
+            bad = [s for s in cand if s not in policies]
             if bad:
                 print(f"error: unknown scheduler(s) {bad} "
-                      f"(choose from {sorted(SCHEDULERS)})",
+                      f"(choose from {policies})",
                       file=sys.stderr)
                 return 2
             schedules, tail = cand, rest[i + 2:]
         else:
-            schedules, tail = list(SCHEDULERS), rest[i + 1:]
+            schedules, tail = policies, rest[i + 1:]
         tail = rest[:i] + tail          # targets may precede the flag
         batch, execs, tgts = 1024, 131072, []
         j = 0

@@ -1,0 +1,33 @@
+"""Static analysis over KBVM programs.
+
+Killerbeez's side tools (picker, tracer) learn about a target by
+RUNNING it; the KBVM tier has the whole program text and the exact
+static edge universe at build time (``vm.compute_edges``), so a class
+of facts AFL can only estimate dynamically is simply computable here:
+
+  cfg.py       control-flow graph reconstruction from the instruction
+               array — reachability, dominators, loop headers, the
+               longest loop-free path (validates ``max_steps``), and a
+               static edge-frequency prior for rare-edge scheduling
+  dataflow.py  abstract interpretation over the 8-register ISA —
+               constant propagation + input-byte taint; yields the
+               comparison constants guarding each branch (an automatic
+               fuzzing dictionary), per-branch input-byte dependency
+               sets, and statically-dead / must-crash blocks
+  lint.py      defect checks over both (slot collisions, unreachable
+               blocks, empty modules, max_steps shortfalls, ...) —
+               the ``kb-lint`` tool and the CI lint lane
+"""
+
+from .cfg import ControlFlowGraph, build_cfg, static_edge_prior
+from .dataflow import (
+    BranchFact, DataflowResult, analyze_dataflow, extract_dictionary,
+)
+from .lint import Finding, lint_program
+
+__all__ = [
+    "ControlFlowGraph", "build_cfg", "static_edge_prior",
+    "BranchFact", "DataflowResult", "analyze_dataflow",
+    "extract_dictionary",
+    "Finding", "lint_program",
+]

@@ -1,0 +1,217 @@
+"""Defect checks over a KBVM Program — the ``kb-lint`` core.
+
+Each check turns a silent correctness hazard into a reported finding:
+
+  error severity (kb-lint exits nonzero; the CI lint lane fails):
+    empty-module          a (name, lo, hi) module with lo == hi: its
+                          64KB map can never light up and per-module
+                          novelty silently no-ops
+    unreachable-block     a coverage block no path from entry reaches:
+                          its edges pad the static universe and its
+                          map slots read as permanently-cold targets
+    field-bound           an instruction field at/beyond 2^24: the
+                          batched engine's f32 matmul fetch goes
+                          inexact (Program construction also rejects)
+    max-steps-shortfall   the longest LOOP-FREE complete path needs
+                          more steps than ``max_steps``: legitimate
+                          hang-free executions get triaged as hangs
+
+  warning severity (reported; exit stays 0):
+    slot-collision        two distinct static edges land on one AFL
+                          map slot: novelty conflates them (AFL lives
+                          with this; here it is measurable)
+    duplicate-block-id    ``assign_block_ids`` drew the same coverage
+                          id for two blocks (birthday collision over
+                          MAP_SIZE): whole blocks alias in the map
+    dead-block            CFG-reachable but unreachable once constant
+                          propagation folds branches — dead weight in
+                          the edge universe and the rarity prior
+
+  info severity:
+    must-crash-block      every path from the block head crashes —
+                          usually the PLANTED bug (expected in fuzz
+                          targets; a whole-module must-crash is worth
+                          a look)
+    no-blocks             the program has no coverage blocks at all
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .cfg import ControlFlowGraph, build_cfg
+from .dataflow import DataflowResult, analyze_dataflow
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+SEV_INFO = "info"
+
+
+@dataclass
+class Finding:
+    severity: str
+    code: str
+    message: str
+    data: Dict = field(default_factory=dict)
+
+    def as_dict(self) -> Dict:
+        return {"severity": self.severity, "code": self.code,
+                "message": self.message, **({"data": self.data}
+                                            if self.data else {})}
+
+
+def lint_program(program,
+                 cfg: Optional[ControlFlowGraph] = None,
+                 dataflow: Optional[DataflowResult] = None
+                 ) -> List[Finding]:
+    """All checks over one Program, errors first."""
+    cfg = cfg or build_cfg(program)
+    dataflow = dataflow or analyze_dataflow(program)
+    out: List[Finding] = []
+
+    # -- empty modules ------------------------------------------------
+    for name, lo, hi in program.modules:
+        if lo >= hi:
+            out.append(Finding(
+                SEV_ERROR, "empty-module",
+                f"module {name!r} spans no blocks "
+                f"(lo == hi == {lo}); its coverage map can never "
+                f"light up", {"module": name, "lo": int(lo),
+                              "hi": int(hi)}))
+
+    # -- unreachable blocks -------------------------------------------
+    for k in cfg.unreachable_blocks():
+        out.append(Finding(
+            SEV_ERROR, "unreachable-block",
+            f"block {k} (pc {cfg.block_pcs[k]}) is unreachable from "
+            f"entry; its {sum(1 for f, _ in cfg.edge_cost if f == k)}"
+            f" outgoing edges pad the static universe",
+            {"block": k, "pc": cfg.block_pcs[k]}))
+
+    # -- instruction field bounds -------------------------------------
+    instrs = np.asarray(program.instrs)
+    if instrs.size:
+        bad = np.flatnonzero(
+            (np.abs(instrs[:, 1:]) >= (1 << 24)).any(axis=1))
+        for pc in bad:
+            out.append(Finding(
+                SEV_ERROR, "field-bound",
+                f"instruction at pc {int(pc)} has a field >= 2^24; "
+                f"the engine's f32 matmul fetch is inexact there",
+                {"pc": int(pc)}))
+
+    # -- register fields out of range ---------------------------------
+    # the engine clips direct register fields to [0, 8) — defined
+    # behavior, but never what the program meant (the assembler
+    # rejects these; hand-built / file-loaded programs can carry them
+    # and the abstract interpreter models the clip, not the intent)
+    from ..models.vm import (
+        N_REGS, OP_ADDI, OP_ALU, OP_BR, OP_LDB, OP_LDI, OP_LDM,
+        OP_LEN, OP_STM,
+    )
+    _REG_FIELDS = {OP_LDB: (1, 2), OP_LDI: (1,), OP_ALU: (1, 2),
+                   OP_ADDI: (1, 2), OP_BR: (1,), OP_LEN: (1,),
+                   OP_LDM: (1, 2), OP_STM: (1, 2)}
+    for pc in range(instrs.shape[0]):
+        fields = _REG_FIELDS.get(int(instrs[pc, 0]), ())
+        bad_f = [f for f in fields
+                 if not (0 <= int(instrs[pc, f]) < N_REGS)]
+        if bad_f:
+            out.append(Finding(
+                SEV_WARNING, "register-field-range",
+                f"instruction at pc {pc} names register(s) "
+                f"{[int(instrs[pc, f]) for f in bad_f]} outside "
+                f"r0..r{N_REGS - 1}; the engine clips them — almost "
+                f"certainly not what was meant",
+                {"pc": int(pc),
+                 "fields": [int(instrs[pc, f]) for f in bad_f]}))
+
+    # -- max_steps vs the longest loop-free path ----------------------
+    need = cfg.longest_acyclic_path
+    if need > program.max_steps:
+        out.append(Finding(
+            SEV_ERROR, "max-steps-shortfall",
+            f"max_steps={program.max_steps} but the longest loop-free "
+            f"path needs {need} steps: hang-free executions would be "
+            f"triaged as hangs",
+            {"max_steps": int(program.max_steps),
+             "longest_acyclic_path": int(need)}))
+
+    # -- AFL map-slot collisions in the static edge universe ----------
+    slots = np.asarray(program.edge_slot)
+    ef = np.asarray(program.edge_from)
+    et = np.asarray(program.edge_to)
+    by_slot: Dict[int, List] = {}
+    for i in range(len(slots)):
+        by_slot.setdefault(int(slots[i]), []).append(
+            (int(ef[i]), int(et[i])))
+    for slot, pairs in sorted(by_slot.items()):
+        if len(pairs) > 1:
+            out.append(Finding(
+                SEV_WARNING, "slot-collision",
+                f"{len(pairs)} static edges alias AFL map slot "
+                f"{slot}: {pairs} — novelty cannot tell them apart",
+                {"slot": slot, "edges": pairs}))
+
+    # -- duplicate coverage ids (assign_block_ids birthday draws) -----
+    dup = {bid: n for bid, n in
+           Counter(program.block_ids).items() if n > 1}
+    for bid, n in sorted(dup.items()):
+        blocks = [k for k, b in enumerate(program.block_ids)
+                  if b == bid]
+        out.append(Finding(
+            SEV_WARNING, "duplicate-block-id",
+            f"blocks {blocks} share coverage id {bid}: every edge "
+            f"into/out of them aliases in the AFL map (re-seed "
+            f"assign_block_ids)", {"id": int(bid), "blocks": blocks}))
+
+    # -- statically-dead blocks (constant folding) --------------------
+    for k in sorted(dataflow.dead_blocks):
+        if k not in cfg.reachable:
+            continue                    # already an unreachable error
+        out.append(Finding(
+            SEV_WARNING, "dead-block",
+            f"block {k} (pc {cfg.block_pcs[k]}) is CFG-reachable but "
+            f"dead under constant propagation (a branch before it "
+            f"always goes the other way)",
+            {"block": k, "pc": cfg.block_pcs[k]}))
+
+    # -- must-crash blocks --------------------------------------------
+    for k in sorted(dataflow.must_crash_blocks):
+        out.append(Finding(
+            SEV_INFO, "must-crash-block",
+            f"every path from block {k} (pc {cfg.block_pcs[k]}) "
+            f"crashes — planted bug or dead-end worth confirming",
+            {"block": k, "pc": cfg.block_pcs[k]}))
+
+    if cfg.n_blocks == 0:
+        out.append(Finding(
+            SEV_INFO, "no-blocks",
+            "program has no coverage blocks: every input looks "
+            "identical to the novelty scan"))
+
+    sev_rank = {SEV_ERROR: 0, SEV_WARNING: 1, SEV_INFO: 2}
+    out.sort(key=lambda f: sev_rank[f.severity])
+    return out
+
+
+def universe_stats(program, cfg: Optional[ControlFlowGraph] = None
+                   ) -> Dict:
+    """Static-universe summary shared by kb-lint / showmap / picker."""
+    cfg = cfg or build_cfg(program)
+    slots = np.asarray(program.edge_slot)
+    return {
+        "name": program.name,
+        "n_blocks": int(program.n_blocks),
+        "n_edges": int(program.n_edges),
+        "n_slots": int(len(np.unique(slots))) if len(slots) else 0,
+        "n_modules": len(program.modules),
+        "max_steps": int(program.max_steps),
+        "longest_acyclic_path": int(cfg.longest_acyclic_path),
+        "loop_headers": sorted(cfg.loop_headers),
+        "unreachable_blocks": cfg.unreachable_blocks(),
+    }

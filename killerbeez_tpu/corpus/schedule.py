@@ -288,9 +288,32 @@ class RareEdgeScheduler(Scheduler):
 
     name = "rare-edge"
 
-    def __init__(self, cap: Optional[int] = None):
+    def __init__(self, cap: Optional[int] = None,
+                 static_prior: Optional[Dict[int, float]] = None):
         super().__init__(cap)
         self.edge_hits: Dict[int, int] = {}
+        #: optional static edge-frequency prior (slot -> probability
+        #: mass, ``analysis.static_edge_prior``): breaks COLD-START
+        #: ties only — it enters the selection key after every
+        #: dynamic statistic, so once corpus-wide hit counts or
+        #: selection counts differ at all the choice is identical to
+        #: an unprimed scheduler (parity-pinned in tests)
+        self.static_prior: Optional[Dict[int, float]] = \
+            dict(static_prior) if static_prior else None
+
+    def set_static_prior(self,
+                         prior: Optional[Dict[int, float]]) -> None:
+        """Install the static rarity prior (e.g. from
+        ``analysis.static_edge_prior(program)``)."""
+        self.static_prior = dict(prior) if prior else None
+
+    def _prior_key(self, arm: Arm) -> float:
+        """Statically-expected frequency of the arm's rarest edge
+        (0.0 when no prior is installed — the key element is then a
+        constant and the ordering is exactly the historical one)."""
+        if not self.static_prior or not arm.sig:
+            return 0.0
+        return min(self.static_prior.get(e, 1.0) for e in arm.sig)
 
     def _forget(self, arm: Optional[Arm]) -> None:
         if arm is None or not arm.sig:
@@ -330,7 +353,8 @@ class RareEdgeScheduler(Scheduler):
             return None, self.base_seed
         best, best_key = None, None
         for i, arm in enumerate(self.arms):
-            key = (self._rarity(arm), float(arm[1]), -arm.seq)
+            key = (self._rarity(arm), float(arm[1]),
+                   self._prior_key(arm), -arm.seq)
             if best_key is None or key < best_key:
                 best, best_key = i, key
         if best_key is not None and best_key[0] == float("inf") \

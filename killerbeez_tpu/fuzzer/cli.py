@@ -173,6 +173,48 @@ def _wire_rare_edge_signer(fuzzer, driver) -> None:
     fuzzer._signer = sign
 
 
+def _wire_static_prior(fuzzer, driver) -> None:
+    """``--schedule rare-edge`` on a KBVM target: seed the scheduler
+    with the static edge-frequency prior (analysis.static_edge_prior)
+    so rarity targeting has a signal before the corpus warms up.  The
+    prior only breaks cold-start ties — once dynamic edge-hit counts
+    or selections differ, selection is identical to an unprimed
+    scheduler (corpus/schedule.py)."""
+    prog = getattr(driver.instrumentation, "program", None)
+    if prog is None or \
+            not hasattr(fuzzer.scheduler, "set_static_prior"):
+        return
+    from ..analysis import static_edge_prior
+    fuzzer.scheduler.set_static_prior(static_edge_prior(prog))
+
+
+def _augment_dictionary_options(mutator_options: Optional[str],
+                                instr_options: Optional[str]
+                                ) -> Optional[str]:
+    """A ``dictionary`` mutator invoked with no token source inherits
+    the instrumentation's KBVM target/program_file, so its tokens
+    auto-extract from static branch-constant analysis — no token file
+    needed for device targets."""
+    import json as _json
+    try:
+        mopts = _json.loads(mutator_options) if mutator_options else {}
+        iopts = _json.loads(instr_options) if instr_options else {}
+    except (ValueError, TypeError):
+        return mutator_options          # factories report the error
+    if not isinstance(mopts, dict) or not isinstance(iopts, dict) or \
+            any(k in mopts for k in ("tokens", "dictionary", "target",
+                                     "program_file")):
+        return mutator_options
+    for k in ("target", "program_file"):
+        if k in iopts:
+            mopts[k] = iopts[k]
+            INFO_MSG("dictionary mutator: auto-extracting tokens "
+                     "from %s=%r (static branch-constant analysis)",
+                     k, iopts[k])
+            return _json.dumps(mopts)
+    return mutator_options
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list:
@@ -196,7 +238,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             instrumentation.set_state(
                 read_file(args.instrumentation_state_file).decode())
 
-        mutator = mutator_factory(args.mutator, args.mutator_options, seed)
+        mutator_options = args.mutator_options
+        if args.mutator == "dictionary":
+            mutator_options = _augment_dictionary_options(
+                mutator_options, args.instrumentation_options)
+        mutator = mutator_factory(args.mutator, mutator_options, seed)
         if args.mutator_state:
             mutator.set_state(args.mutator_state)
         elif args.mutator_state_file:
@@ -254,6 +300,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                         sync=sync)
         if args.schedule == "rare-edge":
             _wire_rare_edge_signer(fuzzer, driver)
+            _wire_static_prior(fuzzer, driver)
         stats = fuzzer.run(args.iterations)
         # both rates read the SAME registry the loop recorded into —
         # the CLI never recomputes from its own wall clock

@@ -143,6 +143,15 @@ class Assembler:
     # -- build ----------------------------------------------------------
 
     def build(self, block_seed: int = 0xB10C) -> Program:
+        # a trailing module() with no blocks after it passes the
+        # consecutive-mark check in module() but would build a
+        # (name, lo, hi) range with lo == hi — reject it the same way
+        if self._module_marks and \
+                self._module_marks[-1][1] == self._n_blocks:
+            raise ValueError(
+                f"module {self._module_marks[-1][0]!r} would start "
+                f"at the same block as the program ends "
+                f"(empty module)")
         ids = assign_block_ids(self._n_blocks, block_seed)
         instrs = np.zeros((len(self.rows), 4), dtype=np.int32)
         for i, row in enumerate(self.rows):

@@ -128,6 +128,17 @@ class Program:
             es = es + (mod_of_block[et] * MAP_SIZE if len(et)
                        else 0)
             object.__setattr__(self, "edge_slot", es.astype(np.int32))
+        # assign_block_ids draws MAP_SIZE-bounded ids: birthday
+        # collisions silently alias distinct blocks in the AFL map
+        # (kb-lint reports the exact pairs)
+        n_dup = self.n_blocks - len(set(self.block_ids))
+        if n_dup > 0:
+            from ..utils.logging import WARNING_MSG
+            WARNING_MSG(
+                "program %r: %d duplicate coverage id(s) among %d "
+                "blocks alias in the AFL map (re-seed "
+                "assign_block_ids; kb-lint shows the pairs)",
+                self.name, n_dup, self.n_blocks)
 
     @property
     def n_edges(self) -> int:

@@ -97,13 +97,24 @@ class InterestingValueMutator(Mutator):
 
 
 class DictionaryMutator(Mutator):
-    """Overwrites/inserts dictionary tokens at every position."""
+    """Overwrites/inserts dictionary tokens at every position.
+
+    With no token file or inline list, tokens auto-populate from
+    static analysis of a KBVM target: the branch-comparison constants
+    the abstract interpreter extracts (magic strings, opcode bytes,
+    guarded values — ``analysis.extract_dictionary``), the byte-level
+    guidance Angora buys with dynamic taint tracking."""
     name = "dictionary"
-    OPTION_SCHEMA = {"dictionary": str, "tokens": list}
+    OPTION_SCHEMA = {"dictionary": str, "tokens": list, "target": str,
+                     "program_file": str}
     OPTION_DESCS = {
         "dictionary": "path to a token file (one token per line; "
                       "\\xNN escapes allowed)",
         "tokens": "inline token list (strings)",
+        "target": "KBVM target name: auto-extract tokens from its "
+                  "branch-comparison constants (static analysis)",
+        "program_file": "compiled .npz KBVM program to auto-extract "
+                        "tokens from",
     }
 
     def __init__(self, options, input_bytes):
@@ -122,8 +133,14 @@ class DictionaryMutator(Mutator):
                         toks.append(
                             line.decode("latin-1").encode("latin-1")
                             .decode("unicode_escape").encode("latin-1"))
+        if not toks and ("target" in self.options
+                         or "program_file" in self.options):
+            toks += self._static_tokens()
         if not toks:
-            raise ValueError("dictionary mutator needs tokens")
+            raise ValueError(
+                "dictionary mutator needs tokens (a token file, "
+                "inline tokens, or a KBVM target/program_file to "
+                "auto-extract from)")
         toks = [t[:self.max_length] for t in toks if t]
         tl = max(len(t) for t in toks)
         arr = np.zeros((len(toks), tl), dtype=np.uint8)
@@ -133,6 +150,21 @@ class DictionaryMutator(Mutator):
         self.token_lens = np.array([len(t) for t in toks], dtype=np.int32)
         self._fn = jax.jit(jax.vmap(
             mc.dictionary_at, in_axes=(None, None, 0, None, None)))
+
+    def _static_tokens(self) -> List[bytes]:
+        """Auto-dictionary from the target's static analysis."""
+        from ..analysis import extract_dictionary
+        from ..models.targets import load_program_from_options
+
+        prog = load_program_from_options(
+            self.options, "dictionary auto-extraction needs a "
+                          "'target' or 'program_file' option")
+        toks = extract_dictionary(prog)
+        if not toks:
+            raise ValueError(
+                f"static analysis of {prog.name!r} extracted no "
+                f"branch-comparison constants; supply tokens")
+        return toks
 
     def get_total_iteration_count(self) -> int:
         return mc.dictionary_total(self.seed_len, len(self.token_lens))

@@ -1,0 +1,125 @@
+"""kb-lint — static analysis lint over KBVM programs.
+
+Runs the analysis subsystem (``killerbeez_tpu/analysis/``) over
+built-in targets and/or compiled ``.npz`` programs and reports
+defects: unreachable blocks, AFL map-slot collisions, duplicate
+coverage ids, empty modules, ``max_steps`` shortfalls, statically
+dead and must-crash blocks.  Exit code 1 when any error-severity
+finding exists (the CI lint lane gates on this), else 0.
+
+Usage:
+    kb-lint                       # all built-in targets
+    kb-lint tlvstack_vm test      # specific targets
+    kb-lint --program-file p.npz  # a compiled program
+    kb-lint --json                # machine-readable report
+    kb-lint --dict tlvstack_vm    # print the auto-dictionary too
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+from ..analysis import (
+    analyze_dataflow, build_cfg, extract_dictionary, lint_program,
+)
+from ..analysis.lint import SEV_ERROR, SEV_WARNING, universe_stats
+
+
+def _load_programs(args) -> List:
+    # import both registries: targets_cgc registers on import
+    from ..models import targets, targets_cgc  # noqa: F401
+
+    names = list(args.targets)
+    if args.all_targets or (not names and not args.program_file):
+        names = targets.target_names()
+    progs = []
+    for name in names:
+        progs.append(targets.get_target(name))
+    for path in args.program_file or []:
+        progs.append(targets.load_program_from_options(
+            {"program_file": path},
+            "program_file missing"))
+    return progs
+
+
+def lint_report(program, want_dict: bool = False) -> Dict:
+    """One target's full report (the --json per-target payload)."""
+    cfg = build_cfg(program)
+    df = analyze_dataflow(program)
+    findings = lint_program(program, cfg, df)
+    rep = {
+        "stats": universe_stats(program, cfg),
+        "findings": [f.as_dict() for f in findings],
+        "errors": sum(f.severity == SEV_ERROR for f in findings),
+        "warnings": sum(f.severity == SEV_WARNING for f in findings),
+    }
+    if want_dict:
+        rep["dictionary"] = [t.decode("latin-1")
+                             for t in extract_dictionary(program, df)]
+    return rep
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="kb-lint",
+        description="static-analysis lint over KBVM programs "
+                    "(CFG + dataflow defect checks)")
+    p.add_argument("targets", nargs="*",
+                   help="built-in target names (default: all)")
+    p.add_argument("--all", action="store_true", dest="all_targets",
+                   help="lint every built-in target (the default "
+                        "when no names are given; explicit for CI)")
+    p.add_argument("--program-file", action="append",
+                   help="compiled .npz program (repeatable)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    p.add_argument("--dict", action="store_true", dest="want_dict",
+                   help="include the extracted auto-dictionary")
+    args = p.parse_args(argv)
+    try:
+        progs = _load_programs(args)
+    except (ValueError, FileNotFoundError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    reports = {}
+    errors = warnings = 0
+    for prog in progs:
+        rep = lint_report(prog, want_dict=args.want_dict)
+        key, n = prog.name, 2
+        while key in reports:           # same-named programs must not
+            key = f"{prog.name}#{n}"    # overwrite each other
+            n += 1
+        reports[key] = rep
+        errors += rep["errors"]
+        warnings += rep["warnings"]
+
+    if args.json:
+        print(json.dumps({"targets": reports, "errors": errors,
+                          "warnings": warnings}, indent=2))
+        return 1 if errors else 0
+
+    for name, rep in reports.items():
+        s = rep["stats"]
+        print(f"{name}: {s['n_blocks']} blocks, {s['n_edges']} edges "
+              f"({s['n_slots']} slots, {s['n_modules']} module(s)), "
+              f"longest loop-free path {s['longest_acyclic_path']} "
+              f"of max_steps {s['max_steps']}")
+        for f in rep["findings"]:
+            print(f"  {f['severity']}: [{f['code']}] {f['message']}")
+        if args.want_dict:
+            toks = ", ".join(repr(t.encode('latin-1'))
+                             for t in rep["dictionary"][:16])
+            print(f"  dictionary ({len(rep['dictionary'])} tokens): "
+                  f"{toks}")
+    total = f"{errors} error(s), {warnings} warning(s) across " \
+            f"{len(reports)} program(s)"
+    print(total if errors or warnings else f"clean: {total}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

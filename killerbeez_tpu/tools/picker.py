@@ -156,6 +156,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             "nondeterministic_bytes": int(mask.sum()),
             "ignore_bytes": encode_array(mask),
         }
+        # KBVM targets carry an exact static universe: report it next
+        # to the dynamic determinism analysis (a single_path verdict
+        # over 3% of the static universe reads very differently from
+        # one over 80%)
+        program = getattr(instrumentation, "program", None)
+        if program is not None:
+            from ..analysis import build_cfg
+            from ..analysis.lint import universe_stats
+            report["static"] = universe_stats(program,
+                                              build_cfg(program))
         # per-module report (reference picker/main.c:163-282 walks
         # modules): classification + partition-LOCAL ignore mask per
         # module; the top-level full-map mask stays the

@@ -20,7 +20,7 @@ from typing import List, Optional
 from ..drivers.factory import driver_factory
 from ..instrumentation.factory import instrumentation_factory
 from ..utils.fileio import read_file, write_buffer_to_file
-from ..utils.logging import setup_logging
+from ..utils.logging import INFO_MSG, setup_logging
 from .tracer import force_edges_option
 
 
@@ -31,6 +31,26 @@ def show_map(driver, instrumentation, input_bytes: bytes) -> List[str]:
         raise ValueError(
             f"{instrumentation.name} cannot report coverage slots")
     return [f"{e}:{c}" for e, c in sorted(edges)]
+
+
+def static_summary(program, dynamic_slots) -> str:
+    """One-line static-universe context for a dynamic trace: how much
+    of the statically-enumerable edge universe this one input lit up
+    (KBVM targets only — the universe is exact, vm.compute_edges)."""
+    from ..analysis import build_cfg
+    from ..analysis.lint import universe_stats
+
+    s = universe_stats(program, build_cfg(program))
+    import numpy as np
+    static = set(int(x) for x in np.asarray(program.edge_slot))
+    hit = len(static & set(int(d) for d in dynamic_slots))
+    pct = 100.0 * hit / s["n_slots"] if s["n_slots"] else 0.0
+    return (f"static universe: {s['n_blocks']} blocks, "
+            f"{s['n_edges']} edges over {s['n_slots']} slots "
+            f"({s['n_modules']} module(s)); input covered "
+            f"{hit}/{s['n_slots']} static slots ({pct:.1f}%); "
+            f"longest loop-free path {s['longest_acyclic_path']} of "
+            f"max_steps {s['max_steps']}")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -57,6 +77,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 instrumentation, None)
         lines = show_map(driver, instrumentation,
                          read_file(args.seed_file))
+        # KBVM targets: report the static edge universe next to the
+        # dynamic trace (logged, so stdout stays slot:count parseable)
+        program = getattr(instrumentation, "program", None)
+        if program is not None:
+            INFO_MSG("%s", static_summary(
+                program, (int(ln.split(":")[0]) for ln in lines)))
         text = "".join(f"{ln}\n" for ln in lines)
         if args.output:
             write_buffer_to_file(args.output, text.encode())

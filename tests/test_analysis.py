@@ -1,0 +1,456 @@
+"""Static analysis subsystem tests: CFG reconstruction, abstract
+interpretation (constants + input-byte taint), the kb-lint defect
+checks against synthetic programs containing each defect class, the
+auto-dictionary extraction, and the rare-edge static prior's
+cold-start/parity contract."""
+
+import json
+
+import numpy as np
+import pytest
+
+from killerbeez_tpu.analysis import (
+    analyze_dataflow, build_cfg, extract_dictionary, lint_program,
+    static_edge_prior,
+)
+from killerbeez_tpu.analysis.cfg import ENTRY
+from killerbeez_tpu.analysis.lint import universe_stats
+from killerbeez_tpu.corpus.schedule import Arm, RareEdgeScheduler
+from killerbeez_tpu.models import targets, targets_cgc  # noqa: F401
+from killerbeez_tpu.models.compiler import Assembler
+from killerbeez_tpu.models.vm import OP_BLOCK, OP_HALT, Program
+from killerbeez_tpu.tools.lint_tool import main as lint_main
+
+
+def codes(findings, severity=None):
+    return [f.code for f in findings
+            if severity is None or f.severity == severity]
+
+
+# -- CFG reconstruction ----------------------------------------------
+
+def test_cfg_matches_static_edge_universe():
+    """The CFG's block-level edges must equal vm.compute_edges' pairs
+    on every built-in target (same walk, independent code path)."""
+    for name in targets.target_names():
+        p = targets.get_target(name)
+        cfg = build_cfg(p)
+        pairs = set(zip(np.asarray(p.edge_from).tolist(),
+                        np.asarray(p.edge_to).tolist()))
+        assert set(cfg.edges) == pairs, name
+
+
+def test_cfg_loop_headers_and_dominators():
+    p = targets.get_target("cgc_like")
+    cfg = build_cfg(p)
+    # the checksum loop head is a loop header, dominated by entry path
+    assert cfg.loop_headers, "cgc_like has a loop"
+    for h in cfg.loop_headers:
+        assert h in cfg.reachable
+        assert ENTRY in cfg.dominators[h]
+    # every built-in target's hang budget covers its loop-free paths
+    for name in targets.target_names():
+        prog = targets.get_target(name)
+        c = build_cfg(prog)
+        assert c.longest_acyclic_path <= prog.max_steps, name
+
+
+def test_cfg_spin_block_has_no_terminal():
+    """hang's spin block: an instruction-level self-loop with no exit
+    — no outgoing edges, no terminating block-free path."""
+    p = targets.get_target("hang")
+    cfg = build_cfg(p)
+    spin = 1                            # block 1 is the spin block
+    assert cfg.succ[spin] == set()
+    assert cfg.term_cost[spin] is None
+
+
+def test_cfg_longest_path_straight_line():
+    a = Assembler("line", max_steps=64)
+    a.block()
+    for _ in range(10):
+        a.addi(1, 1, 1)
+    a.halt(0)
+    cfg = build_cfg(a.build())
+    # block + 10 addi + halt = 12 steps
+    assert cfg.longest_acyclic_path == 12
+
+
+def _irreducible_program(max_steps=64):
+    """Blocks B and C branch into each other with neither dominating
+    (entry reaches both): C->B is a RETREATING edge a loop-free
+    execution can still take, so the longest path must consider
+    entry->C->B->end (cheap hop into C, then B's expensive exit)."""
+    a = Assembler("irr", max_steps=max_steps)
+    a.block()                           # 0: entry
+    a.ldi(1, 0)
+    a.ldb(2, 1)
+    a.ldi(3, 1)
+    a.br("eq", 2, 3, "C")
+    a.label("B")
+    a.block()                           # 1: B
+    a.br("eq", 2, 3, "C")               # cheap hop to C
+    for _ in range(40):
+        a.addi(4, 4, 1)                 # expensive exit path
+    a.jmp("end")
+    a.label("C")
+    a.block()                           # 2: C
+    a.br("eq", 2, 0, "B")               # cheap hop back to B
+    a.label("end")
+    a.block()                           # 3: end
+    a.halt(0)
+    return a.build()
+
+
+def test_cfg_irreducible_retreating_edge_longest_path():
+    prog = _irreducible_program()
+    cfg = build_cfg(prog)
+    # neither B nor C dominates the other -> the C->B edge is
+    # retreating, not a natural back edge
+    assert 1 not in cfg.dominators[2] and 2 not in cfg.dominators[1]
+    # entry(5) -> C(2) -> B's long exit(43) -> end(block+halt=2)
+    assert cfg.longest_acyclic_path == 52
+    assert "max-steps-shortfall" not in codes(lint_program(prog))
+    short = _irreducible_program(max_steps=51)
+    assert "max-steps-shortfall" in codes(lint_program(short),
+                                          "error")
+
+
+def test_cfg_branch_dense_region_is_polynomial():
+    """Reconverging branch diamonds (N branches -> 2^N paths) inside
+    one region must not blow up the walk: costs come from a DP over
+    the cycle-cut pc graph, not path enumeration.  Also pins that
+    build_cfg leaves the process recursion limit alone."""
+    import sys
+    import time
+    from killerbeez_tpu.models.vm import CMP_EQ, OP_BR
+    rows = [[OP_BLOCK, 3, 0, 0]]
+    for _ in range(48):
+        pc = len(rows)
+        rows.append([OP_BR, 1, CMP_EQ | (2 << 2), pc + 1])  # diamond
+    rows.append([OP_HALT, 0, 0, 0])
+    prog = Program(instrs=np.array(rows, dtype=np.int32),
+                   name="diamonds", max_steps=64)
+    limit = sys.getrecursionlimit()
+    t0 = time.time()
+    cfg = build_cfg(prog)
+    assert time.time() - t0 < 5.0
+    assert sys.getrecursionlimit() == limit
+    assert cfg.longest_acyclic_path == 50  # block + 48 br + halt
+
+
+# -- lint: each defect class on a synthetic program ------------------
+
+def test_lint_unreachable_block():
+    a = Assembler("unreach", max_steps=64)
+    a.block()
+    a.jmp("end")
+    a.block()                           # tail block jumped over
+    a.label("end")
+    a.block()
+    a.halt(0)
+    findings = lint_program(a.build())
+    assert "unreachable-block" in codes(findings, "error")
+    assert lint_program(targets.get_target("test"),
+                        )[0].severity != "error"
+
+
+def test_lint_max_steps_shortfall():
+    a = Assembler("short", max_steps=4)
+    a.block()
+    for _ in range(10):
+        a.addi(1, 1, 1)
+    a.halt(0)
+    findings = lint_program(a.build())
+    f = [x for x in findings if x.code == "max-steps-shortfall"]
+    assert f and f[0].severity == "error"
+    assert f[0].data["longest_acyclic_path"] == 12
+
+
+def test_lint_slot_collision():
+    # ids chosen so the entry edge (slot id0=8) aliases the edge
+    # (b0 -> b1): id1 ^ (id0 >> 1) = 12 ^ 4 = 8
+    instrs = np.array([[OP_BLOCK, 8, 0, 0], [OP_BLOCK, 12, 0, 0],
+                       [OP_HALT, 0, 0, 0]], dtype=np.int32)
+    findings = lint_program(Program(instrs=instrs, name="coll"))
+    f = [x for x in findings if x.code == "slot-collision"]
+    assert f and f[0].severity == "warning"
+    assert sorted(f[0].data["edges"]) == [(-1, 0), (0, 1)]
+
+
+def test_lint_duplicate_block_id_and_warning(capsys):
+    instrs = np.array([[OP_BLOCK, 5, 0, 0], [OP_BLOCK, 5, 0, 0],
+                       [OP_HALT, 0, 0, 0]], dtype=np.int32)
+    prog = Program(instrs=instrs, name="dup")
+    err = capsys.readouterr().err
+    assert "duplicate coverage id" in err      # one-line build warning
+    f = [x for x in lint_program(prog)
+         if x.code == "duplicate-block-id"]
+    assert f and f[0].severity == "warning"
+    assert f[0].data["blocks"] == [0, 1]       # the exact aliased pair
+
+
+def test_lint_empty_module():
+    instrs = np.array([[OP_BLOCK, 7, 0, 0], [OP_HALT, 0, 0, 0]],
+                      dtype=np.int32)
+    prog = Program(instrs=instrs, name="em",
+                   modules=(("target", 0, 1), ("lib", 1, 1)))
+    assert "empty-module" in codes(lint_program(prog), "error")
+
+
+def test_lint_must_crash_block():
+    a = Assembler("mc", max_steps=32)
+    a.block()
+    a.ldi(1, 0)
+    a.ldb(2, 1)
+    a.ldi(3, 65)
+    a.br("ne", 2, 3, "out")
+    a.block()                           # input[0] == 'A': wild store
+    a.ldi(4, -1)
+    a.stm(4, 2)
+    a.halt(0)
+    a.label("out")
+    a.block()
+    a.halt(0)
+    findings = lint_program(a.build())
+    f = [x for x in findings if x.code == "must-crash-block"]
+    assert f and f[0].severity == "info" and f[0].data["block"] == 1
+
+
+def test_lint_dead_block_constant_fold():
+    a = Assembler("dead", max_steps=32)
+    a.block()
+    a.ldi(1, 3)
+    a.ldi(2, 5)
+    a.br("lt", 1, 2, "out")             # 3 < 5: always taken
+    a.block()                           # CFG-reachable, never runs
+    a.label("out")
+    a.block()
+    a.halt(0)
+    findings = lint_program(a.build())
+    f = [x for x in findings if x.code == "dead-block"]
+    assert f and f[0].severity == "warning" and f[0].data["block"] == 1
+
+
+def test_lint_register_field_range_and_clip_semantics():
+    """Out-of-range register fields are flagged, and the abstract
+    interpreter models the engine's clip (LDI a=9 writes r7, not
+    r9 & 7 = r1)."""
+    from killerbeez_tpu.models.vm import (
+        CMP_EQ, OP_BR, OP_CRASH, OP_LDI,
+    )
+    instrs = np.array([
+        [OP_BLOCK, 3, 0, 0],
+        [OP_LDI, 9, 7, 0],              # clips to r7 = 7
+        [OP_BR, 7, CMP_EQ | (0 << 2), 5],   # r7 == r0? never
+        [OP_BLOCK, 4, 0, 0],            # fallthrough: always runs
+        [OP_CRASH, 0, 0, 0],
+        [OP_BLOCK, 5, 0, 0],            # branch target: never runs
+        [OP_HALT, 0, 0, 0],
+    ], dtype=np.int32)
+    prog = Program(instrs=instrs, name="clip", max_steps=16)
+    findings = lint_program(prog)
+    f = [x for x in findings if x.code == "register-field-range"]
+    assert f and f[0].data == {"pc": 1, "fields": [9]}
+    df = analyze_dataflow(prog)
+    # with clip semantics r7 == 7, so the eq-branch to block 2 folds
+    # false: block 2 is dead, and every live path crashes (blocks 0
+    # and 1 are both must-crash)
+    assert df.dead_blocks == {2}
+    assert df.must_crash_blocks == {0, 1}
+    from killerbeez_tpu.models.vm import run_batch
+    import jax.numpy as jnp
+    res = run_batch(prog, jnp.zeros((1, 8), jnp.uint8),
+                    jnp.asarray([1], jnp.int32))
+    assert int(res.status[0]) == 2      # FUZZ_CRASH — engine agrees
+
+
+def test_lint_builtin_targets_clean():
+    """Acceptance bar: no error-severity findings on any built-in."""
+    for name in targets.target_names():
+        findings = lint_program(targets.get_target(name))
+        assert not codes(findings, "error"), (name, findings)
+
+
+# -- compiler satellite: trailing empty module -----------------------
+
+def test_trailing_empty_module_rejected_at_build():
+    a = Assembler("tem")
+    a.block()
+    a.halt(0)
+    a.module("tail")                    # no blocks follow
+    with pytest.raises(ValueError, match="empty module"):
+        a.build()
+
+
+# -- dataflow / dictionary extraction --------------------------------
+
+def test_dataflow_branch_constants_test_target():
+    p = targets.get_target("test")
+    df = analyze_dataflow(p)
+    consts = {f.const for f in df.branches
+              if f.const is not None and f.deps}
+    assert {ord("A"), ord("B"), ord("C"), ord("D")} <= consts
+    # expect_byte chains pin single byte positions
+    deps = {next(iter(f.deps)): f.const for f in df.branches
+            if f.deps and len(f.deps) == 1 and f.const is not None}
+    assert deps[0] == ord("A") and deps[3] == ord("D")
+
+
+def test_extract_dictionary_merges_magic_runs():
+    toks = extract_dictionary(targets.get_target("test"))
+    assert b"ABCD" in toks              # merged positional run
+    toks = extract_dictionary(targets.get_target("tlvstack_vm"))
+    assert b"STK1" in toks
+    assert bytes([0x0d]) in toks        # opcode byte (PRIV)
+
+
+def test_dictionary_mutator_auto_tokens():
+    """Acceptance: the dictionary mutator consumes the auto-extracted
+    dictionary of a CGC-class target without any token file."""
+    from killerbeez_tpu.mutators.factory import mutator_factory
+    m = mutator_factory("dictionary",
+                        json.dumps({"target": "tlvstack_vm"}),
+                        b"STK1\x01\x05")
+    assert len(m.token_lens) > 0
+    assert m.get_total_iteration_count() > 0
+    bufs, lens = m._generate(np.arange(4, dtype=np.int32))
+    assert np.asarray(bufs).shape[0] == 4
+    with pytest.raises(ValueError, match="needs tokens"):
+        mutator_factory("dictionary", None, b"seed")
+
+
+def test_cli_dictionary_option_injection():
+    from killerbeez_tpu.fuzzer.cli import _augment_dictionary_options
+    out = _augment_dictionary_options(
+        None, '{"target": "tlvstack_vm"}')
+    assert json.loads(out) == {"target": "tlvstack_vm"}
+    # explicit token sources are never overridden
+    assert _augment_dictionary_options(
+        '{"tokens": ["x"]}', '{"target": "t"}') == '{"tokens": ["x"]}'
+    assert _augment_dictionary_options(None, None) is None
+
+
+# -- static edge prior / rare-edge scheduling ------------------------
+
+def test_static_prior_depth_ordering():
+    """Edges deep behind branch cascades carry less static mass than
+    the entry edge."""
+    p = targets.get_target("tlvstack_vm")
+    prior = static_edge_prior(p)
+    entry_slot = int(np.asarray(p.edge_slot)[
+        np.flatnonzero(np.asarray(p.edge_from) == -1)[0]])
+    assert prior[entry_slot] == 1.0     # entry edge: all mass
+    assert min(prior.values()) < 0.01   # leaves: tiny mass
+    assert set(prior) == {int(s) for s in np.asarray(p.edge_slot)}
+
+
+def _prior_fixture():
+    p = targets.get_target("tlvstack_vm")
+    prior = static_edge_prior(p)
+    slots = sorted(prior, key=prior.get)
+    return prior, slots[:2], slots[-2:]  # (prior, rare, common)
+
+
+def test_rare_edge_static_prior_breaks_cold_start_ties():
+    prior, rare, common = _prior_fixture()
+    unprimed, primed = RareEdgeScheduler(), \
+        RareEdgeScheduler(static_prior=prior)
+    for s in (unprimed, primed):
+        s.admit(Arm(b"rare-sig", sig=rare))
+        s.admit(Arm(b"common-sig", sig=common))
+    # cold start: equal dynamic rarity (1) and selections (0) — the
+    # unprimed scheduler falls back to newest, the primed one probes
+    # the arm holding the statically-rarest edge
+    assert unprimed.select()[0] == 1
+    assert primed.select()[0] == 0
+
+
+def test_rare_edge_static_prior_parity_when_dynamics_dominate():
+    """Acceptance: once dynamic edge-hit counts differ, selection is
+    bit-identical with and without the prior."""
+    prior, rare, common = _prior_fixture()
+    unprimed, primed = RareEdgeScheduler(), \
+        RareEdgeScheduler(static_prior=prior)
+    for s in (unprimed, primed):
+        # arm 0 carries edges shared by later entries (dynamically
+        # common but statically rare); arm 1 stays dynamically rare
+        s.admit(Arm(b"a", sig=rare))
+        s.admit(Arm(b"b", sig=common))
+        s.admit(Arm(b"c", sig=rare))
+        s.admit(Arm(b"d", sig=rare))
+    picks_u, picks_p = [], []
+    for _ in range(8):
+        for picks, s in ((picks_u, unprimed), (picks_p, primed)):
+            i, _ = s.select()
+            picks.append(i)
+            s.arms[i][1] += 1           # selection counts diverge
+    assert picks_u == picks_p
+
+
+# -- kb-lint CLI -----------------------------------------------------
+
+def test_kb_lint_builtins_exit_zero(capsys):
+    assert lint_main(["--all"]) == 0
+    out = capsys.readouterr().out
+    assert "tlvstack_vm" in out and "0 error(s)" in out
+
+
+def test_kb_lint_json_and_error_exit(tmp_path, capsys):
+    a = Assembler("bad", max_steps=2)
+    a.block()
+    a.jmp("end")
+    a.block()                           # unreachable
+    a.label("end")
+    a.block()
+    for _ in range(8):
+        a.addi(1, 1, 1)                 # max_steps shortfall
+    a.halt(0)
+    prog = a.build()
+    path = tmp_path / "bad.npz"
+    np.savez(path, instrs=prog.instrs, name=prog.name,
+             mem_size=prog.mem_size, max_steps=prog.max_steps,
+             n_blocks=prog.n_blocks, block_ids=np.array(prog.block_ids))
+    assert lint_main(["--program-file", str(path), "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["errors"] >= 2
+    found = {f["code"] for t in rep["targets"].values()
+             for f in t["findings"]}
+    assert {"unreachable-block", "max-steps-shortfall"} <= found
+
+
+def test_kb_lint_duplicate_names_not_conflated(tmp_path, capsys):
+    prog = targets.get_target("test")
+    paths = []
+    for i in (1, 2):
+        p = tmp_path / f"p{i}.npz"
+        np.savez(p, instrs=prog.instrs, name=prog.name,
+                 mem_size=prog.mem_size, max_steps=prog.max_steps)
+        paths.append(str(p))
+    assert lint_main(["--program-file", paths[0],
+                      "--program-file", paths[1], "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert sorted(rep["targets"]) == ["test", "test#2"]
+
+
+def test_kb_lint_dictionary_flag(capsys):
+    assert lint_main(["tlvstack_vm", "--json", "--dict"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert "STK1" in rep["targets"]["tlvstack_vm"]["dictionary"]
+
+
+def test_universe_stats_shape():
+    s = universe_stats(targets.get_target("libtest"))
+    assert s["n_modules"] == 2
+    assert s["n_blocks"] == 7 and s["n_edges"] == 8
+    assert json.dumps(s)                # JSON-serializable
+
+
+# -- tool wiring -----------------------------------------------------
+
+def test_showmap_static_summary():
+    from killerbeez_tpu.tools.showmap import static_summary
+    p = targets.get_target("test")
+    slots = [int(s) for s in np.asarray(p.edge_slot)[:3]]
+    line = static_summary(p, slots)
+    assert "7 blocks" in line and "3/11 static slots" in line

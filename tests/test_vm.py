@@ -160,6 +160,118 @@ def test_pc_out_of_range_crashes():
     assert int(res.status[0]) == FUZZ_CRASH
 
 
+def _brute_force_edge_pairs(instrs):
+    """Independent reference for the static edge universe: enumerate
+    every (prev block, next block) pair by recursive path walking
+    from the entry and from each block head (no shared code with
+    vm.compute_edges)."""
+    from killerbeez_tpu.models.vm import (
+        OP_BLOCK, OP_BR, OP_CRASH, OP_HALT, OP_JMP,
+    )
+    ni = len(instrs)
+    block_pcs = [pc for pc in range(ni) if instrs[pc][0] == OP_BLOCK]
+    idx = {pc: k for k, pc in enumerate(block_pcs)}
+    pairs = set()
+
+    def walk(from_idx, pc, seen):
+        if pc < 0 or pc >= ni or pc in seen:
+            return
+        op, a, b, c = (int(x) for x in instrs[pc])
+        if op == OP_BLOCK:
+            pairs.add((from_idx, idx[pc]))
+            return
+        seen = seen | {pc}
+        if op == OP_JMP:
+            walk(from_idx, a, seen)
+        elif op == OP_BR:
+            walk(from_idx, c, seen)
+            walk(from_idx, pc + 1, seen)
+        elif op not in (OP_HALT, OP_CRASH):
+            walk(from_idx, pc + 1, seen)
+
+    walk(-1, 0, frozenset())
+    for pc in block_pcs:
+        walk(idx[pc], pc + 1, frozenset())
+    return pairs
+
+
+def _universe_pairs(prog):
+    return set(zip(np.asarray(prog.edge_from).tolist(),
+                   np.asarray(prog.edge_to).tolist()))
+
+
+def test_compute_edges_branch_to_self_loop():
+    """A branch back to its own block head is a (k, k) self-edge, and
+    the engine's counts land on it once per taken iteration."""
+    a = Assembler("selfloop", max_steps=64)
+    a.block()                           # 0
+    a.ldi(1, 0)
+    a.label("head")
+    a.block()                           # 1: loops on itself
+    a.addi(1, 1, 1)
+    a.ldi(2, 3)
+    a.br("lt", 1, 2, "head")
+    a.halt(0)
+    prog = a.build()
+    pairs = _universe_pairs(prog)
+    assert (1, 1) in pairs
+    assert pairs == _brute_force_edge_pairs(prog.instrs.tolist())
+    res = run_inputs(prog, [b"x"])
+    self_edge = int(prog.edge_table[2, 1])   # (from=1)+1 row, to=1
+    # r1: 1, 2, 3 — the back branch is taken twice
+    assert int(np.asarray(res.counts)[0, self_edge]) == 2
+    assert int(res.status[0]) == FUZZ_NONE
+
+
+def test_compute_edges_unreachable_tail_block():
+    """Blocks jumped over by an unconditional jmp stay in the static
+    universe (it is per-block local by design — kb-lint flags them),
+    but never collect dynamic counts."""
+    a = Assembler("unreach", max_steps=64)
+    a.block()                           # 0
+    a.jmp("end")
+    a.block()                           # 1: unreachable tail
+    a.label("end")
+    a.block()                           # 2
+    a.halt(0)
+    prog = a.build()
+    pairs = _universe_pairs(prog)
+    assert (1, 2) in pairs              # edge FROM the dead block
+    assert (0, 1) not in pairs          # but nothing reaches it
+    assert pairs == _brute_force_edge_pairs(prog.instrs.tolist())
+    res = run_inputs(prog, [b"x"])
+    dead_edge = int(prog.edge_table[2, 2])
+    assert int(np.asarray(res.counts)[0, dead_edge]) == 0
+    from killerbeez_tpu.analysis import build_cfg
+    assert build_cfg(prog).unreachable_blocks() == [1]
+
+
+def test_compute_edges_first_instruction_not_block():
+    """Instructions before the first OP_BLOCK belong to the entry
+    path: the first block's edge is (-1, 0) with slot == its raw id
+    (prev_loc starts at 0)."""
+    a = Assembler("latehead", max_steps=32)
+    a.ldi(1, 0)
+    a.ldb(2, 1)
+    a.block()                           # 0: first block, 2 instrs in
+    a.halt(0)
+    prog = a.build()
+    pairs = _universe_pairs(prog)
+    assert pairs == {(-1, 0)}
+    assert pairs == _brute_force_edge_pairs(prog.instrs.tolist())
+    assert int(prog.edge_slot[0]) == prog.block_ids[0]
+    res = run_inputs(prog, [b"x"])
+    entry_edge = int(prog.edge_table[0, 0])
+    assert int(np.asarray(res.counts)[0, entry_edge]) == 1
+
+
+def test_compute_edges_matches_brute_force_on_builtins():
+    for name in targets.target_names():
+        prog = targets.get_target(name)
+        assert _universe_pairs(prog) == \
+            _brute_force_edge_pairs(prog.instrs.tolist()), name
+
+
 def test_single_lane_reference_engine_parity(rng):
     """vm._run_one is the readable single-lane reference the batched
     one-hot engine is built against: statuses, exit codes, edge

@@ -371,6 +371,87 @@ def bench_schedulers(schedules, targets=None, batch=1024, execs=131072,
                  target=target)
 
 
+def bench_crack(targets=None, batch=256, budget_execs=131072,
+                plateau=4, chunk_batches=8):
+    """--crack: plateau-crack A/B lane.  For each built-in magic-byte
+    target, run the SAME campaign (jit_harness + havoc from an
+    uninformative seed — the regime where blind mutation stalls on
+    magic bytes) with the crack stage off and on, and report execs
+    until 100% of the statically-reachable edge slots are covered
+    (or coverage at budget when the run never gets there).  The
+    acceptance bar: crack-on reaches full static coverage with
+    measurably fewer execs than the scheduler alone."""
+    import json as _json
+    import shutil
+    import numpy as np
+    from killerbeez_tpu.analysis import analyze_dataflow
+    from killerbeez_tpu.drivers.factory import driver_factory
+    from killerbeez_tpu.fuzzer.crack import BranchCracker
+    from killerbeez_tpu.fuzzer.loop import Fuzzer
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    from killerbeez_tpu.models import targets as targets_mod
+    from killerbeez_tpu.models import targets_cgc  # noqa: F401
+    from killerbeez_tpu.mutators.factory import mutator_factory
+
+    for target in (targets or ("test", "cgc_like")):
+        prog = targets_mod.get_target(target)
+        df = analyze_dataflow(prog)
+        ef = np.asarray(prog.edge_from)
+        et = np.asarray(prog.edge_to)
+        slots = np.asarray(prog.edge_slot)
+        # statically-reachable slots: drop edges touching blocks
+        # constant propagation proves dead
+        goal = {int(s) for f, t, s in zip(ef, et, slots)
+                if int(f) not in df.dead_blocks
+                and int(t) not in df.dead_blocks}
+        for mode in ("off", "on"):
+            instr = instrumentation_factory(
+                "jit_harness", _json.dumps(
+                    {"target": target, "novelty": "throughput"}))
+            mut = mutator_factory("havoc", '{"seed": 11}',
+                                  b"\x00" * 8)
+            drv = driver_factory("file", None, instr, mut)
+            out = os.path.join(REPO, "bench_out",
+                               f"crack_{target}_{mode}")
+            shutil.rmtree(out, ignore_errors=True)
+            fz = Fuzzer(drv, output_dir=out, batch_size=batch,
+                        write_findings=False)
+            if mode == "on":
+                fz.cracker = BranchCracker(prog,
+                                           plateau_batches=plateau)
+            full_at = None
+            t0 = time.time()
+            while fz.stats.iterations < budget_execs:
+                fz.run(fz.stats.iterations + chunk_batches * batch)
+                vb = np.asarray(instr.virgin_bits)
+                covered = set(np.flatnonzero(vb != 0xFF).tolist())
+                if goal <= covered:
+                    full_at = fz.stats.iterations
+                    break
+            dt = time.time() - t0
+            vb = np.asarray(instr.virgin_bits)
+            covered = set(np.flatnonzero(vb != 0xFF).tolist())
+            reg = fz.telemetry.registry
+            emit(f"crack-{mode}",
+                 f"plateau-crack {mode} on {target} (-b {batch}, "
+                 f"plateau {plateau}, blind 8-byte seed)",
+                 fz.stats.iterations / dt if dt else 0.0,
+                 target=target,
+                 execs_to_full_static_coverage=full_at,
+                 coverage_slots=len(goal & covered),
+                 goal_slots=len(goal),
+                 execs=fz.stats.iterations,
+                 crashes=fz.stats.crashes,
+                 solver_solved=int(reg.counters.get(
+                     "solver_solved", 0)),
+                 solver_unknown=int(reg.counters.get(
+                     "solver_unknown", 0)),
+                 solver_injected=int(reg.counters.get(
+                     "solver_injected", 0)))
+
+
 def bench_multichip_smoke():
     """Config 5: sharded step on a virtual 8-device CPU mesh, run in a
     subprocess (the driver env exposes one real chip; see
@@ -501,6 +582,30 @@ def main():
             return 2
         bench_schedulers(schedules, targets=tgts or None,
                         batch=batch, execs=execs)
+        return 0
+
+    if "--crack" in sys.argv[1:]:
+        # plateau-crack A/B mode:
+        #   python bench.py --crack [target ...] [-b BATCH] [-n EXECS]
+        rest = [a for a in sys.argv[1:] if a != "--crack"]
+        batch, budget, tgts = 256, 131072, []
+        j = 0
+        while j < len(rest):
+            if rest[j] == "-b":
+                batch = int(rest[j + 1]); j += 2
+            elif rest[j] == "-n":
+                budget = int(rest[j + 1]); j += 2
+            else:
+                tgts.append(rest[j]); j += 1
+        from killerbeez_tpu.models.targets import target_names
+        from killerbeez_tpu.models import targets_cgc  # noqa: F401
+        bad = [t for t in tgts if t not in target_names()]
+        if bad:
+            print(f"error: unknown target(s) {bad} "
+                  f"(choose from {target_names()})", file=sys.stderr)
+            return 2
+        bench_crack(targets=tgts or None, batch=batch,
+                    budget_execs=budget)
         return 0
 
     if "--stats-overhead" in sys.argv[1:]:

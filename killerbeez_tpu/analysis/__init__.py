@@ -17,6 +17,13 @@ of facts AFL can only estimate dynamically is simply computable here:
   lint.py      defect checks over both (slot collisions, unreachable
                blocks, empty modules, max_steps shortfalls, ...) —
                the ``kb-lint`` tool and the CI lint lane
+  solver.py    path-condition collection + input synthesis — given a
+               target edge, collect the branch conditions a path
+               there must satisfy and solve them into concrete input
+               bytes (exact for expect_byte chains and linear ALU
+               compositions, budget-capped enumeration beyond, every
+               emitted input concretely verified) — the ``kb-solve``
+               tool and the fuzzing loop's plateau crack stage
 """
 
 from .cfg import ControlFlowGraph, build_cfg, static_edge_prior
@@ -24,10 +31,15 @@ from .dataflow import (
     BranchFact, DataflowResult, analyze_dataflow, extract_dictionary,
 )
 from .lint import Finding, lint_program
+from .solver import (
+    SolveResult, concrete_run, edge_dep_mask, solve_edge, solve_edges,
+)
 
 __all__ = [
     "ControlFlowGraph", "build_cfg", "static_edge_prior",
     "BranchFact", "DataflowResult", "analyze_dataflow",
     "extract_dictionary",
     "Finding", "lint_program",
+    "SolveResult", "concrete_run", "edge_dep_mask", "solve_edge",
+    "solve_edges",
 ]

@@ -313,17 +313,17 @@ def extract_dictionary(program,
     here because the program text is ours (PAPERS.md).
     """
     result = result or analyze_dataflow(program)
-    tokens: List[bytes] = []
-    seen: Set[bytes] = set()
+    # (first-use pc, token) candidates; the FINAL order is sorted by
+    # (first-use pc, bytes) and deduped — deterministic across runs
+    # and across any reordering of the branch list (the order used to
+    # follow collection order, so dictionary walks depended on
+    # analysis-internal iteration details)
+    cands: List[Tuple[int, bytes]] = []
 
-    def add(tok: bytes) -> None:
-        if tok and tok not in seen:
-            seen.add(tok)
-            tokens.append(tok)
-
-    # positional single-byte compares -> merged runs first (the most
+    # positional single-byte compares -> merged runs (the most
     # valuable tokens), collected only when a position pins ONE value
     by_pos: Dict[int, Set[int]] = {}
+    first_pc: Dict[int, int] = {}
     for f in result.branches:
         if (f.cmp in ("eq", "ne") and f.const is not None
                 and 0 <= f.const <= 255 and f.deps is not ANY
@@ -331,11 +331,13 @@ def extract_dictionary(program,
             i = next(iter(f.deps))
             if isinstance(i, int) and i >= 0:
                 by_pos.setdefault(i, set()).add(f.const)
+                first_pc[i] = min(first_pc.get(i, f.pc), f.pc)
     run: List[int] = []
 
     def flush(run: List[int]) -> None:
         if len(run) >= 2:
-            add(bytes(next(iter(by_pos[i])) for i in run))
+            cands.append((min(first_pc[i] for i in run),
+                          bytes(next(iter(by_pos[i])) for i in run)))
 
     for i in sorted(by_pos):
         single = len(by_pos[i]) == 1
@@ -347,7 +349,7 @@ def extract_dictionary(program,
     flush(run)
 
     # individual constants (any input-dependent guarded compare)
-    for f in sorted(result.branches, key=lambda f: f.pc):
+    for f in result.branches:
         if f.const is None:
             continue
         if f.deps is not ANY and not f.deps:
@@ -357,13 +359,20 @@ def extract_dictionary(program,
             continue                    # zero bytes carry no signal
         u = c & 0xFFFFFFFF
         if 0 < c <= 0xFF:
-            add(bytes([c]))
+            cands.append((f.pc, bytes([c])))
         elif 0 < c <= 0xFFFF:
-            add(u.to_bytes(2, "little"))
-            add(u.to_bytes(2, "big"))
+            cands.append((f.pc, u.to_bytes(2, "little")))
+            cands.append((f.pc, u.to_bytes(2, "big")))
         else:
-            add(u.to_bytes(4, "little"))
-            add(u.to_bytes(4, "big"))
+            cands.append((f.pc, u.to_bytes(4, "little")))
+            cands.append((f.pc, u.to_bytes(4, "big")))
+
+    tokens: List[bytes] = []
+    seen: Set[bytes] = set()
+    for _pc, tok in sorted(cands):
+        if tok and tok not in seen:
+            seen.add(tok)
+            tokens.append(tok)
         if len(tokens) >= max_tokens:
             break
     return tokens[:max_tokens]

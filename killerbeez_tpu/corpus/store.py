@@ -44,7 +44,9 @@ from ..utils.logging import WARNING_MSG
 STATE_FILE = "campaign.json"
 MUTATOR_STATE_FILE = "mutator.state"
 INSTR_STATE_FILE = "instrumentation.state"
-_RESERVED = (STATE_FILE, MUTATOR_STATE_FILE, INSTR_STATE_FILE)
+SOLVER_STATE_FILE = "solver.json"
+_RESERVED = (STATE_FILE, MUTATOR_STATE_FILE, INSTR_STATE_FILE,
+             SOLVER_STATE_FILE)
 
 
 def coverage_hash(sig: Optional[List[int]],
@@ -276,3 +278,23 @@ class CorpusStore:
                 return f.read()
         except OSError:
             return None
+
+    # -- solver cache (crack stage) -------------------------------------
+
+    def save_solver_cache(self, cache: Dict[str, Any]) -> None:
+        """Per-edge solve results ("f:t" -> {status, input_hex,
+        reason}) — the solver is a pure function of the program, so a
+        resumed campaign re-injects/skips instead of re-solving."""
+        try:
+            _atomic_write(os.path.join(self.root, SOLVER_STATE_FILE),
+                          json.dumps(cache).encode())
+        except OSError as e:
+            WARNING_MSG("solver cache write failed: %s", e)
+
+    def load_solver_cache(self) -> Dict[str, Any]:
+        try:
+            with open(os.path.join(self.root, SOLVER_STATE_FILE)) as f:
+                d = json.load(f)
+            return d if isinstance(d, dict) else {}
+        except (OSError, ValueError):
+            return {}

@@ -95,6 +95,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sync-interval", type=float, default=30.0,
                    help="seconds between corpus sync rounds "
                         "(default 30)")
+    p.add_argument("--crack", type=int, nargs="?", const=16, default=0,
+                   metavar="N",
+                   help="plateau crack stage (KBVM device targets): "
+                        "after N batches with no new paths (default "
+                        "16 when the flag is bare), solve statically-"
+                        "reachable-but-never-hit edges into concrete "
+                        "inputs (analysis/solver.py) and inject them; "
+                        "solve results persist to the corpus store's "
+                        "solver.json so resumes don't re-solve")
+    p.add_argument("--no-focus", action="store_true",
+                   help="with --crack: do NOT install the Angora-"
+                        "style focused-mutation byte masks derived "
+                        "from the uncovered frontier's dependency "
+                        "sets (mutators then keep their exact "
+                        "unfocused candidate streams)")
     p.add_argument("-dt", "--debug-triage", action="store_true",
                    help="re-run each unique crash once under the "
                         "ptrace debug tier and save signal-level "
@@ -301,6 +316,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.schedule == "rare-edge":
             _wire_rare_edge_signer(fuzzer, driver)
             _wire_static_prior(fuzzer, driver)
+        if args.crack:
+            prog = getattr(instrumentation, "program", None)
+            if prog is None or not instrumentation.device_backed \
+                    or args.mesh:
+                print("error: --crack needs a KBVM device target "
+                      "(jit_harness, single-chip) — the solver works "
+                      "on the program text", file=sys.stderr)
+                return 2
+            from .crack import BranchCracker
+            fuzzer.cracker = BranchCracker(
+                prog, plateau_batches=args.crack,
+                focus=not args.no_focus, store=fuzzer.store)
         stats = fuzzer.run(args.iterations)
         # both rates read the SAME registry the loop recorded into —
         # the CLI never recomputes from its own wall clock

@@ -1,0 +1,220 @@
+"""The plateau crack stage — solver-guided branch cracking.
+
+Closes the loop from "observe coverage" to "compute the input that
+extends it": when the fuzzing loop plateaus (no new paths for N
+batches), the cracker diffs the program's STATIC edge universe
+(``vm.compute_edges``) against the dynamic coverage the campaign has
+actually accumulated (the instrumentation's virgin map), asks the
+path-condition solver (``analysis/solver.py``) for inputs reaching
+the never-hit edges, and injects the solved candidates straight
+through the instrumentation — ahead of any scheduler decision.
+Solved, unsat and unknown verdicts are cached (and persisted to the
+corpus store's ``solver.json`` sidecar when a store is attached), so
+an edge is solved at most once per campaign lineage, resumes
+included.
+
+Second consumer: **focused mutation masks**.  The dependency sets of
+the branches guarding the still-uncovered frontier (dataflow layer)
+become a byte mask the havoc/zzuf mutators honor — Angora's "don't
+burn mutations on bytes no uncovered branch reads", bought statically
+instead of with dynamic taint.  ``--no-focus`` disables the masks;
+campaigns without a cracker never see one (parity-pinned).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..analysis import analyze_dataflow, edge_dep_mask
+from ..analysis.solver import (
+    DEFAULT_BUDGET, DEFAULT_MAX_LEN, DEFAULT_MAX_VISITS, solve_edge,
+)
+from ..utils.logging import DEBUG_MSG, INFO_MSG, WARNING_MSG
+
+
+class BranchCracker:
+    """Owns the plateau trigger, the per-edge solve cache, candidate
+    injection and the focus-mask feed for ONE campaign/program."""
+
+    #: at most this many fresh solver attempts per crack invocation
+    #: (the rest wait for the next plateau — keeps a single crack's
+    #: host-side pause bounded)
+    MAX_SOLVES_PER_CRACK = 32
+
+    def __init__(self, program, *, plateau_batches: int = 16,
+                 budget: int = DEFAULT_BUDGET,
+                 max_visits: int = DEFAULT_MAX_VISITS,
+                 max_len: int = DEFAULT_MAX_LEN,
+                 focus: bool = True, store=None):
+        self.program = program
+        self.plateau_batches = max(int(plateau_batches), 1)
+        self.budget = int(budget)
+        self.max_visits = int(max_visits)
+        self.max_len = int(max_len)
+        self.focus = bool(focus)
+        self.store = store
+        ef = np.asarray(program.edge_from)
+        et = np.asarray(program.edge_to)
+        slots = np.asarray(program.edge_slot)
+        self.edges: List[Tuple[int, int]] = \
+            [(int(f), int(t)) for f, t in zip(ef, et)]
+        self.slot_of_edge: Dict[Tuple[int, int], int] = {
+            e: int(s) for e, s in zip(self.edges, slots)}
+        self._dataflow = None           # lazy (mask computation only)
+        #: "f:t" -> {"status", "reason", "input_hex"?}
+        self.cache: Dict[str, Dict] = {}
+        if store is not None:
+            self.cache = store.load_solver_cache()
+        self._last_new_paths = -1
+        self._progress_iter = 0
+
+    # -- coverage frontier ----------------------------------------------
+
+    def uncovered_edges(self, instr) -> List[Tuple[int, int]]:
+        """Static edges whose AFL map slot the campaign has never lit
+        (colliding slots conflate, exactly as novelty itself does)."""
+        virgin = np.asarray(instr.virgin_bits)
+        covered = set(np.flatnonzero(virgin != 0xFF).tolist())
+        return [e for e in self.edges
+                if self.slot_of_edge[e] not in covered]
+
+    @staticmethod
+    def _key(edge: Tuple[int, int]) -> str:
+        return f"{edge[0]}:{edge[1]}"
+
+    # -- the plateau trigger --------------------------------------------
+
+    def maybe_crack(self, fuzzer) -> None:
+        """Called once per loop iteration: fire ``crack`` after
+        ``plateau_batches`` batches with zero new paths.
+
+        ``stats.iterations`` advances at DISPATCH while
+        ``stats.new_paths`` advances at triage, which lags by up to
+        ``PIPELINE_DEPTH`` batches — so the plateau window is padded
+        by the pipeline depth.  By the time the padded window
+        elapses, every batch of the un-padded window has been
+        triaged (the pending deque caps at the depth), and any
+        finding among them would have reset the baseline: the crack
+        only fires after ``plateau_batches`` PROVEN finding-free
+        batches, not during warm-up."""
+        s = fuzzer.stats
+        if s.new_paths != self._last_new_paths:
+            self._last_new_paths = s.new_paths
+            self._progress_iter = s.iterations
+            return
+        depth = getattr(fuzzer, "PIPELINE_DEPTH", 0)
+        window = (self.plateau_batches + depth) * fuzzer.batch_size
+        if s.iterations - self._progress_iter < window:
+            return
+        self._progress_iter = s.iterations      # re-arm
+        self.crack(fuzzer)
+
+    # -- the crack itself -----------------------------------------------
+
+    def crack(self, fuzzer) -> int:
+        """Solve + inject the uncovered frontier; returns how many
+        candidates were injected."""
+        instr = fuzzer.driver.instrumentation
+        reg = fuzzer.telemetry.registry
+        uncovered = self.uncovered_edges(instr)
+        reg.gauge("solver_frontier", len(uncovered))
+        if not uncovered:
+            if self.focus:
+                fuzzer.driver.mutator.set_focus_mask(None)
+            return 0
+
+        fresh = [e for e in uncovered if self._key(e) not in self.cache]
+        t0 = time.time()
+        for e in fresh[:self.MAX_SOLVES_PER_CRACK]:
+            reg.count("solver_attempts")
+            res = solve_edge(self.program, e, budget=self.budget,
+                             max_visits=self.max_visits,
+                             max_len=self.max_len)
+            entry = {"status": res.status, "reason": res.reason}
+            if res.status == "solved":
+                reg.count("solver_solved")
+                entry["input_hex"] = res.input.hex()
+            elif res.status == "unsat":
+                reg.count("solver_unsat")
+            else:
+                reg.count("solver_unknown")
+                if "budget" in res.reason:
+                    reg.count("solver_budget_bailed")
+            self.cache[self._key(e)] = entry
+        if self.store is not None and fresh:
+            self.store.save_solver_cache(self.cache)
+
+        # inject every cached solve whose edge is STILL uncovered —
+        # includes solves restored from a resumed campaign's sidecar
+        bufs = []
+        for e in uncovered:
+            entry = self.cache.get(self._key(e))
+            if entry and entry.get("status") == "solved" \
+                    and "input_hex" in entry:
+                bufs.append(bytes.fromhex(entry["input_hex"]))
+        injected = self._inject(fuzzer, bufs) if bufs else 0
+        if fresh or injected:
+            INFO_MSG(
+                "crack: %d uncovered edges, %d solve attempts "
+                "(%.2fs), %d candidates injected",
+                len(uncovered), len(fresh[:self.MAX_SOLVES_PER_CRACK]),
+                time.time() - t0, injected)
+
+        # focus mask from whatever frontier remains unsolved
+        if self.focus:
+            remaining = self.uncovered_edges(instr)
+            self._update_mask(fuzzer, remaining)
+        return injected
+
+    def _inject(self, fuzzer, bufs: List[bytes]) -> int:
+        """Run solved candidates through the MAIN instrumentation (so
+        its virgin maps absorb the new coverage) and hand each lane to
+        the loop's triage — findings dedup, persist, sync and enter
+        rotation exactly like mutated ones."""
+        from ..mutators.base import pack_byte_rows
+        instr = fuzzer.driver.instrumentation
+        inputs, lengths = pack_byte_rows(bufs)
+        try:
+            res = instr.run_batch(inputs, lengths)
+        except Exception as e:      # cracking must never kill the loop
+            WARNING_MSG("crack injection failed: %s", e)
+            return 0
+        # statuses arrive hang-mapped (run_batch folds FUZZ_RUNNING)
+        statuses = np.asarray(res.statuses)
+        new_paths = np.asarray(res.new_paths)
+        uc = np.asarray(res.unique_crashes)
+        uh = np.asarray(res.unique_hangs)
+        reg = fuzzer.telemetry.registry
+        n = len(bufs)
+        fuzzer.stats.iterations += n
+        reg.rate("execs", n)
+        reg.count("solver_injected", n)
+        prev_credit = fuzzer._credit_arm
+        fuzzer._credit_arm = None       # solver finds credit the base
+        try:
+            for i in range(n):
+                fuzzer._triage_lane(int(statuses[i]),
+                                    int(new_paths[i]), bufs[i],
+                                    bool(uc[i]), bool(uh[i]))
+        finally:
+            fuzzer._credit_arm = prev_credit
+        return n
+
+    def _update_mask(self, fuzzer, remaining) -> None:
+        mut = fuzzer.driver.mutator
+        if not remaining:
+            mut.set_focus_mask(None)
+            reg = fuzzer.telemetry.registry
+            reg.gauge("solver_frontier", 0)
+            return
+        if self._dataflow is None:
+            self._dataflow = analyze_dataflow(self.program)
+        mask = edge_dep_mask(self.program, remaining, self._dataflow)
+        mut.set_focus_mask(mask)
+        fuzzer.telemetry.registry.gauge(
+            "solver_focus_bytes", len(mask) if mask else 0)
+        DEBUG_MSG("crack: focus mask %s over %d frontier edges",
+                  mask, len(remaining))

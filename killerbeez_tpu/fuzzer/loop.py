@@ -219,6 +219,11 @@ class Fuzzer:
         #: optional signature hook: bytes -> [edge slot, ...] for the
         #: entry sidecar (rare-edge scheduling, sync coverage dedup)
         self._signer = None
+        #: optional plateau crack stage (fuzzer/crack.py): solves
+        #: statically-reachable-but-never-hit edges into concrete
+        #: inputs when coverage stalls, and feeds the focused-
+        #: mutation masks; installed by the CLI's --crack wiring
+        self.cracker = None
         self._persist_interval = float(persist_interval)
         self._last_persist = 0.0
         # the arm whose candidates the batch being TRIAGED came from:
@@ -857,6 +862,18 @@ class Fuzzer:
                         self._credit_period()
                         if self._corpus:
                             self._rotate_seed(mut)
+                # plateau crack: when no new paths for N batches,
+                # solve uncovered static edges into inputs and inject
+                # them ahead of the scheduler (the injected execs
+                # triage synchronously — the pipeline keeps flowing).
+                # Ready batches are triaged first so the plateau
+                # verdict reads coverage as fresh as non-blocking
+                # probes allow (the detector itself also pads its
+                # window by the pipeline depth).
+                if self.cracker is not None:
+                    with self.telemetry.timer("corpus_feedback"):
+                        self._drain_ready(pending)
+                        self.cracker.maybe_crack(self)
                 # K-step accumulation may not stride over a feedback
                 # rotation boundary (the check above only fires at
                 # loop top): engage only when the next boundary is at
@@ -871,7 +888,12 @@ class Fuzzer:
                         and self._remaining(n_iterations)
                         >= accumulate * self.batch_size
                         and mut.remaining()
-                        >= accumulate * self.batch_size):
+                        >= accumulate * self.batch_size
+                        # re-checked per batch: a crack-stage focus
+                        # mask drops fused eligibility (the fused
+                        # kernel generates candidates itself and
+                        # would silently ignore the mask)
+                        and self.driver.supports_fused_multi()):
                     # K-step device-side accumulation: one transfer
                     # set per K batches
                     self._run_superbatch(accumulate, pending, depth)

@@ -376,6 +376,12 @@ class JitHarnessInstrumentation(Instrumentation):
                 "mutator (havoc); %s mutates separately — running the "
                 "unfused pallas engine",
                 getattr(mutator, "name", type(mutator).__name__))
+        if getattr(mutator, "focus_positions", None) is not None:
+            # a focus mask (crack-stage frontier bytes) is honored
+            # only by the mutate-then-execute path; silently fusing
+            # would drop the mask, so fusion stands down until the
+            # mask clears
+            return False
         return self.engine in ("pallas", "pallas_fused") and fusable
 
     def run_batch_fused(self, mutator, its, pad_to: Optional[int] = None

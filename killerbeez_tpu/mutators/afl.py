@@ -37,6 +37,13 @@ class AflMutator(Mutator):
         self._havoc = jax.jit(jax.vmap(
             lambda b, ln, k: mc.havoc_at(b, ln, k, stack_pow2=sp),
             in_axes=(None, None, 0)))
+        # focus mask applies to the havoc tail only: the
+        # deterministic stages are position-exhaustive walks whose
+        # iteration contract must not change under a mask
+        self._havoc_focus = jax.jit(jax.vmap(
+            lambda b, ln, k, p: mc.havoc_focus_at(b, ln, k, p,
+                                                  stack_pow2=sp),
+            in_axes=(None, None, 0, None)))
         self._flip = {}
         for nb in (1, 2, 4, 8, 16, 32):
             self._flip[nb] = jax.jit(jax.vmap(
@@ -125,8 +132,14 @@ class AflMutator(Mutator):
             base = jax.random.key(int(self.options.get("seed", 0)))
             keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
                 jnp.asarray(local, dtype=jnp.uint32))
-            b, ln = self._havoc(jnp.asarray(self.seed_buf),
-                                jnp.int32(self.seed_len), keys)
+            if self.focus_positions is not None:
+                b, ln = self._havoc_focus(
+                    jnp.asarray(self.seed_buf),
+                    jnp.int32(self.seed_len), keys,
+                    jnp.asarray(self.focus_positions))
+            else:
+                b, ln = self._havoc(jnp.asarray(self.seed_buf),
+                                    jnp.int32(self.seed_len), keys)
             out_b[remaining_mask] = np.asarray(b)
             out_l[remaining_mask] = np.asarray(ln)
         return out_b, out_l

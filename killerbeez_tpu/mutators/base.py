@@ -110,6 +110,29 @@ class Mutator:
         self._stash = None  # prefetched candidates used the old seed
         self.iteration = 0
 
+    # -- focused mutation (Angora-style byte masks) ---------------------
+
+    #: optional int32[P] byte positions mutation should concentrate
+    #: on (the frontier-dependency mask from the static layer); None
+    #: = unfocused.  Mutators that can honor it do (havoc, zzuf, the
+    #: afl havoc tail); deterministic walks ignore it — their
+    #: iteration contract is position-exhaustive by definition.
+    focus_positions = None
+
+    def set_focus_mask(self, positions) -> None:
+        """Install (or clear, with None/empty) the focus byte mask.
+        Positions beyond the candidate buffer are dropped; an empty
+        surviving set clears the mask — a mask must never silently
+        pin mutation to nothing."""
+        if positions is not None:
+            positions = sorted({int(p) for p in positions
+                                if 0 <= int(p) < self.max_length})
+        if not positions:
+            self.focus_positions = None
+        else:
+            self.focus_positions = np.asarray(positions, dtype=np.int32)
+        self._stash = None  # prefetched candidates used the old mask
+
     # -- iteration bookkeeping -----------------------------------------
 
     def get_current_iteration(self) -> int:

@@ -53,8 +53,20 @@ class HavocMutator(_KeyedMutator):
         self._fn = jax.jit(jax.vmap(
             lambda b, ln, k: mc.havoc_at(b, ln, k, stack_pow2=sp),
             in_axes=(None, None, 0)))
+        # focused variant: positions ride as a traced arg so mask
+        # updates (the frontier shrinks as edges crack) only
+        # recompile when the mask SIZE changes
+        self._fn_focus = jax.jit(jax.vmap(
+            lambda b, ln, k, p: mc.havoc_focus_at(b, ln, k, p,
+                                                  stack_pow2=sp),
+            in_axes=(None, None, 0, None)))
 
     def _generate(self, its):
+        if self.focus_positions is not None:
+            bufs, lens = self._fn_focus(
+                jnp.asarray(self.seed_buf), jnp.int32(self.seed_len),
+                self._keys(its), jnp.asarray(self.focus_positions))
+            return bufs, lens
         bufs, lens = self._fn(jnp.asarray(self.seed_buf),
                               jnp.int32(self.seed_len), self._keys(its))
         return bufs, lens  # device arrays: base keeps them lazy
@@ -86,8 +98,16 @@ class ZzufMutator(_KeyedMutator):
         self._fn = jax.jit(jax.vmap(
             lambda b, ln, k: mc.zzuf_at(b, ln, k, ratio=r),
             in_axes=(None, None, 0)))
+        self._fn_focus = jax.jit(jax.vmap(
+            lambda b, ln, k, p: mc.zzuf_focus_at(b, ln, k, p, ratio=r),
+            in_axes=(None, None, 0, None)))
 
     def _generate(self, its):
+        if self.focus_positions is not None:
+            bufs, lens = self._fn_focus(
+                jnp.asarray(self.seed_buf), jnp.int32(self.seed_len),
+                self._keys(its), jnp.asarray(self.focus_positions))
+            return bufs, lens
         bufs, lens = self._fn(jnp.asarray(self.seed_buf),
                               jnp.int32(self.seed_len), self._keys(its))
         return bufs, lens  # device arrays: base keeps them lazy

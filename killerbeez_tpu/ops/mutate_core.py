@@ -280,7 +280,7 @@ def interesting_at(buf: jax.Array, length: jax.Array, it: jax.Array
 N_HAVOC_OPS = 15
 
 
-def _havoc_one(buf, length, words):
+def _havoc_one(buf, length, words, positions=None):
     """One stacked havoc edit, chosen uniformly from the op table.
 
     Branch-free: under vmap a 15-way ``lax.switch`` lowers to
@@ -314,6 +314,24 @@ def _havoc_one(buf, length, words):
     blk = (1 + words[6] % jnp.maximum(blk_span, 1)).astype(jnp.int32)
     bit = (words[7] % jnp.maximum(length * 8, 1).astype(jnp.uint32)
            ).astype(jnp.int32)
+    if positions is not None:
+        # Angora-style focus: anchor the primary edit position (and
+        # the bit-flip byte) on the frontier-dependency byte set
+        # instead of the whole buffer — mutations stop burning on
+        # bytes no uncovered branch reads.  Clone sources (pos2) and
+        # block spans stay unrestricted: material may come from
+        # anywhere, it just lands on a frontier byte.
+        np_ = positions.shape[0]
+        lim = jnp.maximum(length, 1).astype(jnp.int32) - 1
+        pidx = jnp.arange(np_, dtype=jnp.int32)
+
+        def pick(sel):
+            return jnp.sum(jnp.where(pidx == sel.astype(jnp.int32),
+                                     positions, 0))
+
+        pos = jnp.minimum(pick(words[1] % np_), lim)
+        bit = jnp.minimum(pick(words[7] % np_), lim) * 8 + \
+            (words[7] >> 16).astype(jnp.int32) % 8
     delta = (rint % ARITH_MAX + 1).astype(jnp.uint32)
     use_fill = (rint % 4) == 0  # insert/overwrite: 25% fill, 75% clone
 
@@ -432,6 +450,54 @@ def havoc_at(buf: jax.Array, length: jax.Array, key: jax.Array,
         step, (buf, length),
         (jnp.arange(n_steps, dtype=jnp.uint32), words[1:]))
     return out, out_len
+
+
+@partial(jax.jit, static_argnames=("stack_pow2",))
+def havoc_focus_at(buf: jax.Array, length: jax.Array, key: jax.Array,
+                   positions: jax.Array, stack_pow2: int = 4
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """``havoc_at`` with edit positions drawn from ``positions``
+    (int32[P], the frontier-dependency byte set from the static
+    layer).  A SEPARATE entry point on purpose: the unfocused path
+    keeps its exact historical RNG stream and compiled program, so
+    ``--no-focus`` (and every campaign without a mask) is bit-for-bit
+    parity-pinned against prior releases."""
+    n_steps = 1 << stack_pow2
+    words = jax.random.bits(key, (n_steps + 1, 8), dtype=jnp.uint32)
+    stack = jnp.uint32(1) << (1 + words[0, 0] % stack_pow2)
+
+    def step(carry, xs):
+        i, w = xs
+        b, ln = carry
+        nb, nln = _havoc_one(b, ln, w, positions=positions)
+        active = i < stack
+        b = jnp.where(active, nb, b)
+        ln = jnp.where(active, nln, ln)
+        return (b, ln), None
+
+    (out, out_len), _ = jax.lax.scan(
+        step, (buf, length),
+        (jnp.arange(n_steps, dtype=jnp.uint32), words[1:]))
+    return out, out_len
+
+
+@jax.jit
+def zzuf_focus_at(buf: jax.Array, length: jax.Array, key: jax.Array,
+                  positions: jax.Array, ratio: jax.Array | float = 0.004
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """``zzuf_at`` restricted to the focus byte set, with the flip
+    ratio rescaled by buffer/mask size so the EXPECTED flip count is
+    preserved — a 2-byte mask on a 64-byte buffer at the default
+    ratio would otherwise leave ~94% of candidates byte-identical to
+    the seed (duplicate execs, exactly when the campaign is
+    plateaued)."""
+    L = buf.shape[-1]
+    scaled = jnp.minimum(
+        jnp.asarray(ratio, jnp.float32) * L / positions.shape[0], 1.0)
+    out, ln = zzuf_at(buf, length, key, scaled)
+    idx = jnp.arange(L, dtype=jnp.int32)
+    allowed = (idx[:, None] == positions[None, :]).any(axis=1)
+    return jnp.where(allowed, out, buf), ln
 
 
 @jax.jit

@@ -28,20 +28,41 @@ from ..analysis import (
 from ..analysis.lint import SEV_ERROR, SEV_WARNING, universe_stats
 
 
+def _target_location(name) -> Dict:
+    """Repo-relative source location of a built-in target's builder —
+    GitHub's SARIF ingestion renders results only through a
+    physicalLocation, so findings anchor on the target definition."""
+    import inspect
+    import os
+    from ..models import targets
+    try:
+        fn = targets._REGISTRY[name]
+        path = inspect.getsourcefile(fn)
+        _, line = inspect.getsourcelines(fn)
+        return {"uri": os.path.relpath(path).replace(os.sep, "/"),
+                "line": int(line)}
+    except (KeyError, OSError, TypeError, ValueError):
+        return {"uri": f"kbvm/{name}", "line": 1}
+
+
 def _load_programs(args) -> List:
+    """[(program, sarif location)] for every requested target."""
     # import both registries: targets_cgc registers on import
     from ..models import targets, targets_cgc  # noqa: F401
+    import os
 
     names = list(args.targets)
     if args.all_targets or (not names and not args.program_file):
         names = targets.target_names()
     progs = []
     for name in names:
-        progs.append(targets.get_target(name))
+        progs.append((targets.get_target(name),
+                      _target_location(name)))
     for path in args.program_file or []:
-        progs.append(targets.load_program_from_options(
-            {"program_file": path},
-            "program_file missing"))
+        progs.append((targets.load_program_from_options(
+            {"program_file": path}, "program_file missing"),
+            {"uri": os.path.relpath(path).replace(os.sep, "/"),
+             "line": 1}))
     return progs
 
 
@@ -62,6 +83,68 @@ def lint_report(program, want_dict: bool = False) -> Dict:
     return rep
 
 
+_SARIF_LEVELS = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def sarif_report(reports: Dict,
+                 locations: Optional[Dict[str, Dict]] = None) -> Dict:
+    """SARIF 2.1.0 document over per-target reports — one rule per
+    check id, one result per finding.  Each result carries BOTH a
+    logical location addressing ``<target>:pc<N>`` (KBVM programs
+    have no per-pc source) and a physical location anchored on the
+    target's builder source (``locations``: report key -> {uri,
+    line}) — GitHub's SARIF ingestion requires the physical location
+    to render PR annotations at all."""
+    locations = locations or {}
+    rules: Dict[str, Dict] = {}
+    results = []
+    for name, rep in reports.items():
+        phys = locations.get(name, {"uri": f"kbvm/{name}", "line": 1})
+        for f in rep["findings"]:
+            code = f["code"]
+            if code not in rules:
+                rules[code] = {
+                    "id": code,
+                    "shortDescription": {"text": code},
+                    "defaultConfiguration": {
+                        "level": _SARIF_LEVELS[f["severity"]]},
+                }
+            data = f.get("data", {})
+            loc = name if "pc" not in data else f"{name}:pc{data['pc']}"
+            results.append({
+                "ruleId": code,
+                "level": _SARIF_LEVELS[f["severity"]],
+                "message": {"text": f["message"]},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": phys["uri"]},
+                        "region": {"startLine": phys["line"]},
+                    },
+                    "logicalLocations": [{
+                        "name": name,
+                        "fullyQualifiedName": loc,
+                        "kind": "module",
+                    }],
+                }],
+                "properties": {"target": name, **data},
+            })
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "kb-lint",
+                "informationUri":
+                    "https://github.com/grimm-co/killerbeez",
+                "rules": sorted(rules.values(),
+                                key=lambda r: r["id"]),
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="kb-lint",
@@ -74,8 +157,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "when no names are given; explicit for CI)")
     p.add_argument("--program-file", action="append",
                    help="compiled .npz program (repeatable)")
-    p.add_argument("--json", action="store_true",
-                   help="machine-readable report on stdout")
+    fmt = p.add_mutually_exclusive_group()
+    fmt.add_argument("--json", action="store_true",
+                     help="machine-readable report on stdout")
+    fmt.add_argument("--sarif", action="store_true",
+                     help="SARIF 2.1.0 report on stdout (one rule "
+                          "per check id) — the CI lane uploads this "
+                          "to annotate findings on PRs")
     p.add_argument("--dict", action="store_true", dest="want_dict",
                    help="include the extracted auto-dictionary")
     args = p.parse_args(argv)
@@ -86,20 +174,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     reports = {}
+    locs = {}
     errors = warnings = 0
-    for prog in progs:
+    for prog, loc in progs:
         rep = lint_report(prog, want_dict=args.want_dict)
         key, n = prog.name, 2
         while key in reports:           # same-named programs must not
             key = f"{prog.name}#{n}"    # overwrite each other
             n += 1
         reports[key] = rep
+        locs[key] = loc
         errors += rep["errors"]
         warnings += rep["warnings"]
 
     if args.json:
         print(json.dumps({"targets": reports, "errors": errors,
                           "warnings": warnings}, indent=2))
+        return 1 if errors else 0
+
+    if args.sarif:
+        print(json.dumps(sarif_report(reports, locs), indent=2))
         return 1 if errors else 0
 
     for name, rep in reports.items():

@@ -89,6 +89,16 @@ def render(snap: Dict[str, Any]) -> str:
                      f" in / {_fmt_n(c.get('corpus_synced_out', 0))}"
                      " out")
         lines.append(line)
+    if c.get("solver_attempts") or g.get("solver_frontier"):
+        line = (f"  solver   : "
+                f"{_fmt_n(c.get('solver_solved', 0))} solved"
+                f" | {_fmt_n(c.get('solver_unsat', 0))} unsat"
+                f" | {_fmt_n(c.get('solver_unknown', 0))} unknown"
+                f" | {int(g.get('solver_frontier', 0))} frontier "
+                f"pending")
+        if c.get("solver_injected"):
+            line += f" | {_fmt_n(c.get('solver_injected', 0))} injected"
+        lines.append(line)
     lines.append(
         f"  crashes  : {_fmt_n(c.get('crashes', 0))}"
         f" ({_fmt_n(c.get('unique_crashes', 0))} unique)"

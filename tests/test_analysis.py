@@ -305,6 +305,76 @@ def test_extract_dictionary_merges_magic_runs():
     assert bytes([0x0d]) in toks        # opcode byte (PRIV)
 
 
+def test_extract_dictionary_deterministic_ordering():
+    """Regression: token order is (first-use pc, bytes) — stable
+    across runs AND across any reordering of the branch list (it used
+    to follow collection order)."""
+    from killerbeez_tpu.analysis.dataflow import (
+        BranchFact, DataflowResult,
+    )
+    prog = targets.get_target("tlvstack_vm")
+    base = analyze_dataflow(prog)
+    toks = extract_dictionary(prog, base)
+    # same facts, reversed and interleaved: identical tokens
+    for order in (list(reversed(base.branches)),
+                  base.branches[1::2] + base.branches[0::2]):
+        shuffled = DataflowResult(branches=order,
+                                  reached_pcs=base.reached_pcs)
+        assert extract_dictionary(prog, shuffled) == toks
+    # the contract itself: a synthetic two-branch program emits the
+    # earlier-pc token first even when collected later
+    early = BranchFact(pc=2, block=0, cmp="eq", const=0x41,
+                       deps=frozenset([5]), always=None)
+    late = BranchFact(pc=9, block=1, cmp="eq", const=0x7788,
+                      deps=frozenset([0, 1]), always=None)
+    df = DataflowResult(branches=[late, early], reached_pcs=set())
+    assert extract_dictionary(prog, df) == [
+        b"A", (0x7788).to_bytes(2, "big"),
+        (0x7788).to_bytes(2, "little")]
+
+
+def test_extract_dictionary_run_merge_keeps_first_pc_order():
+    toks = extract_dictionary(targets.get_target("test"))
+    # first-use pc 8 carries both the single and the merged run
+    # (bytes break the tie), then the later singles in pc order
+    assert toks == [b"A", b"ABCD", b"B", b"C", b"D"]
+
+
+# -- dataflow over every CGC-grade target ----------------------------
+
+@pytest.mark.parametrize("name", sorted(targets_cgc.VM_SEEDS))
+def test_dataflow_cgc_targets_terminate_with_facts(name):
+    """Fixpoint terminates on the 100+-block targets and yields
+    non-empty branch facts, input-tainted guarded compares included
+    (the dictionary/solver signal)."""
+    prog = targets.get_target(name)
+    df = analyze_dataflow(prog)
+    assert df.branches, name
+    guarded = [f for f in df.branches
+               if f.const is not None and f.deps]
+    assert guarded, name                # magic-byte chains at least
+    assert df.reached_pcs               # fixpoint visited the program
+
+
+@pytest.mark.parametrize("name", sorted(targets_cgc.VM_SEEDS))
+def test_dataflow_cgc_no_false_statics_vs_concrete_run(name):
+    """No must-crash or dead-block false positives: concrete runs of
+    the seed AND the crash reproducer never execute a statically-dead
+    block, and whenever they enter a must-crash block the run really
+    does crash."""
+    from killerbeez_tpu import FUZZ_CRASH
+    from killerbeez_tpu.analysis.solver import concrete_run
+    prog = targets.get_target(name)
+    df = analyze_dataflow(prog)
+    seed_fn, crash_fn = targets_cgc.VM_SEEDS[name]
+    for data in (seed_fn(), crash_fn()):
+        tr = concrete_run(prog, data)
+        visited = set(tr.blocks)
+        assert not (visited & df.dead_blocks), (name, data)
+        if visited & df.must_crash_blocks:
+            assert tr.status == FUZZ_CRASH, (name, data)
+
+
 def test_dictionary_mutator_auto_tokens():
     """Acceptance: the dictionary mutator consumes the auto-extracted
     dictionary of a CGC-class target without any token file."""
@@ -444,6 +514,61 @@ def test_universe_stats_shape():
     assert s["n_modules"] == 2
     assert s["n_blocks"] == 7 and s["n_edges"] == 8
     assert json.dumps(s)                # JSON-serializable
+
+
+# -- kb-lint --sarif --------------------------------------------------
+
+def test_kb_lint_sarif_clean_targets(capsys):
+    assert lint_main(["--all", "--sarif"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "kb-lint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    # warning/info findings exist on the builtins (slot collisions,
+    # must-crash planted bugs) but nothing error-level
+    assert all(r["level"] != "error" for r in run["results"])
+    assert {r["ruleId"] for r in run["results"]} <= rule_ids
+    # built-in findings anchor on the target builder's source file
+    for r in run["results"]:
+        uri = r["locations"][0]["physicalLocation"][
+            "artifactLocation"]["uri"]
+        assert uri.endswith(("targets.py", "targets_cgc.py")), uri
+
+
+def test_kb_lint_sarif_error_levels_and_exit(tmp_path, capsys):
+    a = Assembler("bad", max_steps=64)
+    a.block()
+    a.jmp("end")
+    a.block()                           # unreachable -> error
+    a.label("end")
+    a.block()
+    a.halt(0)
+    prog = a.build()
+    path = tmp_path / "bad.npz"
+    np.savez(path, instrs=prog.instrs, name=prog.name,
+             mem_size=prog.mem_size, max_steps=prog.max_steps)
+    assert lint_main(["--program-file", str(path), "--sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    results = doc["runs"][0]["results"]
+    errs = [r for r in results if r["level"] == "error"]
+    assert errs and errs[0]["ruleId"] == "unreachable-block"
+    loc = errs[0]["locations"][0]["logicalLocations"][0]
+    assert loc["fullyQualifiedName"].startswith("bad:pc")
+    # GitHub's SARIF ingestion renders results only through a
+    # physical location — program-file findings anchor on the .npz
+    phys = errs[0]["locations"][0]["physicalLocation"]
+    assert phys["artifactLocation"]["uri"].endswith("bad.npz")
+    assert phys["region"]["startLine"] == 1
+    # one rule per check id, each with a defaultConfiguration level
+    rules = doc["runs"][0]["tool"]["driver"]["rules"]
+    assert len({r["id"] for r in rules}) == len(rules)
+    assert all("level" in r["defaultConfiguration"] for r in rules)
+
+
+def test_kb_lint_sarif_json_mutually_exclusive(capsys):
+    with pytest.raises(SystemExit):
+        lint_main(["--json", "--sarif"])
 
 
 # -- tool wiring -----------------------------------------------------

@@ -286,3 +286,73 @@ def test_radamsa_gated():
 def test_empty_seed_rejected():
     with pytest.raises(ValueError, match="empty seed"):
         mutator_factory("bit_flip", None, b"")
+
+
+# -- focused mutation (crack-stage byte masks) ------------------------
+
+def test_focus_mask_none_is_bit_exact_parity():
+    """The unfocused path is parity-pinned: installing and clearing
+    a mask (or never touching it) yields the identical candidate
+    stream — same compiled fn, same RNG draws."""
+    seed = bytes(range(16))
+    ref = mutator_factory("havoc", '{"seed": 5}', seed)
+    rb, rl = ref.mutate_batch(32)
+    for prep in (lambda m: None,
+                 lambda m: m.set_focus_mask(None),
+                 lambda m: (m.set_focus_mask([2, 3]),
+                            m.set_focus_mask(None))):
+        m = mutator_factory("havoc", '{"seed": 5}', seed)
+        prep(m)
+        b, l = m.mutate_batch(32)
+        assert np.array_equal(np.asarray(b), np.asarray(rb))
+        assert np.array_equal(np.asarray(l), np.asarray(rl))
+
+
+def test_focus_mask_anchors_havoc_edits():
+    """With a mask, primary edit positions anchor on the mask bytes:
+    masked positions mutate far more often than distant ones, and a
+    single-byte mask at 0 never touches the buffer tail (block edits
+    extend right of the anchor only up to length//2)."""
+    seed = bytes(range(16))
+    m = mutator_factory("havoc", '{"seed": 5}', seed)
+    m.set_focus_mask([3])
+    b, l = m.mutate_batch(256)
+    b, l = np.asarray(b), np.asarray(l)
+    sb = np.frombuffer(seed, np.uint8)
+    diff = (b[l == 16][:, :16] != sb[None, :]).sum(0)
+    assert diff[3] > 10 * max(int(diff[15]), 1)
+    assert diff[:3].sum() == 0          # nothing lands left of the mask
+
+
+def test_focus_mask_zzuf_strictly_masked():
+    seed = bytes(range(16))
+    m = mutator_factory("zzuf", '{"seed": 5, "ratio_bits": 0.2}', seed)
+    m.set_focus_mask([2, 7])
+    b, _ = m.mutate_batch(64)
+    diff = np.flatnonzero(
+        (np.asarray(b)[:, :16] != np.frombuffer(seed, np.uint8)).any(0))
+    assert set(diff.tolist()) <= {2, 7}
+    assert len(diff)                    # and it DOES mutate them
+
+
+def test_focus_mask_afl_tail_only():
+    """The afl mutator's deterministic stages keep their exact walk
+    under a mask; only the havoc tail focuses."""
+    seed = b"ABCDEFGH"
+    ref = mutator_factory("afl", None, seed)
+    m = mutator_factory("afl", None, seed)
+    m.set_focus_mask([1])
+    rb, _ = ref.mutate_batch(16)        # deep inside bit_flip 1
+    fb, _ = m.mutate_batch(16)
+    assert np.array_equal(np.asarray(rb), np.asarray(fb))
+
+
+def test_focus_mask_validation_and_clearing():
+    seed = bytes(range(16))
+    m = mutator_factory("havoc", None, seed)
+    m.set_focus_mask([500, -3])         # all out of the buffer
+    assert m.focus_positions is None    # empty mask clears, not pins
+    m.set_focus_mask([1, 1, 5])
+    assert m.focus_positions.tolist() == [1, 5]
+    m.set_focus_mask([])
+    assert m.focus_positions is None

@@ -23,6 +23,7 @@ fuzzing loop.
 from __future__ import annotations
 
 import base64
+import contextlib
 import time
 from typing import Any, Dict, List, Optional, Set
 
@@ -148,6 +149,15 @@ class CorpusSync:
         if not force and now - self._last_sync < self.interval_s:
             return False
         self._last_sync = now
+        # flight recorder: the round gets its own trace lane (a slow
+        # round shows up as host time stolen from the pipeline) and a
+        # sync_round event carrying the per-round deltas
+        tr = fuzzer.telemetry.trace
+        with (tr.span("sync_round", lane="sync") if tr is not None
+              else contextlib.nullcontext()):
+            return self._sync_round(fuzzer)
+
+    def _sync_round(self, fuzzer) -> bool:
         reg = fuzzer.telemetry.registry
         # push set: entries the loop admitted since the last round
         # (note_entry, O(1)) plus — ONCE, for resumed campaigns — the
@@ -209,4 +219,7 @@ class CorpusSync:
         if pulled:
             reg.count("corpus_synced_in", pulled)
         reg.gauge("corpus_arms", len(fuzzer.scheduler.arms))
+        fuzzer.telemetry.event(
+            "sync_round", pushed=int(sent), pulled=int(pulled),
+            transport_failed=bool(failed))
         return True

@@ -116,6 +116,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "details next to the repro (host targets)")
     p.add_argument("-b", "--batch-size", type=int, default=1024,
                    help="candidates per device step (batched backends)")
+    p.add_argument("--trace", type=int, nargs="?", const=65536,
+                   default=0, metavar="MAX_SPANS",
+                   help="flight recorder: record pipeline trace "
+                        "spans (one lane per in-flight batch, plus "
+                        "crack/sync/shard lanes) into a bounded ring "
+                        "and export <output>/trace.json (Chrome "
+                        "trace-event JSON — load it in Perfetto or "
+                        "chrome://tracing); the optional value caps "
+                        "the ring in events (default 65536); analyze "
+                        "with kb-timeline")
+    p.add_argument("--profile-device", type=int, nargs="?", const=8,
+                   default=0, metavar="N",
+                   help="capture a jax.profiler device trace for N "
+                        "batches (default 8 when bare) into "
+                        "<output>/device_trace, next to the host "
+                        "trace; needs the jax profiler deps, degrades "
+                        "to a warning without them")
     p.add_argument("--no-stats", action="store_true",
                    help="disable the periodic campaign stats files "
                         "(fuzzer_stats / plot_data / stats.jsonl in "
@@ -312,7 +329,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         scheduler=args.schedule,
                         corpus_dir=corpus_dir,
                         resume=args.resume,
-                        sync=sync)
+                        sync=sync,
+                        trace=args.trace,
+                        profile_device=args.profile_device)
         if args.schedule == "rare-edge":
             _wire_rare_edge_signer(fuzzer, driver)
             _wire_static_prior(fuzzer, driver)

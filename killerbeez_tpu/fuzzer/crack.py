@@ -23,6 +23,7 @@ campaigns without a cracker never see one (parity-pinned).
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -110,7 +111,16 @@ class BranchCracker:
         if s.iterations - self._progress_iter < window:
             return
         self._progress_iter = s.iterations      # re-arm
-        self.crack(fuzzer)
+        # flight recorder: the plateau itself is a campaign event —
+        # kb-timeline overlays it on the span lanes, which is exactly
+        # the artifact that exposed PR 4's warm-up-crack race
+        fuzzer.telemetry.event(
+            "plateau", execs=int(s.iterations),
+            new_paths=int(s.new_paths), window_execs=int(window))
+        tr = fuzzer.telemetry.trace
+        with (tr.span("crack", lane="crack") if tr is not None
+              else contextlib.nullcontext()):
+            self.crack(fuzzer)
 
     # -- the crack itself -----------------------------------------------
 
@@ -157,6 +167,11 @@ class BranchCracker:
                 bufs.append(bytes.fromhex(entry["input_hex"]))
         injected = self._inject(fuzzer, bufs) if bufs else 0
         if fresh or injected:
+            fuzzer.telemetry.event(
+                "crack_injection", injected=int(injected),
+                attempts=len(fresh[:self.MAX_SOLVES_PER_CRACK]),
+                frontier=len(uncovered),
+                solve_seconds=round(time.time() - t0, 3))
             INFO_MSG(
                 "crack: %d uncovered edges, %d solve attempts "
                 "(%.2fs), %d candidates injected",

@@ -164,7 +164,9 @@ class Fuzzer:
                  corpus_dir: Optional[str] = None,
                  resume: bool = False,
                  sync=None,
-                 persist_interval: float = 5.0):
+                 persist_interval: float = 5.0,
+                 trace=None,
+                 profile_device: int = 0):
         self.driver = driver
         self.output_dir = output_dir
         self.batch_size = int(batch_size)
@@ -172,18 +174,27 @@ class Fuzzer:
         self.debug_triage = debug_triage
         # observability: the registry ALWAYS runs (FuzzStats is a view
         # over it); ``telemetry=False`` (CLI --no-stats) only disables
-        # the periodic fuzzer_stats/plot_data/stats.jsonl file sink.
-        # The default follows write_findings: a no-artifacts run
-        # (bench timing loops, library callers) must not grow a new
-        # filesystem side effect; telemetry=True forces the sink on.
+        # the periodic fuzzer_stats/plot_data/stats.jsonl file sink
+        # and the campaign event log.  The default follows
+        # write_findings: a no-artifacts run (bench timing loops,
+        # library callers) must not grow a new filesystem side
+        # effect; telemetry=True forces the sink on.  ``trace`` turns
+        # the flight-recorder span ring on (True / max-events int /
+        # TraceRecorder); it is independent of the sink — trace.json
+        # exports at run end whenever findings are being written.
+        # a NON-resume campaign starts a fresh event timeline even in
+        # a reused output dir (counters restart, so inherited events
+        # would break reconciliation); --resume continues the log
         if telemetry is None:
             telemetry = Telemetry(
                 output_dir if write_findings else None,
-                interval_s=stats_interval)
+                interval_s=stats_interval, trace=trace,
+                fresh_events=not resume)
         elif telemetry is True:
-            telemetry = Telemetry(output_dir, interval_s=stats_interval)
+            telemetry = Telemetry(output_dir, interval_s=stats_interval,
+                                  trace=trace, fresh_events=not resume)
         elif telemetry is False:
-            telemetry = Telemetry(None)
+            telemetry = Telemetry(None, trace=trace)
         self.telemetry = telemetry
         # drivers time their mutate/execute phases with the loop's
         # stage timer (base.Driver.test_batch)
@@ -224,6 +235,14 @@ class Fuzzer:
         #: inputs when coverage stalls, and feeds the focused-
         #: mutation masks; installed by the CLI's --crack wiring
         self.cracker = None
+        #: opt-in jax.profiler device capture: trace this many batches
+        #: into <output>/device_trace next to the host trace.json
+        self.profile_device = int(profile_device)
+        self._prof_active = False
+        #: monotone dispatched-batch counter — the flight recorder
+        #: maps it onto PIPELINE_DEPTH trace lanes (seq % depth), one
+        #: lane per in-flight pipeline slot
+        self._batch_seq = 0
         self._persist_interval = float(persist_interval)
         self._last_persist = 0.0
         # the arm whose candidates the batch being TRIAGED came from:
@@ -402,9 +421,11 @@ class Fuzzer:
 
     # -- finding triage (reference fuzzer/main.c:393-417) ---------------
 
-    def _record(self, kind: str, buf: bytes) -> bool:
-        """Write a finding, deduped by input md5. Returns True if new."""
-        digest = md5_hex(buf)
+    def _record(self, kind: str, buf: bytes,
+                digest: Optional[str] = None) -> bool:
+        """Write a finding, deduped by input md5. Returns True if new.
+        ``digest`` skips rehashing when the caller already has it."""
+        digest = digest or md5_hex(buf)
         if digest in self._seen[kind]:
             return False
         self._seen[kind].add(digest)
@@ -471,13 +492,27 @@ class Fuzzer:
         if status == FUZZ_CRASH:
             s.crashes += 1
             s.unique_crashes += int(unique_crash)
-            self._record("crashes", buf)
-            if unique_crash and self.debug_triage:
-                self._debug_repro(buf)
+            digest = md5_hex(buf)
+            self._record("crashes", buf, digest)
+            if unique_crash:
+                # event contract (telemetry/events.py): one crash
+                # event per unique_crashes increment, raw total riding
+                # along — AFL saves crashes at the same granularity
+                self.telemetry.event(
+                    "crash", md5=digest,
+                    crashes=int(s.crashes),
+                    unique_crashes=int(s.unique_crashes))
+                if self.debug_triage:
+                    self._debug_repro(buf)
         elif status == FUZZ_HANG:
             s.hangs += 1
             s.unique_hangs += int(unique_hang)
-            self._record("hangs", buf)
+            digest = md5_hex(buf)
+            self._record("hangs", buf, digest)
+            if unique_hang:
+                self.telemetry.event(
+                    "hang", md5=digest, hangs=int(s.hangs),
+                    unique_hangs=int(s.unique_hangs))
         elif status == FUZZ_ERROR:
             s.errors += 1
             WARNING_MSG("target exec error on iteration %d", s.iterations)
@@ -485,7 +520,14 @@ class Fuzzer:
             s.new_paths += 1
             reg = self.telemetry.registry
             reg.rate("new_paths", 1)
-            recorded = self._record("new_paths", buf)
+            digest = md5_hex(buf)
+            recorded = self._record("new_paths", buf, digest)
+            # one new_path event per counter increment: the event
+            # count reconciles exactly with fuzzer_stats paths_total
+            self.telemetry.event(
+                "new_path", md5=digest,
+                edge_novel=bool(new_path == 2),
+                new_paths=int(s.new_paths))
             # corpus_seen: distinct new-path inputs ever recorded;
             # corpus_arms: entries actually in rotation (they used to
             # be conflated in one misleading corpus_size gauge)
@@ -540,8 +582,16 @@ class Fuzzer:
             else:
                 self._run_single(n_iterations)
         finally:
+            self._profile_stop()
             self.telemetry.registry.run_ended()
             self.telemetry.flush()
+            # flight recorder: the span ring exports on every run
+            # end — interrupts included, with still-open spans closed
+            # synthetically — so a killed campaign leaves a readable
+            # trace.json next to events.jsonl
+            if self.telemetry.trace is not None and self.write_findings:
+                self.telemetry.export_trace(
+                    os.path.join(self.output_dir, "trace.json"))
             # full campaign snapshot (scheduler + component states):
             # runs on clean exits AND interrupts, so --resume
             # continues exactly here
@@ -585,14 +635,22 @@ class Fuzzer:
         return rows
 
     def _triage_batch(self, out, room: int, done_through: int,
-                      packed=None, arm: Optional[list] = None
-                      ) -> None:
+                      packed=None, arm: Optional[list] = None,
+                      lane: Optional[int] = None) -> None:
         """``done_through`` is the global iteration count as of THIS
         batch — with pipelining, stats.iterations runs ahead of the
         batch being triaged, so logs must not read it.  ``packed`` is
         the device-side verdict byte built by _prefetch; when set,
         the big per-lane arrays never cross to the host unless this
-        batch actually has interesting lanes."""
+        batch actually has interesting lanes.  ``lane`` is the flight
+        recorder's pipeline slot for this batch: triage spans land on
+        the SAME lane that dispatched it, closing its in-flight span
+        (an ASYNC pair — triage can fire while unrelated sync spans
+        are open on this lane, which stack-matched B/E would cross)."""
+        tr = self.telemetry.trace
+        if tr is not None and lane is not None:
+            tr.lane = lane
+            tr.async_end("in_flight", lane)
         self._credit_arm = arm
         res = out.result
         timer = self.telemetry.timer
@@ -695,6 +753,48 @@ class Fuzzer:
                     fn()
         return packed
 
+    # -- opt-in device profiling (--profile-device) ---------------------
+
+    def _profile_start(self) -> None:
+        """Start a jax.profiler device capture into the output dir
+        (next to the host trace.json).  Degrades to a warning — like
+        every observability path."""
+        try:
+            import jax
+            d = os.path.join(self.output_dir, "device_trace")
+            ensure_dir(d)
+            jax.profiler.start_trace(d)
+            self._prof_active = True
+            INFO_MSG("device profiling: capturing %d batches to %s",
+                     self.profile_device, d)
+        except Exception as e:
+            WARNING_MSG("device profiling unavailable: %s", e)
+            self.profile_device = 0
+
+    def _profile_stop(self) -> None:
+        if not self._prof_active:
+            return
+        self._prof_active = False
+        self.profile_device = 0
+        try:
+            import jax
+            jax.profiler.stop_trace()
+            INFO_MSG("device profile written to %s",
+                     os.path.join(self.output_dir, "device_trace"))
+        except Exception as e:
+            WARNING_MSG("device profile stop failed: %s", e)
+
+    def _trace_lane(self, tr) -> int:
+        """Point the recorder at THIS batch's pipeline lane (one of
+        PIPELINE_DEPTH slots, reused round-robin — a slot is free by
+        the time it recurs because the pending deque caps at the
+        depth) and return the lane id for the pending tuple."""
+        slot = self._batch_seq % self.PIPELINE_DEPTH
+        lane = slot
+        tr.name_lane(lane, f"batch-{slot:02d}")
+        tr.lane = lane
+        return lane
+
     def _credit_period(self) -> None:
         """Close one feedback period: the scheduler decays every
         arm's stats and charges the period to the arm ENTRY that
@@ -735,6 +835,12 @@ class Fuzzer:
                 mut.iteration = it
                 self._active_entry = (None if best is None
                                       else self.scheduler.arms[best])
+                self.telemetry.event(
+                    "scheduler_pick",
+                    arm=(getattr(self._active_entry, "md5", None)
+                         or "base"),
+                    policy=self.scheduler.name,
+                    rotation=int(self.scheduler.rotations))
                 DEBUG_MSG("feedback: arm %s (%s), %d-byte input",
                           best, self.scheduler.name, len(cand))
                 return
@@ -768,6 +874,11 @@ class Fuzzer:
         from ..instrumentation.base import CompactReport
         from ..drivers.base import BatchOutcome
         b = self.batch_size
+        tr = self.telemetry.trace
+        if tr is not None:
+            # the fused dispatch is ONE device call covering k
+            # batches; its execute span lands on the first slot
+            self._trace_lane(tr)
         packed, bufs, lens, compact = \
             self.driver.test_batch_fused_multi(b, k)
         ph = _StackedRows(packed)
@@ -775,13 +886,20 @@ class Fuzzer:
         for j in range(k):
             self.stats.iterations += b
             self._fb_batches += 1
+            lane = None
+            if tr is not None:
+                lane = self._trace_lane(tr)
+                tr.async_begin("in_flight", lane,
+                               args={"batch": self._batch_seq,
+                                     "n": b})
+            self._batch_seq += 1
             out = BatchOutcome(
                 result=None, inputs=bufs[j], lengths=lens[j],
                 compact=CompactReport(idx=idxh.row(j), bufs=sbh.row(j),
                                       lens=slh.row(j),
                                       count=cnth.row(j)))
             pending.append((out, b, self.stats.iterations, ph.row(j),
-                            self._active_entry))
+                            self._active_entry, lane))
             if len(pending) >= depth:
                 self._triage_batch(*pending.popleft())
         reg = self.telemetry.registry
@@ -874,6 +992,10 @@ class Fuzzer:
                     with self.telemetry.timer("corpus_feedback"):
                         self._drain_ready(pending)
                         self.cracker.maybe_crack(self)
+                # opt-in device capture: starts at the next dispatch,
+                # stops after profile_device batches
+                if self.profile_device and not self._prof_active:
+                    self._profile_start()
                 # K-step accumulation may not stride over a feedback
                 # rotation boundary (the check above only fires at
                 # loop top): engage only when the next boundary is at
@@ -897,6 +1019,10 @@ class Fuzzer:
                     # K-step device-side accumulation: one transfer
                     # set per K batches
                     self._run_superbatch(accumulate, pending, depth)
+                    if self._prof_active:
+                        self.profile_device -= accumulate
+                        if self.profile_device <= 0:
+                            self._profile_stop()
                     continue
                 self._fb_batches += 1
                 # a smaller tail batch would change tensor shapes and
@@ -908,13 +1034,28 @@ class Fuzzer:
                 # before a smaller tail would be discarded as stale)
                 nxt = min(self._remaining(n_iterations) - room,
                           mut.remaining() - room, self.batch_size)
+                lane = None
+                tr = self.telemetry.trace
+                if tr is not None:
+                    # mutate/execute spans (driver stage timer) land
+                    # on this batch's pipeline lane
+                    lane = self._trace_lane(tr)
                 out = self.driver.test_batch(room,
                                              pad_to=self.batch_size,
                                              prefetch_next=max(nxt, 0))
                 self.stats.iterations += room
                 packed = self._prefetch(out)
+                if tr is not None:
+                    tr.async_begin("in_flight", lane,
+                                   args={"batch": self._batch_seq,
+                                         "n": room})
+                self._batch_seq += 1
+                if self._prof_active:
+                    self.profile_device -= 1
+                    if self.profile_device <= 0:
+                        self._profile_stop()
                 pending.append((out, room, self.stats.iterations,
-                                packed, self._active_entry))
+                                packed, self._active_entry, lane))
                 if len(pending) >= depth:
                     self._triage_batch(*pending.popleft())
                 reg = self.telemetry.registry
@@ -946,12 +1087,20 @@ class Fuzzer:
                     self._credit_period()
                     if self._corpus:
                         self._rotate_seed(mut)
+            # single-exec path: --profile-device counts each exec as
+            # one "batch" (the flag must not silently no-op here)
+            if self.profile_device and not self._prof_active:
+                self._profile_start()
             with self.telemetry.timer("execute"):
                 result = self.driver.test_next_input()
             if result is None:  # mutator exhausted (reference -2)
                 INFO_MSG("mutator exhausted after %d iterations",
                          self.stats.iterations)
                 break
+            if self._prof_active:
+                self.profile_device -= 1
+                if self.profile_device <= 0:
+                    self._profile_stop()
             self.stats.iterations += 1
             reg.rate("execs", 1)
             buf = self.driver.get_last_input() or b""

@@ -29,6 +29,9 @@ plus the work-queue routes that replace BOINC's scheduler
                                       content_b64, meta} -> {id, new}
     GET  /api/corpus/<campaign>?since=&exclude=
                                      -> {entries, latest}
+    POST /api/events/<campaign>      {worker, events} -> {stored}
+    GET  /api/events/<campaign>?since=<id>
+                                     -> {events, latest}
 """
 
 from __future__ import annotations
@@ -252,6 +255,29 @@ class _Handler(BaseHTTPRequestHandler):
             } for r in rows],
         })
 
+    def h_events(self, query, campaign):
+        """Fleet event-log exchange (the flight recorder's terminal
+        tier): POST stores a worker's forwarded event records (deduped
+        by the worker's own monotone seq — a retried heartbeat window
+        stores once), GET returns events newer than the caller's
+        server-id cursor, mirroring ``/api/corpus`` semantics."""
+        if self.command == "POST":
+            b = self._body()
+            n = self.db.add_campaign_events(
+                campaign, b.get("worker", "anon"),
+                b.get("events") or [])
+            self._json(201, {"stored": n})
+            return
+        since = int(query.get("since", ["0"])[0])
+        rows = self.db.get_campaign_events(campaign, since)
+        latest = max((r["id"] for r in rows),
+                     default=self.db.events_latest_id(campaign))
+        self._json(200, {
+            "campaign": campaign,
+            "latest": latest,
+            "events": rows,
+        })
+
     def h_work_claim(self, query):
         b = self._body()
         job = self.db.claim_job(b.get("worker", "anon"))
@@ -290,6 +316,8 @@ _ROUTES: Tuple = (
                                "POST": _Handler.h_stats}),
     (r"/api/corpus/([\w.-]+)", {"GET": _Handler.h_corpus,
                                 "POST": _Handler.h_corpus}),
+    (r"/api/events/([\w.-]+)", {"GET": _Handler.h_events,
+                                "POST": _Handler.h_events}),
     (r"/api/minimize", {"POST": _Handler.h_minimize}),
     (r"/api/work/claim", {"POST": _Handler.h_work_claim}),
     (r"/api/work/(\d+)/finish", {"POST": _Handler.h_work_finish}),

@@ -86,6 +86,21 @@ CREATE TABLE IF NOT EXISTS campaign_stats (
     updated REAL NOT NULL,
     UNIQUE(campaign, worker)      -- latest heartbeat per worker
 );
+CREATE TABLE IF NOT EXISTS campaign_events (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    campaign TEXT NOT NULL,
+    worker TEXT NOT NULL,
+    seq INTEGER NOT NULL,         -- the worker's events.jsonl seq
+    t REAL NOT NULL,              -- event wall time (worker clock)
+    type TEXT NOT NULL,           -- crash | hang | plateau | ...
+    payload TEXT NOT NULL,        -- full event record JSON
+    created REAL NOT NULL,
+    -- re-forwarded heartbeat windows dedup (identical record, same
+    -- t); t is IN the key because seq is only monotone per log
+    -- lifetime — a same-named worker restarting with a fresh output
+    -- dir restarts seq at 0, and its events must still store
+    UNIQUE(campaign, worker, seq, t)
+);
 CREATE TABLE IF NOT EXISTS corpus_entries (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
     campaign TEXT NOT NULL,
@@ -333,6 +348,62 @@ class ManagerDB:
         for r in rows:
             r["snapshot"] = json.loads(r["snapshot"])
         return rows
+
+    # -- campaign events (flight-recorder exchange) --------------------
+
+    def add_campaign_events(self, campaign: str, worker: str,
+                            events: List[Dict[str, Any]]) -> int:
+        """Store forwarded event records, deduped by the worker's own
+        (seq, t) — a retried heartbeat re-POSTs the same window and
+        one row survives, while a restarted worker whose fresh log
+        reuses seq 0 still stores (its wall times differ).  Returns
+        how many were stored as new."""
+        stored = 0
+        with self._lock:
+            conn = self._conn()
+            for e in events:
+                if not isinstance(e, dict) or "seq" not in e:
+                    continue
+                try:
+                    seq, t = int(e["seq"]), float(e.get("t", 0.0))
+                except (TypeError, ValueError):
+                    continue             # malformed record: skip
+                cur = conn.execute(
+                    "INSERT INTO campaign_events (campaign, worker, "
+                    "seq, t, type, payload, created) "
+                    "VALUES (?,?,?,?,?,?,?) "
+                    "ON CONFLICT(campaign, worker, seq, t) "
+                    "DO NOTHING",
+                    (str(campaign), worker, seq, t,
+                     str(e.get("type", "")), json.dumps(e),
+                     time.time()))
+                stored += cur.rowcount
+            conn.commit()
+        return stored
+
+    def get_campaign_events(self, campaign: str, since_id: int = 0
+                            ) -> List[Dict[str, Any]]:
+        """Events newer than the caller's server-id cursor (mirrors
+        the corpus exchange's since semantics)."""
+        rows = self._rows(
+            "SELECT id, worker, payload FROM campaign_events "
+            "WHERE campaign=? AND id>? ORDER BY id",
+            (str(campaign), int(since_id)))
+        out = []
+        for r in rows:
+            try:
+                event = json.loads(r["payload"])
+            except ValueError:
+                continue
+            out.append({"id": r["id"], "worker": r["worker"],
+                        "event": event})
+        return out
+
+    def events_latest_id(self, campaign: str) -> int:
+        rows = self._rows(
+            "SELECT MAX(id) AS m FROM campaign_events WHERE campaign=?",
+            (str(campaign),))
+        return int(rows[0]["m"] or 0) if rows else 0
 
     # -- corpus exchange (fleet seed sharing) --------------------------
 

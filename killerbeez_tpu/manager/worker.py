@@ -71,27 +71,79 @@ def _request_retry(url: str, payload: Optional[Dict[str, Any]] = None,
 
 # the heartbeat's stats.jsonl tailer: O(1) tail window + torn-line
 # tolerance, shared with kb-stats (telemetry.sink)
-from ..telemetry import read_latest_snapshot  # noqa: E402
+from ..telemetry import TERMINAL_EVENTS, read_latest_snapshot  # noqa: E402
 
 
 class Heartbeat(threading.Thread):
     """Progress reporter for one running job: every ``interval``
     seconds, POST the job's latest telemetry snapshot to the
-    manager's ``/api/stats/<campaign>`` (retry-with-backoff; a dead
-    manager degrades to warnings — the fuzz run itself never stops
-    for observability)."""
+    manager's ``/api/stats/<campaign>`` and forward any new TERMINAL
+    events (crash / hang / plateau) from the job's ``events.jsonl``
+    to ``/api/events/<campaign>`` (retry-with-backoff; a dead manager
+    degrades to warnings — the fuzz run itself never stops for
+    observability)."""
 
     def __init__(self, manager_url: str, campaign: str, worker: str,
                  output_dir: str, interval: float = 5.0):
         super().__init__(daemon=True)
         self.url = f"{manager_url}/api/stats/{campaign}"
+        self.events_url = f"{manager_url}/api/events/{campaign}"
         self.worker = worker
         self.output_dir = output_dir
         self.interval = interval
         self._halt = threading.Event()
+        self._ev_pos = 0                 # events.jsonl bytes consumed
         self.sent = 0
+        self.events_sent = 0
+
+    #: per-beat read window over events.jsonl: bounds memory and
+    #: request size — a long backlog (worker restart against a
+    #: resumed campaign's log) drains across beats instead of one
+    #: whole-file read + one giant POST
+    EV_WINDOW = 256 << 10
+
+    def _forward_events(self) -> int:
+        """Ship terminal events appended since the last beat.  Only
+        COMPLETE lines advance the cursor (a torn tail line stays for
+        the next beat); on transport failure the cursor rewinds — the
+        manager dedups by (worker, seq, t), so a re-send is
+        harmless."""
+        path = os.path.join(self.output_dir, "events.jsonl")
+        try:
+            with open(path, "rb") as f:
+                f.seek(self._ev_pos)
+                chunk = f.read(self.EV_WINDOW)
+        except OSError:
+            return 0
+        nl = chunk.rfind(b"\n")
+        if nl < 0:
+            return 0
+        events = []
+        for line in chunk[:nl].splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and \
+                    rec.get("type") in TERMINAL_EVENTS:
+                events.append(rec)
+        self._ev_pos += nl + 1
+        if not events:
+            return 0
+        try:
+            _request_retry(self.events_url,
+                           {"worker": self.worker, "events": events},
+                           attempts=3)
+        except Exception as e:
+            WARNING_MSG("event forward to %s failed: %s",
+                        self.events_url, e)
+            self._ev_pos -= nl + 1       # retry the window next beat
+            return 0
+        self.events_sent += len(events)
+        return len(events)
 
     def beat(self) -> bool:
+        self._forward_events()
         snap = read_latest_snapshot(self.output_dir)
         if snap is None:
             return False

@@ -157,6 +157,18 @@ class ShardedCampaignDriver(Driver):
                     timer.reg.gauge(k, v)
                 timer.reg.gauge("mesh_dp", self.mesh.shape["dp"])
                 timer.reg.gauge("mesh_mp", self.mesh.shape["mp"])
+                # flight recorder: one instant per dp shard per step
+                # on a named shard lane, so the trace shows the mesh
+                # clock advancing next to the host pipeline lanes
+                tr = getattr(timer, "tracer", None)
+                if tr is not None:
+                    per_shard = execs // self.mesh.shape["dp"]
+                    for i in range(self.mesh.shape["dp"]):
+                        tr.instant(
+                            "shard_step",
+                            lane=tr.lane_id(f"shard-{i}"),
+                            args={"step": self._host_step,
+                                  "execs": per_shard})
         if n > 0:
             self._last_batch_tail = (bufs, lens, n - 1)
             self.last_input = None

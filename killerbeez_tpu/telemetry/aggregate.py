@@ -19,10 +19,14 @@ answer:
                   is what makes the mean associative.
   * histograms  — bucket-wise counts summed
   * start_time  — min; ``t`` — max (the merged view spans the fleet)
+  * event logs  — exact-duplicate-deduped union, sorted into one
+                  fleet timeline (``merge_events``; snapshots carrying
+                  an ``events`` list fold through it automatically)
 """
 
 from __future__ import annotations
 
+import json
 from typing import Dict, List, Optional
 
 
@@ -67,6 +71,25 @@ def _merge_hists(a: Dict[str, Dict], b: Dict[str, Dict]
     return out
 
 
+def merge_events(a: List[Dict], b: Optional[List[Dict]] = None
+                 ) -> List[Dict]:
+    """Fold event-log record lists into one fleet timeline.
+
+    Exact duplicates (a record forwarded through two paths, or the
+    same heartbeat replayed) collapse to one; the result is sorted by
+    ``(t, worker, seq, canonical json)`` — a TOTAL order, which is
+    what makes the fold associative and commutative regardless of
+    which worker's log arrives first."""
+    seen: Dict[str, Dict] = {}
+    for rec in list(a) + list(b or []):
+        seen.setdefault(json.dumps(rec, sort_keys=True), rec)
+    return sorted(
+        seen.values(),
+        key=lambda r: (r.get("t", 0), str(r.get("worker", "")),
+                       r.get("seq", 0),
+                       json.dumps(r, sort_keys=True)))
+
+
 def merge_two(a: Dict[str, object], b: Dict[str, object]
               ) -> Dict[str, object]:
     ca, cb = a.get("counters", {}), b.get("counters", {})
@@ -83,6 +106,9 @@ def merge_two(a: Dict[str, object], b: Dict[str, object]
         "rates": _merge_rates(a.get("rates", {}), b.get("rates", {})),
         "hists": _merge_hists(a.get("hists", {}), b.get("hists", {})),
     }
+    ev_a, ev_b = a.get("events"), b.get("events")
+    if ev_a or ev_b:
+        out["events"] = merge_events(ev_a or [], ev_b or [])
     st = [s.get("start_time") for s in (a, b)
           if s.get("start_time") is not None]
     ts = [s.get("t") for s in (a, b) if s.get("t") is not None]

@@ -206,19 +206,30 @@ class MetricsRegistry:
 
 
 class _Span:
-    __slots__ = ("reg", "stage", "_t0")
+    __slots__ = ("reg", "stage", "tracer", "_t0", "_lane")
 
-    def __init__(self, reg: MetricsRegistry, stage: str):
+    def __init__(self, reg: MetricsRegistry, stage: str, tracer=None):
         self.reg = reg
         self.stage = stage
+        self.tracer = tracer
         self._t0 = 0.0
+        self._lane = 0
 
     def __enter__(self) -> "_Span":
+        if self.tracer is not None:
+            # pin the lane at entry: the loop may retarget the
+            # recorder's current lane mid-span (triaging another
+            # batch inside a corpus_feedback span), and a B/E pair
+            # split across lanes would corrupt both lanes' stacks
+            self._lane = self.tracer.lane
+            self.tracer.begin(self.stage)
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc) -> None:
         self.reg.observe(self.stage, time.perf_counter() - self._t0)
+        if self.tracer is not None:
+            self.tracer.end(self.stage, lane=self._lane)
 
 
 class StageTimer:
@@ -232,12 +243,19 @@ class StageTimer:
     batch (1k-64k execs) the overhead is unmeasurable.  No device
     syncs: callers time around materialization points that already
     exist.
+
+    When a ``TraceRecorder`` is attached (``--trace``), every timed
+    stage also records a begin/end span on the recorder's CURRENT
+    lane — the fuzzing loop points that lane at the in-flight batch's
+    pipeline slot, so the one instrumentation site feeds both the
+    aggregate split and the flight-recorder timeline.
     """
 
-    __slots__ = ("reg",)
+    __slots__ = ("reg", "tracer")
 
-    def __init__(self, registry: MetricsRegistry):
+    def __init__(self, registry: MetricsRegistry, tracer=None):
         self.reg = registry
+        self.tracer = tracer
 
     def __call__(self, stage: str) -> _Span:
-        return _Span(self.reg, stage)
+        return _Span(self.reg, stage, self.tracer)

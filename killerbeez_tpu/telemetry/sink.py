@@ -45,9 +45,17 @@ def write_fuzzer_stats(path: str, snap: Dict[str, object],
     """Atomic ``key = value`` dump of one snapshot (AFL layout)."""
     c = snap.get("counters", {})
     d = snap.get("derived", {})
+    g = snap.get("gauges", {})
     rows = {
         "start_time": int(snap.get("start_time", 0)),
         "last_update": int(snap.get("t", 0)),
+        # AFL's find-recency epochs (afl-whatsup reads these to call a
+        # campaign stuck/alive); sourced from the flight recorder's
+        # event timestamps, mirrored as gauges so fleet merges take
+        # the max — "most recent find anywhere" — automatically
+        "last_path": int(g.get("last_path", 0)),
+        "last_crash": int(g.get("last_crash", 0)),
+        "last_hang": int(g.get("last_hang", 0)),
         "run_time": int(snap.get("elapsed", 0)),
         "fuzzer_pid": os.getpid(),
         "execs_done": int(c.get("execs", 0)),

@@ -143,16 +143,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="refresh seconds (default 1)")
     p.add_argument("--once", action="store_true",
                    help="print one frame and exit (no ANSI)")
+    p.add_argument("--json", action="store_true",
+                   help="with --once: print the raw registry "
+                        "snapshot as JSON (CI / scripts — no "
+                        "rendering, no TTY assumptions)")
     args = p.parse_args(argv)
     if args.manager and not args.campaign:
         print("error: --manager needs --campaign", file=sys.stderr)
+        return 2
+    if args.json and not args.once:
+        print("error: --json needs --once", file=sys.stderr)
         return 2
     if args.once:
         snap = _frame(args)
         if snap is None:
             print("no stats yet", file=sys.stderr)
             return 1
-        print(render(snap))
+        print(json.dumps(snap) if args.json else render(snap))
         return 0
     try:
         while True:

@@ -1,0 +1,445 @@
+"""kb-timeline — flight-recorder analysis (critical path, stage
+occupancy, pipeline bubbles).
+
+Loads a campaign's ``trace.json`` (the ``--trace`` span ring, Chrome
+trace-event JSON) plus ``events.jsonl`` and ``fuzzer_stats`` when
+present, and answers the questions the aggregate stats can't: where
+did wall-clock go per stage, how full was the pipeline, WHERE are the
+bubbles (device idle while the host mutates/triages), and do the
+recorded events reconcile with the counters.  This is the artifact
+that shows a dispatch-vs-triage race in one glance instead of a
+debugging session.
+
+    kb-timeline output/                 # human report + ANSI lane view
+    kb-timeline output/ --json          # machine report
+    kb-timeline output/trace.json --width 100 --bubble-ms 5
+
+Not to be confused with ``kb-trace`` (the host-tier ptrace edge
+harvester, ``tools/tracer.py`` / ``native/``): kb-trace records what a
+HOST TARGET executed; kb-timeline analyzes what the TPU-tier fuzzing
+PIPELINE did.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..telemetry.events import read_events
+from ..telemetry.sink import parse_fuzzer_stats
+from ..telemetry.trace import load_chrome_trace
+
+#: stages that are HOST attention (bubble attribution candidates);
+#: "execute" is the device dispatch, "in_flight" is occupancy
+HOST_STAGES = ("mutate", "host_transfer", "triage", "corpus_feedback",
+               "fs_write", "crack", "sync_round")
+
+#: lane-view glyph per span name (top-of-stack wins)
+GLYPHS = {"mutate": "m", "execute": "x", "host_transfer": "h",
+          "triage": "t", "corpus_feedback": "c", "fs_write": "w",
+          "in_flight": ".", "crack": "K", "sync_round": "s"}
+
+
+# -- span reconstruction ------------------------------------------------
+
+
+def spans_from_chrome(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Pair B/E events (per tid, stack discipline) and async b/e
+    pairs (matched by tid+name+id — the in-flight windows, which
+    cross sync span boundaries) back into ``{name, tid, t0, t1}``
+    spans (microseconds, trace-relative)."""
+    spans: List[Dict[str, Any]] = []
+    stacks: Dict[int, List[Dict[str, Any]]] = {}
+    open_async: Dict[tuple, Dict[str, Any]] = {}
+    for ev in doc.get("traceEvents", []):
+        ph = ev.get("ph")
+        tid = int(ev.get("tid", 0))
+        if ph == "B":
+            stacks.setdefault(tid, []).append(
+                {"name": ev.get("name", "?"), "tid": tid,
+                 "t0": float(ev.get("ts", 0.0)), "t1": None,
+                 "args": ev.get("args")})
+        elif ph == "E":
+            stack = stacks.get(tid)
+            if stack:
+                s = stack.pop()
+                s["t1"] = float(ev.get("ts", 0.0))
+                spans.append(s)
+        elif ph == "b":
+            open_async[(tid, ev.get("name"), ev.get("id"))] = {
+                "name": ev.get("name", "?"), "tid": tid,
+                "t0": float(ev.get("ts", 0.0)), "t1": None,
+                "args": ev.get("args")}
+        elif ph == "e":
+            s = open_async.pop(
+                (tid, ev.get("name"), ev.get("id")), None)
+            if s is not None:
+                s["t1"] = float(ev.get("ts", 0.0))
+                spans.append(s)
+    spans.sort(key=lambda s: (s["t0"], s["t1"]))
+    return spans
+
+
+def lane_names_from_chrome(doc: Dict[str, Any]) -> Dict[int, str]:
+    names: Dict[int, str] = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            names[int(ev.get("tid", 0))] = \
+                (ev.get("args") or {}).get("name", "")
+    return names
+
+
+def instants_from_chrome(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    return [{"name": ev.get("name", "?"), "tid": int(ev.get("tid", 0)),
+             "ts": float(ev.get("ts", 0.0)), "args": ev.get("args")}
+            for ev in doc.get("traceEvents", []) if ev.get("ph") == "i"]
+
+
+# -- interval math ------------------------------------------------------
+
+
+def _union_len(ivals: List[Tuple[float, float]]) -> float:
+    """Total length covered by a set of (t0, t1) intervals."""
+    total = 0.0
+    end = float("-inf")
+    for t0, t1 in sorted(ivals):
+        if t1 <= end:
+            continue
+        total += t1 - max(t0, end)
+        end = t1
+    return total
+
+
+def stage_report(spans: List[Dict[str, Any]]
+                 ) -> Tuple[Dict[str, Dict[str, float]], float]:
+    """Per-stage {total_us, count, occupancy} plus the trace window
+    length.  ``total_us`` sums span durations (nesting double-counts,
+    matching the registry's attention split); ``occupancy`` is the
+    fraction of the window with >= 1 span of that stage open."""
+    if not spans:
+        return {}, 0.0
+    w0 = min(s["t0"] for s in spans)
+    w1 = max(s["t1"] for s in spans)
+    window = max(w1 - w0, 1e-9)
+    by: Dict[str, List[Tuple[float, float]]] = {}
+    out: Dict[str, Dict[str, float]] = {}
+    for s in spans:
+        by.setdefault(s["name"], []).append((s["t0"], s["t1"]))
+    for name, ivals in by.items():
+        out[name] = {
+            "total_us": sum(t1 - t0 for t0, t1 in ivals),
+            "count": len(ivals),
+            "occupancy": _union_len(ivals) / window,
+        }
+    return out, window
+
+
+def detect_bubbles(spans: List[Dict[str, Any]],
+                   threshold_us: Optional[float] = None
+                   ) -> Tuple[List[Dict[str, Any]], float]:
+    """Pipeline-bubble detection: a bubble is a gap between
+    consecutive device dispatches (``execute`` spans, merged across
+    lanes) during which HOST stages were busy — the device sat idle
+    while the host mutated/triaged/synced.  Returns (bubbles,
+    threshold_used).  The auto threshold is 4x the median
+    dispatch-to-dispatch gap (floored at 200us): a steady pipeline's
+    natural cadence never alarms, a stall several times that does."""
+    ex = sorted([(s["t0"], s["t1"]) for s in spans
+                 if s["name"] == "execute"])
+    if len(ex) < 3:
+        return [], 0.0
+    merged: List[Tuple[float, float]] = []
+    for t0, t1 in ex:
+        if merged and t0 <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], t1))
+        else:
+            merged.append((t0, t1))
+    gaps = [(a1, b0) for (a0, a1), (b0, b1)
+            in zip(merged, merged[1:]) if b0 > a1]
+    if not gaps:
+        return [], 0.0
+    if threshold_us is None:
+        sizes = sorted(b - a for a, b in gaps)
+        median = sizes[len(sizes) // 2]
+        threshold_us = max(4.0 * median, 200.0)
+    host = [s for s in spans if s["name"] in HOST_STAGES]
+    bubbles: List[Dict[str, Any]] = []
+    for g0, g1 in gaps:
+        dur = g1 - g0
+        if dur < threshold_us:
+            continue
+        # attribute to the host stage holding the most of the gap
+        overlap: Dict[str, float] = {}
+        for s in host:
+            o = min(s["t1"], g1) - max(s["t0"], g0)
+            if o > 0:
+                overlap[s["name"]] = overlap.get(s["name"], 0.0) + o
+        if not overlap:
+            continue                     # idle-idle: not a host bubble
+        dominant = max(overlap.items(), key=lambda kv: kv[1])
+        bubbles.append({
+            "t0_us": g0, "duration_us": dur,
+            "dominant_stage": dominant[0],
+            "dominant_us": dominant[1],
+            "host_overlap_us": sum(overlap.values()),
+        })
+    return bubbles, threshold_us
+
+
+# -- events -------------------------------------------------------------
+
+
+def event_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    counts: Dict[str, int] = {}
+    last: Dict[str, float] = {}
+    for e in events:
+        t = e.get("type", "?")
+        counts[t] = counts.get(t, 0) + 1
+        last[t] = max(last.get(t, 0.0), float(e.get("t", 0.0)))
+    return {"counts": counts, "last": last, "total": len(events)}
+
+
+def reconcile(events: List[Dict[str, Any]],
+              stats: Dict[str, str]) -> Dict[str, Any]:
+    """Check the event-log contract against fuzzer_stats: one
+    new_path event per paths_total, one crash per unique_crashes, one
+    hang per unique_hangs."""
+    counts: Dict[str, int] = {}
+    for e in events:
+        t = e.get("type", "?")
+        counts[t] = counts.get(t, 0) + 1
+    out: Dict[str, Any] = {}
+    for etype, key in (("new_path", "paths_total"),
+                       ("crash", "unique_crashes"),
+                       ("hang", "unique_hangs")):
+        want = int(stats.get(key, 0))
+        got = counts.get(etype, 0)
+        out[etype] = {"events": got, key: want, "ok": got == want}
+    out["ok"] = all(v["ok"] for v in out.values()
+                    if isinstance(v, dict))
+    return out
+
+
+# -- rendering ----------------------------------------------------------
+
+
+def _fmt_us(us: float) -> str:
+    if us >= 1e6:
+        return f"{us / 1e6:.2f}s"
+    if us >= 1e3:
+        return f"{us / 1e3:.2f}ms"
+    return f"{us:.0f}us"
+
+
+def lane_view(spans: List[Dict[str, Any]],
+              instants: List[Dict[str, Any]],
+              lane_names: Dict[int, str], window: float,
+              width: int = 72) -> List[str]:
+    """One text row per lane, a glyph per time bucket (top-of-stack
+    span wins; later spans overwrite earlier in the same bucket), and
+    an events row overlaying instant markers."""
+    if not spans or window <= 0:
+        return []
+    t0 = min(s["t0"] for s in spans)
+    scale = width / window
+    rows: List[str] = []
+    tids = sorted({s["tid"] for s in spans})
+    label_w = max([len(lane_names.get(t, f"lane-{t}")) for t in tids]
+                  + [6])
+    for tid in tids:
+        cells = [" "] * width
+        for s in sorted((s for s in spans if s["tid"] == tid),
+                        key=lambda s: (s["t1"] - s["t0"]),
+                        reverse=True):
+            a = int((s["t0"] - t0) * scale)
+            b = int((s["t1"] - t0) * scale)
+            g = GLYPHS.get(s["name"], "#")
+            for i in range(max(a, 0), min(b + 1, width)):
+                cells[i] = g
+        name = lane_names.get(tid, f"lane-{tid}")
+        rows.append(f"  {name:<{label_w}} |{''.join(cells)}|")
+    if instants:
+        cells = [" "] * width
+        for ev in instants:
+            i = int((ev["ts"] - t0) * scale)
+            if 0 <= i < width:
+                cells[i] = "!"
+        rows.append(f"  {'events':<{label_w}} |{''.join(cells)}|")
+    rows.append(f"  {'':<{label_w}} "
+                f"|0{' ' * (width - 2)}|  ({_fmt_us(window)} window)")
+    return rows
+
+
+def render(report: Dict[str, Any], lanes: List[str]) -> str:
+    lines: List[str] = []
+    head = "kb-timeline — flight-recorder analysis"
+    lines.append(head)
+    lines.append("=" * len(head))
+    window = report.get("window_us", 0.0)
+    lines.append(f"  trace window : {_fmt_us(window)}  "
+                 f"({report.get('span_count', 0)} spans, "
+                 f"{report.get('lane_count', 0)} lanes)")
+    stages = report.get("stages", {})
+    if stages:
+        lines.append("  per-stage wall clock (host attention):")
+        acc = sum(v["total_us"] for k, v in stages.items()
+                  if k != "in_flight") or 1.0
+        for name, v in sorted(stages.items(),
+                              key=lambda kv: -kv[1]["total_us"]):
+            if name == "in_flight":
+                continue
+            frac = v["total_us"] / acc
+            lines.append(
+                f"    {name:<15} {_fmt_us(v['total_us']):>10}  "
+                f"{frac:6.1%}  ({int(v['count'])} spans, "
+                f"{v['occupancy']:.1%} occupancy)")
+        cp = report.get("critical_path")
+        if cp:
+            lines.append(f"  critical path : {cp} "
+                         f"(highest occupancy outside the device)")
+        inf = stages.get("in_flight")
+        if inf:
+            lines.append(
+                f"  pipeline      : {inf['occupancy']:.1%} of the "
+                f"window with batches in flight "
+                f"({int(inf['count'])} batches)")
+    bubbles = report.get("bubbles", [])
+    lines.append(
+        f"  bubbles       : {len(bubbles)} detected, "
+        f"{_fmt_us(report.get('bubble_total_us', 0.0))} total "
+        f"(threshold {_fmt_us(report.get('bubble_threshold_us', 0.0))})")
+    for b in bubbles[:8]:
+        lines.append(
+            f"    @{_fmt_us(b['t0_us'])}: device idle "
+            f"{_fmt_us(b['duration_us'])} while host ran "
+            f"{b['dominant_stage']} ({_fmt_us(b['dominant_us'])})")
+    if len(bubbles) > 8:
+        lines.append(f"    ... {len(bubbles) - 8} more")
+    ev = report.get("events")
+    if ev:
+        pairs = ", ".join(f"{k} x{v}" for k, v in
+                          sorted(ev["counts"].items()))
+        lines.append(f"  events        : {pairs}")
+    rec = report.get("reconcile")
+    if rec:
+        ok = "OK" if rec.get("ok") else "MISMATCH"
+        lines.append(
+            f"  reconcile     : {ok} (new_path "
+            f"{rec['new_path']['events']}/"
+            f"{rec['new_path']['paths_total']}, crash "
+            f"{rec['crash']['events']}/"
+            f"{rec['crash']['unique_crashes']}, hang "
+            f"{rec['hang']['events']}/"
+            f"{rec['hang']['unique_hangs']} vs fuzzer_stats)")
+    if lanes:
+        glyphs = "  ".join(f"{g}={n}" for n, g in GLYPHS.items())
+        lines.append("  lane view (" + glyphs + "):")
+        lines.extend(lanes)
+    return "\n".join(lines)
+
+
+# -- entry --------------------------------------------------------------
+
+
+def analyze(out_dir: str, trace_path: Optional[str] = None,
+            bubble_us: Optional[float] = None
+            ) -> Tuple[Optional[Dict[str, Any]], List[Dict], Dict]:
+    """Returns (chrome doc or None, events list, fuzzer_stats dict)."""
+    if trace_path is None:
+        trace_path = os.path.join(out_dir, "trace.json")
+    doc = load_chrome_trace(trace_path)
+    events = list(read_events(os.path.join(out_dir, "events.jsonl")))
+    stats: Dict[str, str] = {}
+    try:
+        stats = parse_fuzzer_stats(os.path.join(out_dir,
+                                                "fuzzer_stats"))
+    except OSError:
+        pass
+    return doc, events, stats
+
+
+def build_report(doc: Optional[Dict[str, Any]],
+                 events: List[Dict[str, Any]],
+                 stats: Dict[str, str],
+                 bubble_us: Optional[float] = None) -> Dict[str, Any]:
+    report: Dict[str, Any] = {}
+    if doc is not None:
+        spans = spans_from_chrome(doc)
+        stages, window = stage_report(spans)
+        bubbles, thresh = detect_bubbles(spans, bubble_us)
+        host = {k: v for k, v in stages.items()
+                if k not in ("execute", "in_flight")}
+        report.update({
+            "window_us": window,
+            "span_count": len(spans),
+            "lane_count": len({s["tid"] for s in spans}),
+            "stages": stages,
+            "critical_path": (max(host.items(),
+                                  key=lambda kv: kv[1]["occupancy"])[0]
+                              if host else None),
+            "bubbles": bubbles,
+            "bubble_total_us": sum(b["duration_us"] for b in bubbles),
+            "bubble_threshold_us": thresh,
+            "trace_meta": doc.get("otherData", {}),
+        })
+    if events:
+        report["events"] = event_summary(events)
+    if events and stats:
+        report["reconcile"] = reconcile(events, stats)
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="kb-timeline",
+        description="flight-recorder analysis: per-stage wall clock, "
+                    "pipeline occupancy, bubble detection and event "
+                    "overlay from a --trace campaign's trace.json + "
+                    "events.jsonl")
+    p.add_argument("path", nargs="?", default="output",
+                   help="campaign output dir, or a trace.json path "
+                        "(default ./output)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report (scripts/CI)")
+    p.add_argument("--width", type=int, default=72,
+                   help="lane-view width in columns (default 72)")
+    p.add_argument("--bubble-ms", type=float, default=None,
+                   help="explicit bubble threshold in ms (default: "
+                        "4x the median dispatch gap)")
+    p.add_argument("--no-lanes", action="store_true",
+                   help="skip the ANSI lane view")
+    args = p.parse_args(argv)
+
+    path = args.path
+    if os.path.isfile(path):
+        out_dir, trace_path = os.path.dirname(path) or ".", path
+    else:
+        out_dir, trace_path = path, None
+    doc, events, stats = analyze(out_dir, trace_path)
+    if doc is None and not events:
+        print("error: no trace.json or events.jsonl under "
+              f"{args.path} (run the fuzzer with --trace)",
+              file=sys.stderr)
+        return 1
+    bubble_us = (args.bubble_ms * 1e3 if args.bubble_ms is not None
+                 else None)
+    report = build_report(doc, events, stats, bubble_us)
+    if args.json:
+        print(json.dumps(report, indent=2))
+        return 0
+    lanes: List[str] = []
+    if doc is not None and not args.no_lanes:
+        spans = spans_from_chrome(doc)
+        lanes = lane_view(spans, instants_from_chrome(doc),
+                          lane_names_from_chrome(doc),
+                          report.get("window_us", 0.0),
+                          width=args.width)
+    print(render(report, lanes))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

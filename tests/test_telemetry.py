@@ -1,8 +1,10 @@
 """Telemetry subsystem tests — registry math (EMA decay, histogram
 buckets), merge algebra (associative + commutative under random
 snapshots), atomic fuzzer_stats writes (a reader never sees a torn
-file), sink file formats, worker heartbeat retry/backoff, and the
-kb-stats renderer."""
+file), sink file formats, worker heartbeat retry/backoff, the
+kb-stats renderer, and the flight recorder (span ring + Chrome
+export, event log schema/seq contract, kb-timeline analysis, the
+manager /api/events exchange)."""
 
 import json
 import os
@@ -12,8 +14,9 @@ import threading
 import pytest
 
 from killerbeez_tpu.telemetry import (
-    MetricsRegistry, StageTimer, Telemetry, merge, merge_two,
-    parse_fuzzer_stats,
+    EventLog, MetricsRegistry, StageTimer, Telemetry, TraceRecorder,
+    last_event_seq, merge, merge_events, merge_two,
+    parse_fuzzer_stats, read_events,
 )
 from killerbeez_tpu.telemetry.metrics import (
     EmaRate, HIST_BUCKETS, Histogram,
@@ -436,6 +439,552 @@ def test_stats_tui_reads_manager_merge(tmp_path):
         s.stop()
     assert merged["counters"]["execs"] == 150
     assert merged["_n_workers"] == 2
+
+
+# -- flight recorder: span ring ----------------------------------------
+
+
+def _balance_check(doc):
+    """Every tid's B/E stream must stay balanced and end at zero;
+    every async b must have exactly one matching e (by tid+name+id)."""
+    depth = {}
+    a_open = set()
+    for ev in doc["traceEvents"]:
+        tid = ev["tid"]
+        if ev["ph"] == "B":
+            depth[tid] = depth.get(tid, 0) + 1
+        elif ev["ph"] == "E":
+            depth[tid] = depth.get(tid, 0) - 1
+            assert depth[tid] >= 0, f"E without B on tid {tid}"
+        elif ev["ph"] == "b":
+            key = (tid, ev["name"], ev["id"])
+            assert key not in a_open, f"double async begin {key}"
+            a_open.add(key)
+        elif ev["ph"] == "e":
+            key = (tid, ev["name"], ev["id"])
+            assert key in a_open, f"e without b {key}"
+            a_open.remove(key)
+    assert all(v == 0 for v in depth.values()), depth
+    assert not a_open, a_open
+
+
+def test_trace_recorder_balanced_export_mid_span(tmp_path):
+    """Chrome export stays balanced under a forced mid-span shutdown:
+    open spans get synthetic closes, the JSON loads, timestamps are
+    relative microseconds."""
+    tr = TraceRecorder(max_events=256)
+    tr.begin("execute", args={"batch": 0})
+    tr.end("execute")
+    tr.begin("triage")
+    tr.begin("fs_write")                 # nested, BOTH left open:
+    doc = tr.to_chrome()                 # the mid-span "shutdown"
+    _balance_check(doc)
+    names = [e["name"] for e in doc["traceEvents"]
+             if e["ph"] in "BE"]
+    assert names.count("triage") == 2 and names.count("fs_write") == 2
+    # atomic file export round-trips
+    p = str(tmp_path / "trace.json")
+    assert tr.export(p)
+    assert not os.path.exists(p + ".tmp")
+    doc2 = json.load(open(p))
+    _balance_check(doc2)
+    assert doc2["otherData"]["wall_t0"] > 0
+
+
+def test_trace_recorder_ring_wrap_drops_orphan_ends():
+    """When the ring overwrites old events, an E whose B wrapped away
+    must be dropped — the export is still balanced."""
+    tr = TraceRecorder(max_events=8)
+    for i in range(50):                  # 100 events through an
+        tr.begin("execute")              # 8-slot ring
+        tr.end("execute")
+    tr.begin("triage")                   # guarantee a B survives
+    doc = tr.to_chrome()
+    _balance_check(doc)
+    assert tr.dropped == 50 * 2 + 1 - 8
+    assert doc["otherData"]["events_dropped"] == tr.dropped
+
+
+def test_trace_recorder_lanes_and_span_cm():
+    tr = TraceRecorder()
+    tr.lane = 3
+    tr.name_lane(3, "batch-03")
+    tr.begin("execute")
+    tr.end("execute")
+    with tr.span("crack", lane="crack", args={"edges": 2}):
+        tr.instant("plateau")
+    assert tr.lane == 3                  # span() restored the lane
+    doc = tr.to_chrome()
+    _balance_check(doc)
+    crack_tid = tr.lane_id("crack")
+    by_tid = {}
+    for ev in doc["traceEvents"]:
+        by_tid.setdefault(ev["tid"], []).append(ev)
+    assert any(e["ph"] == "B" and e["name"] == "crack"
+               for e in by_tid[crack_tid])
+    assert any(e["ph"] == "i" and e["name"] == "plateau"
+               for e in by_tid[crack_tid])
+    # thread_name metadata labels both lanes
+    meta = {e["tid"]: e["args"]["name"]
+            for e in doc["traceEvents"] if e["ph"] == "M"}
+    assert meta[3] == "batch-03" and meta[crack_tid] == "crack"
+
+
+def test_stage_timer_feeds_both_registry_and_tracer():
+    reg = MetricsRegistry()
+    tr = TraceRecorder()
+    t = StageTimer(reg, tr)
+    with t("triage"):
+        with t("fs_write"):
+            pass
+    assert reg.hists["triage"].total == 1
+    doc = tr.to_chrome()
+    _balance_check(doc)
+    assert [e["name"] for e in doc["traceEvents"]
+            if e["ph"] == "B"] == ["triage", "fs_write"]
+    # a span pins its lane at entry: retargeting the recorder's
+    # current lane mid-span (the loop triages other batches inside
+    # corpus_feedback spans) must not split the B/E pair across lanes
+    tr.lane = 1
+    with t("corpus_feedback"):
+        tr.lane = 2
+        tr.begin("in_flight")
+        tr.end("in_flight")
+    doc = tr.to_chrome()
+    _balance_check(doc)
+    cf = [e for e in doc["traceEvents"]
+          if e["name"] == "corpus_feedback"]
+    assert [e["tid"] for e in cf] == [1, 1]
+
+
+def test_async_in_flight_does_not_cross_sync_spans():
+    """The regression the async pair exists for: a batch's in-flight
+    window closes while an unrelated sync span is open on the SAME
+    lane (pipeline ramp-up + _drain_ready inside corpus_feedback).
+    Stack-matched B/E would cross the pairs; async b/e must not."""
+    from killerbeez_tpu.tools import timeline_tool as tt
+    tr = TraceRecorder()
+    tr.lane = 0
+    tr.async_begin("in_flight", 0, args={"batch": 0})
+    tr.begin("corpus_feedback")          # sync span opens...
+    tr.async_end("in_flight", 0)         # ...in-flight closes inside
+    tr.end("corpus_feedback")
+    # mid-span shutdown with an open async pair stays balanced too
+    tr.async_begin("in_flight", 1)
+    doc = tr.to_chrome()
+    _balance_check(doc)
+    spans = tt.spans_from_chrome(doc)
+    by = {s["name"]: s for s in spans}
+    assert set(by) == {"in_flight", "corpus_feedback"}
+    # each span got its OWN begin/end (no swapped durations):
+    # in_flight opened first and closed before corpus_feedback did
+    inf = [s for s in spans if s["name"] == "in_flight"
+           and s["args"]]
+    cf = by["corpus_feedback"]
+    assert inf[0]["t0"] <= cf["t0"] and inf[0]["t1"] <= cf["t1"]
+
+
+# -- flight recorder: event log ----------------------------------------
+
+
+def test_event_log_roundtrip_and_resume_seq(tmp_path):
+    """Schema round-trip + seq monotonicity across a reopen (the
+    --resume contract) + torn-tail tolerance."""
+    d = str(tmp_path)
+    log = EventLog(d)
+    log.emit("new_path", md5="a" * 32, new_paths=1)
+    log.emit("crash", md5="b" * 32, crashes=1, unique_crashes=1)
+    log.close()
+    recs = list(read_events(d))
+    assert [r["seq"] for r in recs] == [0, 1]
+    assert all(r["v"] == 1 and r["t"] > 0 for r in recs)
+    assert recs[0]["type"] == "new_path" and recs[0]["md5"] == "a" * 32
+    # torn tail: a record cut mid-append is skipped, not fatal
+    with open(os.path.join(d, "events.jsonl"), "a") as f:
+        f.write('{"v": 1, "seq": 2, "t": 1.0, "ty')
+    assert [r["seq"] for r in recs] == \
+        [r["seq"] for r in read_events(d)]
+    assert last_event_seq(d) == 1
+    # a reopened log (resume) continues the monotone seq
+    log2 = EventLog(d)
+    assert log2.next_seq == 2
+    log2.emit("plateau", execs=100)
+    log2.close()
+    seqs = [r["seq"] for r in read_events(d)]
+    assert seqs == sorted(seqs) == [0, 1, 2]
+    # cursor reads skip already-seen records
+    assert [r["seq"] for r in read_events(d, since_seq=1)] == [2]
+    assert [r["type"] for r in read_events(d, types=["crash"])] \
+        == ["crash"]
+    # a parseable line with a non-numeric seq (foreign writer /
+    # corruption) is skipped, not fatal
+    with open(os.path.join(d, "events.jsonl"), "a") as f:
+        f.write('{"v": 1, "seq": null, "t": 1.0, "type": "crash"}\n')
+    assert [r["seq"] for r in read_events(d)] == [0, 1, 2]
+
+
+def test_event_log_and_trace_absorb_non_json_fields(tmp_path):
+    """Observability must never kill the campaign: a numpy scalar or
+    bytes field neither raises from emit() nor from the trace export
+    (it stringifies)."""
+    import numpy as np
+    log = EventLog(str(tmp_path))
+    log.emit("new_path", count=np.int64(5), raw=b"\x01")
+    log.close()
+    (rec,) = read_events(str(tmp_path))
+    assert rec["count"] == "5"           # stringified, not lost
+    tr = TraceRecorder()
+    tr.instant("plateau", args={"execs": np.int64(7)})
+    assert tr.export(str(tmp_path / "t.json"))
+    json.load(open(tmp_path / "t.json"))
+
+
+def test_event_log_write_failure_degrades(tmp_path, monkeypatch):
+    log = EventLog(str(tmp_path))
+    monkeypatch.setattr(
+        "builtins.open",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")))
+    rec = log.emit("new_path", md5="x")   # warns, never raises
+    assert rec["type"] == "new_path"      # in-process view intact
+    assert log.last_times["new_path"] == rec["t"]
+
+
+def _rand_events(rng, worker):
+    return [{"v": 1, "seq": i, "t": rng.uniform(0, 100),
+             "worker": worker,
+             "type": rng.choice(["crash", "hang", "plateau"])}
+            for i in range(rng.randrange(0, 6))]
+
+
+def test_merge_events_associative_commutative_deduped():
+    rng = random.Random(0xf11e)
+    for _ in range(30):
+        a, b, c = (_rand_events(rng, w) for w in "abc")
+        assert merge_events(a, b) == merge_events(b, a)
+        assert merge_events(merge_events(a, b), c) == \
+            merge_events(a, merge_events(b, c))
+        # exact duplicates (a replayed heartbeat window) collapse
+        assert merge_events(a, a) == merge_events(a, [])
+    # snapshots carrying event lists fold through merge_two/merge
+    sa = {"counters": {"execs": 1}, "events": [
+        {"v": 1, "seq": 0, "t": 2.0, "worker": "w1", "type": "crash"}]}
+    sb = {"counters": {"execs": 2}, "events": [
+        {"v": 1, "seq": 0, "t": 1.0, "worker": "w2", "type": "hang"}]}
+    m = merge([sa, sb])
+    assert m["counters"]["execs"] == 3
+    assert [e["worker"] for e in m["events"]] == ["w2", "w1"]  # by t
+    assert merge([sa, sb])["events"] == merge([sb, sa])["events"]
+
+
+def test_fuzzer_stats_carries_last_find_epochs(tmp_path):
+    """AFL's last_path/last_crash/last_hang fields, sourced from the
+    find-recency gauges the event tier stamps."""
+    snap = _snap(100)
+    snap["gauges"] = {"last_path": 1234.9, "last_crash": 99.2}
+    path = str(tmp_path / "fuzzer_stats")
+    write_fuzzer_stats(path, snap)
+    fs = parse_fuzzer_stats(path)
+    assert fs["last_path"] == "1234"
+    assert fs["last_crash"] == "99"
+    assert fs["last_hang"] == "0"        # never seen: AFL's 0
+
+
+def test_telemetry_event_stamps_gauges_and_log(tmp_path):
+    tl = Telemetry(str(tmp_path / "o"), interval_s=0.0, trace=True)
+    tl.event("new_path", md5="a" * 32, new_paths=1)
+    tl.event("crash", md5="b" * 32, crashes=1, unique_crashes=1)
+    tl.event("sync_round", pushed=1, pulled=0)
+    assert tl.registry.gauges["last_path"] > 0
+    assert tl.registry.gauges["last_crash"] > 0
+    assert "last_hang" not in tl.registry.gauges
+    types = [r["type"] for r in read_events(str(tmp_path / "o"))]
+    assert types == ["new_path", "crash", "sync_round"]
+    # events also drop instant marks on the span timeline
+    marks = [e for e in tl.trace.to_chrome()["traceEvents"]
+             if e["ph"] == "i"]
+    assert [m["name"] for m in marks] == types
+    # file-less telemetry: gauges still stamp, nothing is written
+    tl2 = Telemetry(None)
+    tl2.event("new_path", md5="c" * 32)
+    assert tl2.registry.gauges["last_path"] > 0
+    assert tl2.events is None
+
+
+# -- kb-timeline --------------------------------------------------------
+
+
+def _chrome_doc(spans, instants=()):
+    """Synthetic Chrome trace from (name, tid, t0_us, t1_us) spans."""
+    evs = []
+    for name, tid, t0, t1 in spans:
+        evs.append({"ph": "B", "name": name, "pid": 1, "tid": tid,
+                    "ts": t0})
+        evs.append({"ph": "E", "name": name, "pid": 1, "tid": tid,
+                    "ts": t1})
+    for name, tid, ts in instants:
+        evs.append({"ph": "i", "name": name, "pid": 1, "tid": tid,
+                    "ts": ts, "s": "t"})
+    evs.sort(key=lambda e: e["ts"])
+    return {"traceEvents": evs, "displayTimeUnit": "ms",
+            "otherData": {"wall_t0": 1000.0}}
+
+
+def test_timeline_detects_host_bound_bubble(tmp_path):
+    """A deliberately host-bound timeline: steady 1ms dispatch cadence,
+    then a 40ms gap filled by triage — exactly one bubble, attributed
+    to triage."""
+    from killerbeez_tpu.tools import timeline_tool as tt
+    spans = []
+    t = 0.0
+    for i in range(10):                  # steady cadence: 1ms period
+        spans.append(("execute", i % 4, t, t + 500.0))
+        t += 1000.0
+    gap_start = t - 500.0                # device idle from last end
+    spans.append(("triage", 0, gap_start + 100.0,
+                  gap_start + 39000.0))  # host busy through the gap
+    t = gap_start + 40000.0
+    spans.append(("execute", 0, t, t + 500.0))
+    doc = _chrome_doc(spans)
+    parsed = tt.spans_from_chrome(doc)
+    assert len(parsed) == len(spans)
+    bubbles, thresh = tt.detect_bubbles(parsed)
+    assert len(bubbles) == 1
+    assert bubbles[0]["dominant_stage"] == "triage"
+    assert bubbles[0]["duration_us"] == pytest.approx(40000.0)
+    assert thresh < 40000.0
+    # steady cadence alone: no bubbles
+    steady = tt.spans_from_chrome(_chrome_doc(
+        [("execute", 0, i * 1000.0, i * 1000.0 + 500.0)
+         for i in range(10)]))
+    assert tt.detect_bubbles(steady)[0] == []
+    # an idle gap with NO host span active is not a host bubble
+    no_host = tt.spans_from_chrome(_chrome_doc(
+        [("execute", 0, i * 1000.0, i * 1000.0 + 500.0)
+         for i in range(10)]
+        + [("execute", 0, 50000.0, 50500.0)]))
+    assert tt.detect_bubbles(no_host)[0] == []
+
+
+def test_timeline_report_and_cli(tmp_path, capsys):
+    from killerbeez_tpu.tools import timeline_tool as tt
+    out = tmp_path / "out"
+    out.mkdir()
+    doc = _chrome_doc(
+        [("execute", 0, 0.0, 600.0), ("triage", 0, 700.0, 900.0),
+         ("execute", 1, 1000.0, 1600.0), ("in_flight", 1, 1600.0,
+                                          1900.0)],
+        instants=[("new_path", 0, 800.0)])
+    (out / "trace.json").write_text(json.dumps(doc))
+    log = EventLog(str(out))
+    log.emit("new_path", md5="a" * 32, new_paths=1)
+    log.close()
+    write_fuzzer_stats(str(out / "fuzzer_stats"),
+                       {**_snap(100, paths=1),
+                        "counters": {"execs": 100, "new_paths": 1}})
+    assert tt.main([str(out)]) == 0
+    text = capsys.readouterr().out
+    assert "per-stage wall clock" in text
+    assert "reconcile     : OK" in text
+    assert "batch-" not in text          # synthetic doc: unnamed lanes
+    assert tt.main([str(out), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["stages"]["execute"]["count"] == 2
+    assert rep["reconcile"]["ok"] is True
+    assert rep["critical_path"] == "triage"
+    # no artifacts at all: clean error exit
+    empty = tmp_path / "none"
+    empty.mkdir()
+    assert tt.main([str(empty)]) == 1
+
+
+def test_traced_campaign_end_to_end(tmp_path):
+    """Acceptance slice: a --trace campaign on the `test` target
+    emits a balanced trace.json + an events.jsonl that reconciles
+    exactly with fuzzer_stats, and kb-timeline reads both."""
+    from killerbeez_tpu.drivers.factory import driver_factory
+    from killerbeez_tpu.fuzzer.loop import Fuzzer
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    from killerbeez_tpu.mutators.factory import mutator_factory
+    from killerbeez_tpu.tools import timeline_tool as tt
+
+    out = str(tmp_path / "out")
+    instr = instrumentation_factory("jit_harness",
+                                    '{"target": "test"}')
+    mut = mutator_factory("bit_flip", None, b"ABC@")
+    drv = driver_factory("file", None, instr, mut)
+    fz = Fuzzer(drv, output_dir=out, batch_size=8,
+                stats_interval=0.0, trace=True)
+    stats = fz.run(32)                   # full walk: 1 unique crash
+    assert stats.unique_crashes == 1
+    doc = json.load(open(os.path.join(out, "trace.json")))
+    _balance_check(doc)
+    # every pipeline stage left spans, on pipeline-slot lanes
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "B"}
+    assert {"execute", "host_transfer", "triage"} <= names
+    lanes = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M"}
+    assert any(l.startswith("batch-") for l in lanes)
+    evs = list(read_events(out))
+    fs = parse_fuzzer_stats(os.path.join(out, "fuzzer_stats"))
+    n_paths = sum(1 for e in evs if e["type"] == "new_path")
+    n_crash = sum(1 for e in evs if e["type"] == "crash")
+    assert n_paths == int(fs["paths_total"]) == stats.new_paths
+    assert n_crash == int(fs["unique_crashes"]) == 1
+    assert int(fs["last_crash"]) > 0 and int(fs["last_path"]) > 0
+    rep = tt.build_report(doc, evs, fs)
+    assert rep["reconcile"]["ok"] is True
+    assert rep["span_count"] > 0
+    # --resume continues the monotone event seq; a fresh (non-resume)
+    # campaign into the same dir truncates instead of inheriting the
+    # old timeline (counters restart — stale events would break
+    # reconciliation and re-forward old terminal events)
+    def again(resume):
+        fz = Fuzzer(driver_factory(
+            "file", None,
+            instrumentation_factory("jit_harness",
+                                    '{"target": "test"}'),
+            mutator_factory("bit_flip", None, b"ABC@")),
+            output_dir=out, batch_size=8, stats_interval=0.0,
+            trace=True, corpus_dir=str(tmp_path / "corpus"),
+            resume=resume)
+        fz.run(8)
+
+    first_run_seqs = [e["seq"] for e in evs]
+    again(resume=True)
+    seqs = [e["seq"] for e in read_events(out)]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert len(seqs) > len(first_run_seqs)   # continued, not reset
+    again(resume=False)
+    seqs = [e["seq"] for e in read_events(out)]
+    assert seqs and seqs[0] == 0             # truncated: new timeline
+    assert len(seqs) < len(first_run_seqs) + 3
+
+
+# -- manager /api/events exchange --------------------------------------
+
+
+def test_manager_events_endpoint_cursor_and_dedup():
+    from killerbeez_tpu.manager import ManagerServer
+    import urllib.request
+    s = ManagerServer(port=0)
+    s.start()
+    try:
+        base = f"http://127.0.0.1:{s.port}/api/events/c1"
+
+        def post(worker, events):
+            req = urllib.request.Request(
+                base, json.dumps({"worker": worker,
+                                  "events": events}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read())
+
+        def get(since=0):
+            with urllib.request.urlopen(f"{base}?since={since}") as r:
+                return json.loads(r.read())
+
+        e0 = {"v": 1, "seq": 0, "t": 1.0, "type": "crash",
+              "md5": "a" * 32}
+        e1 = {"v": 1, "seq": 1, "t": 2.0, "type": "plateau"}
+        assert post("w1", [e0, e1])["stored"] == 2
+        # a retried window dedups on (worker, seq, t)
+        assert post("w1", [e0, e1])["stored"] == 0
+        assert post("w2", [e0])["stored"] == 1   # other worker: new
+        # a same-named worker RESTARTED with a fresh log reuses seq 0
+        # but carries a new wall time — its events must still store
+        assert post("w1", [{**e0, "t": 50.0}])["stored"] == 1
+        # malformed records are skipped, not fatal
+        assert post("w1", [{"v": 1, "seq": None, "t": 1.0,
+                            "type": "crash"}])["stored"] == 0
+        view = get()
+        # ids need not be dense (conflicting inserts may burn
+        # AUTOINCREMENT values) — only the cursor contract matters
+        assert view["latest"] == view["events"][-1]["id"]
+        assert [r["worker"] for r in view["events"]] \
+            == ["w1", "w1", "w2", "w1"]
+        assert view["events"][0]["event"]["md5"] == "a" * 32
+        # cursor semantics mirror /api/corpus
+        tail = get(since=view["events"][1]["id"])
+        assert [r["worker"] for r in tail["events"]] == ["w2", "w1"]
+        assert get(since=view["latest"])["events"] == []
+    finally:
+        s.stop()
+
+
+def test_heartbeat_forwards_terminal_events(tmp_path, monkeypatch):
+    """The worker heartbeat tails events.jsonl and forwards crash/
+    hang/plateau records (only complete lines, cursor advances, a
+    failed POST rewinds for the next beat)."""
+    from killerbeez_tpu.manager import worker as w
+    out = tmp_path / "o"
+    out.mkdir()
+    (out / "stats.jsonl").write_text(json.dumps(_snap(1)) + "\n")
+    log = EventLog(str(out))
+    log.emit("new_path", md5="n" * 32)   # NOT terminal: filtered
+    log.emit("crash", md5="c" * 32, crashes=1, unique_crashes=1)
+    log.emit("plateau", execs=64)
+    log.close()
+    posts = []
+    monkeypatch.setattr(
+        w, "_request_retry",
+        lambda url, payload=None, **kw: posts.append((url, payload)))
+    hb = w.Heartbeat("http://mgr", "7", "w1", str(out), interval=99)
+    assert hb.beat()
+    ev_posts = [p for p in posts if "/api/events/" in p[0]]
+    assert len(ev_posts) == 1
+    url, payload = ev_posts[0]
+    assert url == "http://mgr/api/events/7"
+    assert [e["type"] for e in payload["events"]] \
+        == ["crash", "plateau"]
+    assert hb.events_sent == 2
+    # nothing new: no second events POST
+    posts.clear()
+    hb.beat()
+    assert not [p for p in posts if "/api/events/" in p[0]]
+    # a torn tail line is left for the next beat
+    with open(out / "events.jsonl", "a") as f:
+        f.write('{"v": 1, "seq": 3, "t": 1.0, "type": "crash"')
+    posts.clear()
+    hb.beat()
+    assert not [p for p in posts if "/api/events/" in p[0]]
+    with open(out / "events.jsonl", "a") as f:
+        f.write(', "md5": "d"}\n')
+    posts.clear()
+    hb.beat()
+    (url, payload), = [p for p in posts if "/api/events/" in p[0]]
+    assert payload["events"][0]["seq"] == 3
+    # transport failure rewinds the cursor; the next beat re-sends
+    log2 = EventLog(str(out))
+    log2.emit("hang", md5="h" * 32)
+    log2.close()
+
+    def down(url, payload=None, **kw):
+        if "/api/events/" in url:
+            raise ConnectionError("refused")
+        return None
+
+    monkeypatch.setattr(w, "_request_retry", down)
+    hb.beat()
+    posts.clear()
+    monkeypatch.setattr(
+        w, "_request_retry",
+        lambda url, payload=None, **kw: posts.append((url, payload)))
+    hb.beat()
+    (url, payload), = [p for p in posts if "/api/events/" in p[0]]
+    assert [e["type"] for e in payload["events"]] == ["hang"]
+
+
+def test_stats_tui_json_once(tmp_path, capsys):
+    from killerbeez_tpu.tools import stats_tui
+    snap = _snap(4096, paths=7)
+    (tmp_path / "stats.jsonl").write_text(json.dumps(snap) + "\n")
+    assert stats_tui.main([str(tmp_path), "--once", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["counters"]["execs"] == 4096
+    # --json without --once is an argument error
+    assert stats_tui.main([str(tmp_path), "--json"]) == 2
 
 
 # -- Telemetry facade --------------------------------------------------

@@ -133,6 +133,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "<output>/device_trace, next to the host "
                         "trace; needs the jax profiler deps, degrades "
                         "to a warning without them")
+    p.add_argument("--events-max-mb", type=float, default=0.0,
+                   metavar="MB",
+                   help="rotate <output>/events.jsonl to "
+                        "events.jsonl.1 when it exceeds this many "
+                        "megabytes (seq stays monotone across the "
+                        "rotation; kb-timeline and the heartbeat "
+                        "forwarder read the rotated tail "
+                        "transparently; 0 = unbounded, the default)")
     p.add_argument("--no-stats", action="store_true",
                    help="disable the periodic campaign stats files "
                         "(fuzzer_stats / plot_data / stats.jsonl in "
@@ -331,7 +339,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         resume=args.resume,
                         sync=sync,
                         trace=args.trace,
-                        profile_device=args.profile_device)
+                        profile_device=args.profile_device,
+                        events_max_mb=args.events_max_mb)
         if args.schedule == "rare-edge":
             _wire_rare_edge_signer(fuzzer, driver)
             _wire_static_prior(fuzzer, driver)

@@ -166,7 +166,8 @@ class Fuzzer:
                  sync=None,
                  persist_interval: float = 5.0,
                  trace=None,
-                 profile_device: int = 0):
+                 profile_device: int = 0,
+                 events_max_mb: float = 0.0):
         self.driver = driver
         self.output_dir = output_dir
         self.batch_size = int(batch_size)
@@ -185,14 +186,17 @@ class Fuzzer:
         # a NON-resume campaign starts a fresh event timeline even in
         # a reused output dir (counters restart, so inherited events
         # would break reconciliation); --resume continues the log
+        ev_max_bytes = int(float(events_max_mb) * 1e6)
         if telemetry is None:
             telemetry = Telemetry(
                 output_dir if write_findings else None,
                 interval_s=stats_interval, trace=trace,
-                fresh_events=not resume)
+                fresh_events=not resume,
+                events_max_bytes=ev_max_bytes)
         elif telemetry is True:
             telemetry = Telemetry(output_dir, interval_s=stats_interval,
-                                  trace=trace, fresh_events=not resume)
+                                  trace=trace, fresh_events=not resume,
+                                  events_max_bytes=ev_max_bytes)
         elif telemetry is False:
             telemetry = Telemetry(None, trace=trace)
         self.telemetry = telemetry

@@ -32,6 +32,16 @@ plus the work-queue routes that replace BOINC's scheduler
     POST /api/events/<campaign>      {worker, events} -> {stored}
     GET  /api/events/<campaign>?since=<id>
                                      -> {events, latest}
+
+plus the fleet observatory (manager/fleet.py):
+
+    GET  /api/fleet                  -> {campaigns: {name: counts}}
+    GET  /api/fleet/<campaign>       -> worker health + merged stats
+                                        + alert states
+    GET  /api/fleet/<campaign>/series?since=<id>[&limit=][&format=plot]
+                                     -> {samples, latest} | plot_data
+    GET  /metrics                    -> OpenMetrics exposition
+                                        (Prometheus scrape surface)
 """
 
 from __future__ import annotations
@@ -45,14 +55,21 @@ from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..telemetry import merge
+from ..telemetry.openmetrics import CONTENT_TYPE as _OM_CTYPE
 from ..tools.minimize import greedy_edge_cover
 from ..utils.logging import INFO_MSG
 from .db import ManagerDB
+from .fleet import (
+    FleetConfig, FleetMonitor, fleet_index, fleet_view,
+    render_fleet_metrics,
+)
 from .fuzzer_cmd import format_cmdline
 
 
 class _Handler(BaseHTTPRequestHandler):
     db: ManagerDB  # set by ManagerServer
+    fleet_config: FleetConfig
+    monitor: Optional[FleetMonitor] = None
 
     # -- plumbing -------------------------------------------------------
 
@@ -211,8 +228,18 @@ class _Handler(BaseHTTPRequestHandler):
         afl-whatsup-style campaign rollup."""
         if self.command == "POST":
             b = self._body()
-            self.db.upsert_campaign_stats(
-                campaign, b.get("worker", "anon"), b["snapshot"])
+            worker = b.get("worker", "anon")
+            self.db.upsert_campaign_stats(campaign, worker,
+                                          b["snapshot"])
+            # health registry: the heartbeat IS the liveness signal;
+            # a stale/dead worker beating again flips back to healthy
+            # and the revival lands in the campaign event stream
+            prev = self.db.note_fleet_worker(campaign, worker,
+                                             meta=b.get("meta"))
+            if prev in ("stale", "dead"):
+                self.db.add_manager_event(campaign, "worker_returned",
+                                          worker=worker,
+                                          previous=prev)
             self._json(201, {"ok": True})
             return
         rows = self.db.get_campaign_stats(campaign)
@@ -278,6 +305,58 @@ class _Handler(BaseHTTPRequestHandler):
             "events": rows,
         })
 
+    # -- fleet observatory ---------------------------------------------
+
+    def h_fleet_index(self, query):
+        self._json(200, fleet_index(self.db, self.fleet_config))
+
+    def h_fleet(self, query, campaign):
+        """Worker health registry view: live-classified statuses,
+        per-worker stat summaries, the merged fleet snapshot and the
+        alert evaluator's current states."""
+        self._json(200, fleet_view(self.db, self.fleet_config,
+                                   campaign, self.monitor))
+
+    def h_fleet_series(self, query, campaign):
+        """Fleet time-series, cursor GET like ``/api/events``;
+        ``format=plot`` renders the afl-plot-compatible fleet-wide
+        plot_data CSV instead of JSON."""
+        since = int(query.get("since", ["0"])[0])
+        limit = int(query.get("limit", ["0"])[0])
+        rows = self.db.get_fleet_series(campaign, since, limit)
+        if query.get("format", [None])[0] == "plot":
+            lines = ["# unix_time, execs_done, paths_total, crashes, "
+                     "unique_crashes, hangs, unique_hangs, "
+                     "corpus_count, execs_per_sec, n_workers"]
+            for s in rows:
+                lines.append(", ".join(str(v) for v in (
+                    int(s.get("t", 0)), int(s.get("execs", 0)),
+                    int(s.get("new_paths", 0)),
+                    int(s.get("crashes", 0)),
+                    int(s.get("unique_crashes", 0)),
+                    int(s.get("hangs", 0)),
+                    int(s.get("unique_hangs", 0)),
+                    int(s.get("corpus_seen", 0)),
+                    round(float(s.get("execs_per_sec_ema", 0.0)), 2),
+                    int(s.get("n_workers", 0)))))
+            self._bytes(200, ("\n".join(lines) + "\n").encode(),
+                        ctype="text/plain; charset=utf-8")
+            return
+        # (not max()'s default= — that expression is evaluated
+        # eagerly, costing a discarded MAX(id) query on every page)
+        latest = (max(s["id"] for s in rows) if rows
+                  else self.db.fleet_series_latest_id(campaign))
+        self._json(200, {"campaign": campaign, "latest": latest,
+                         "samples": rows})
+
+    def h_metrics(self, query):
+        """OpenMetrics exposition over every known campaign — the
+        Prometheus scrape surface (conformance pinned in CI by the
+        test suite's strict parser)."""
+        text = render_fleet_metrics(self.db, self.fleet_config,
+                                    self.monitor)
+        self._bytes(200, text.encode(), ctype=_OM_CTYPE)
+
     def h_work_claim(self, query):
         b = self._body()
         job = self.db.claim_job(b.get("worker", "anon"))
@@ -318,6 +397,10 @@ _ROUTES: Tuple = (
                                 "POST": _Handler.h_corpus}),
     (r"/api/events/([\w.-]+)", {"GET": _Handler.h_events,
                                 "POST": _Handler.h_events}),
+    (r"/api/fleet", {"GET": _Handler.h_fleet_index}),
+    (r"/api/fleet/([\w.-]+)", {"GET": _Handler.h_fleet}),
+    (r"/api/fleet/([\w.-]+)/series", {"GET": _Handler.h_fleet_series}),
+    (r"/metrics", {"GET": _Handler.h_metrics}),
     (r"/api/minimize", {"POST": _Handler.h_minimize}),
     (r"/api/work/claim", {"POST": _Handler.h_work_claim}),
     (r"/api/work/(\d+)/finish", {"POST": _Handler.h_work_finish}),
@@ -329,24 +412,41 @@ class ManagerServer:
     tests, serve_forever() for the CLI."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8650,
-                 db_path: str = ":memory:"):
+                 db_path: str = ":memory:",
+                 fleet: Optional[FleetConfig] = None):
         self.db = ManagerDB(db_path)
-        handler = type("BoundHandler", (_Handler,), {"db": self.db})
+        self.fleet_config = fleet or FleetConfig()
+        #: the observatory evaluator; its thread only starts with the
+        #: server (monitor_interval <= 0 keeps it manual-tick-only —
+        #: tests drive tick() deterministically)
+        self.monitor = FleetMonitor(self.db, self.fleet_config)
+        handler = type("BoundHandler", (_Handler,),
+                       {"db": self.db,
+                        "fleet_config": self.fleet_config,
+                        "monitor": self.monitor})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
+    def _start_monitor(self) -> None:
+        if self.fleet_config.monitor_interval > 0 \
+                and not self.monitor.is_alive():
+            self.monitor.start()
+
     def start(self) -> None:
+        self._start_monitor()
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
         INFO_MSG("manager listening on :%d", self.port)
 
     def serve_forever(self) -> None:
+        self._start_monitor()
         INFO_MSG("manager listening on :%d", self.port)
         self.httpd.serve_forever()
 
     def stop(self) -> None:
+        self.monitor.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:

@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..telemetry.events import SCHEMA_VERSION
+
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS targets (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -100,6 +102,25 @@ CREATE TABLE IF NOT EXISTS campaign_events (
     -- lifetime — a same-named worker restarting with a fresh output
     -- dir restarts seq at 0, and its events must still store
     UNIQUE(campaign, worker, seq, t)
+);
+CREATE TABLE IF NOT EXISTS fleet_workers (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    campaign TEXT NOT NULL,
+    worker TEXT NOT NULL,
+    first_seen REAL NOT NULL,     -- first heartbeat (registration)
+    last_seen REAL NOT NULL,      -- newest heartbeat
+    beats INTEGER NOT NULL DEFAULT 0,
+    status TEXT NOT NULL DEFAULT 'healthy',
+        -- healthy | stale | dead (the monitor's last classification;
+        -- endpoints re-classify live against last_seen)
+    meta TEXT,                    -- worker-supplied JSON (pid, host)
+    UNIQUE(campaign, worker)
+);
+CREATE TABLE IF NOT EXISTS fleet_series (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,  -- the GET cursor
+    campaign TEXT NOT NULL,
+    t REAL NOT NULL,
+    sample TEXT NOT NULL          -- fleet snapshot JSON (monitor)
 );
 CREATE TABLE IF NOT EXISTS corpus_entries (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -349,6 +370,158 @@ class ManagerDB:
             r["snapshot"] = json.loads(r["snapshot"])
         return rows
 
+    # -- fleet worker health registry ----------------------------------
+
+    def note_fleet_worker(self, campaign: str, worker: str,
+                          meta: Optional[Dict[str, Any]] = None,
+                          now: Optional[float] = None
+                          ) -> Optional[str]:
+        """Record one heartbeat in the health registry: first beat
+        registers the worker (first_seen), every beat refreshes
+        last_seen and resets status to healthy.  Returns the PREVIOUS
+        status (None for a new worker) so the caller can emit a
+        ``worker_returned`` event when a stale/dead worker revives."""
+        now = time.time() if now is None else now
+        with self._lock:
+            conn = self._conn()
+            row = conn.execute(
+                "SELECT status FROM fleet_workers WHERE campaign=? "
+                "AND worker=?", (str(campaign), worker)).fetchone()
+            prev = row["status"] if row is not None else None
+            conn.execute(
+                "INSERT INTO fleet_workers (campaign, worker, "
+                "first_seen, last_seen, beats, status, meta) "
+                "VALUES (?,?,?,?,1,'healthy',?) "
+                "ON CONFLICT(campaign, worker) DO UPDATE SET "
+                "last_seen=excluded.last_seen, beats=beats+1, "
+                "status='healthy', "
+                "meta=COALESCE(excluded.meta, meta)",
+                (str(campaign), worker, now, now,
+                 json.dumps(meta) if meta is not None else None))
+            conn.commit()
+        return prev
+
+    def get_fleet_workers(self, campaign: Optional[str] = None
+                          ) -> List[Dict[str, Any]]:
+        if campaign is not None:
+            rows = self._rows(
+                "SELECT * FROM fleet_workers WHERE campaign=? "
+                "ORDER BY worker", (str(campaign),))
+        else:
+            rows = self._rows(
+                "SELECT * FROM fleet_workers ORDER BY campaign, "
+                "worker")
+        for r in rows:
+            if r.get("meta"):
+                try:
+                    r["meta"] = json.loads(r["meta"])
+                except ValueError:
+                    r["meta"] = None
+        return rows
+
+    def set_fleet_worker_status(self, campaign: str, worker: str,
+                                status: str,
+                                expect_last_seen: Optional[float]
+                                = None) -> bool:
+        """Update a worker's stored status; with ``expect_last_seen``
+        the write only lands if no heartbeat slipped in since the
+        caller read the row (note_fleet_worker bumps last_seen under
+        the same DB lock) — the monitor uses this so a beat racing
+        the tick can't get a spurious worker_stale/worker_dead
+        recorded over its fresh 'healthy'.  Returns whether the
+        update applied."""
+        if expect_last_seen is None:
+            cur = self._exec(
+                "UPDATE fleet_workers SET status=? WHERE campaign=? "
+                "AND worker=?", (status, str(campaign), worker))
+        else:
+            cur = self._exec(
+                "UPDATE fleet_workers SET status=? WHERE campaign=? "
+                "AND worker=? AND last_seen=?",
+                (status, str(campaign), worker,
+                 float(expect_last_seen)))
+        return cur.rowcount > 0
+
+    def retire_fleet_workers(self, cutoff: float) -> int:
+        """Drop health-registry rows (and their heartbeat snapshots)
+        whose last beat predates ``cutoff`` — a finished campaign's
+        workers leave the observatory instead of reading dead
+        forever; fleet_series keeps the campaign's history."""
+        with self._lock:
+            conn = self._conn()
+            cur = conn.execute(
+                "DELETE FROM fleet_workers WHERE last_seen < ?",
+                (float(cutoff),))
+            # snapshots follow the registry: a worker with no
+            # registry row left has retired (any live worker's next
+            # heartbeat re-registers it immediately)
+            conn.execute(
+                "DELETE FROM campaign_stats WHERE NOT EXISTS "
+                "(SELECT 1 FROM fleet_workers fw WHERE "
+                "fw.campaign=campaign_stats.campaign AND "
+                "fw.worker=campaign_stats.worker)")
+            conn.commit()
+            return cur.rowcount
+
+    def fleet_campaigns(self) -> List[str]:
+        """Every campaign the observatory knows: health-registry rows
+        union heartbeat-snapshot rows."""
+        rows = self._rows(
+            "SELECT campaign FROM fleet_workers UNION "
+            "SELECT campaign FROM campaign_stats ORDER BY campaign")
+        return [r["campaign"] for r in rows]
+
+    # -- fleet time-series (history that survives worker churn) -------
+
+    def add_fleet_sample(self, campaign: str,
+                         sample: Dict[str, Any],
+                         max_rows: int = 0) -> int:
+        """Insert one fleet sample; with ``max_rows`` > 0 the oldest
+        rows beyond the cap are pruned in the same call, so the
+        history table stays bounded however long the manager runs
+        (cursors stay valid — ids only ever disappear from the old
+        end)."""
+        cur = self._exec(
+            "INSERT INTO fleet_series (campaign, t, sample) "
+            "VALUES (?,?,?)",
+            (str(campaign), float(sample.get("t", time.time())),
+             json.dumps(sample)))
+        if max_rows > 0:
+            self._exec(
+                "DELETE FROM fleet_series WHERE campaign=? AND id "
+                "NOT IN (SELECT id FROM fleet_series WHERE "
+                "campaign=? ORDER BY id DESC LIMIT ?)",
+                (str(campaign), str(campaign), int(max_rows)))
+        return cur.lastrowid
+
+    def get_fleet_series(self, campaign: str, since_id: int = 0,
+                         limit: int = 0) -> List[Dict[str, Any]]:
+        """Samples newer than the caller's cursor (``/api/events``
+        since semantics); ``limit`` > 0 caps the page."""
+        sql = ("SELECT id, t, sample FROM fleet_series WHERE "
+               "campaign=? AND id>? ORDER BY id")
+        params: tuple = (str(campaign), int(since_id))
+        if limit > 0:
+            sql += " LIMIT ?"
+            params += (int(limit),)
+        rows = self._rows(sql, params)
+        out = []
+        for r in rows:
+            try:
+                sample = json.loads(r["sample"])
+            except ValueError:
+                continue
+            sample["id"] = r["id"]
+            sample.setdefault("t", r["t"])
+            out.append(sample)
+        return out
+
+    def fleet_series_latest_id(self, campaign: str) -> int:
+        rows = self._rows(
+            "SELECT MAX(id) AS m FROM fleet_series WHERE campaign=?",
+            (str(campaign),))
+        return int(rows[0]["m"] or 0) if rows else 0
+
     # -- campaign events (flight-recorder exchange) --------------------
 
     def add_campaign_events(self, campaign: str, worker: str,
@@ -380,6 +553,39 @@ class ManagerDB:
                 stored += cur.rowcount
             conn.commit()
         return stored
+
+    #: pseudo-worker name for manager-origin records (health
+    #: transitions, alerts) in the campaign event stream
+    MANAGER_WORKER = "_manager"
+
+    def add_manager_event(self, campaign: str, etype: str,
+                          now: Optional[float] = None,
+                          **fields) -> Dict[str, Any]:
+        """Emit one manager-origin record into the campaign stream
+        under the ``_manager`` pseudo-worker with its own monotone
+        seq, so cursor GETs, kb-timeline merging and the heartbeat
+        dedup key apply to manager events unchanged."""
+        now = time.time() if now is None else now
+        with self._lock:
+            conn = self._conn()
+            row = conn.execute(
+                "SELECT MAX(seq) AS m FROM campaign_events WHERE "
+                "campaign=? AND worker=?",
+                (str(campaign), self.MANAGER_WORKER)).fetchone()
+            seq = int(row["m"] if row and row["m"] is not None
+                      else -1) + 1
+            rec: Dict[str, Any] = {"v": SCHEMA_VERSION, "seq": seq,
+                                   "t": now, "type": str(etype)}
+            rec.update(fields)
+            conn.execute(
+                "INSERT INTO campaign_events (campaign, worker, seq, "
+                "t, type, payload, created) VALUES (?,?,?,?,?,?,?) "
+                "ON CONFLICT(campaign, worker, seq, t) DO NOTHING",
+                (str(campaign), self.MANAGER_WORKER, seq, float(now),
+                 str(etype), json.dumps(rec, default=str),
+                 time.time()))
+            conn.commit()
+        return rec
 
     def get_campaign_events(self, campaign: str, since_id: int = 0
                             ) -> List[Dict[str, Any]]:

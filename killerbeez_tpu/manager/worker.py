@@ -93,8 +93,19 @@ class Heartbeat(threading.Thread):
         self.interval = interval
         self._halt = threading.Event()
         self._ev_pos = 0                 # events.jsonl bytes consumed
+        self._ev_ino = None              # inode the cursor belongs to
+        self._ev1_pos = 0                # first-beat drain cursor (.1)
+        self._ev1_done = False
         self.sent = 0
         self.events_sent = 0
+        #: worker identity forwarded with each beat — the manager's
+        #: health registry stores it as fleet_workers.meta
+        try:
+            import socket
+            self.meta = {"pid": os.getpid(),
+                         "host": socket.gethostname()}
+        except OSError:
+            self.meta = {"pid": os.getpid()}
 
     #: per-beat read window over events.jsonl: bounds memory and
     #: request size — a long backlog (worker restart against a
@@ -102,22 +113,19 @@ class Heartbeat(threading.Thread):
     #: whole-file read + one giant POST
     EV_WINDOW = 256 << 10
 
-    def _forward_events(self) -> int:
-        """Ship terminal events appended since the last beat.  Only
-        COMPLETE lines advance the cursor (a torn tail line stays for
-        the next beat); on transport failure the cursor rewinds — the
-        manager dedups by (worker, seq, t), so a re-send is
-        harmless."""
-        path = os.path.join(self.output_dir, "events.jsonl")
+    def _read_terminal_window(self, path: str, pos: int):
+        """One bounded read at ``pos``: returns (terminal event
+        records from the COMPLETE lines, bytes consumed) — (.., 0)
+        when nothing complete is available."""
         try:
             with open(path, "rb") as f:
-                f.seek(self._ev_pos)
+                f.seek(pos)
                 chunk = f.read(self.EV_WINDOW)
         except OSError:
-            return 0
+            return [], 0
         nl = chunk.rfind(b"\n")
         if nl < 0:
-            return 0
+            return [], 0
         events = []
         for line in chunk[:nl].splitlines():
             try:
@@ -127,9 +135,11 @@ class Heartbeat(threading.Thread):
             if isinstance(rec, dict) and \
                     rec.get("type") in TERMINAL_EVENTS:
                 events.append(rec)
-        self._ev_pos += nl + 1
+        return events, nl + 1
+
+    def _post_events(self, events) -> bool:
         if not events:
-            return 0
+            return True
         try:
             _request_retry(self.events_url,
                            {"worker": self.worker, "events": events},
@@ -137,9 +147,68 @@ class Heartbeat(threading.Thread):
         except Exception as e:
             WARNING_MSG("event forward to %s failed: %s",
                         self.events_url, e)
-            self._ev_pos -= nl + 1       # retry the window next beat
-            return 0
+            return False
         self.events_sent += len(events)
+        return True
+
+    def _forward_events(self) -> int:
+        """Ship terminal events appended since the last beat.  Only
+        COMPLETE lines advance the cursor (a torn tail line stays for
+        the next beat); on transport failure the cursor rewinds — the
+        manager dedups by (worker, seq, t), so a re-send is
+        harmless.  An ``--events-max-mb`` rotation (live file shrinks
+        below the cursor) drains the rotated generation's tail from
+        ``events.jsonl.1`` first, then restarts the cursor at the
+        fresh live file."""
+        path = os.path.join(self.output_dir, "events.jsonl")
+        if not self._ev1_done:
+            # first beats: a burst of events can rotate the log
+            # BEFORE the heartbeat ever reads it, so the rotated
+            # generation must be drained once up front (re-sends are
+            # dedup-safe; the .1 file is bounded by the cap)
+            while True:
+                tail, used = self._read_terminal_window(
+                    path + ".1", self._ev1_pos)
+                if used == 0:
+                    self._ev1_done = True
+                    break
+                if not self._post_events(tail):
+                    return 0             # retry the same spot later
+                self._ev1_pos += used
+        try:
+            st = os.stat(path)
+        except OSError:
+            st = None
+        rotated = (self._ev_ino is not None
+                   and (st is None or st.st_ino != self._ev_ino
+                        or st.st_size < self._ev_pos))
+        if rotated:
+            # rotated under us (the cursor's inode is now
+            # events.jsonl.1): finish the previous generation — the
+            # .1 file is bounded by the rotation cap, so this drain
+            # is bounded too — then restart at byte 0 of the fresh
+            # live file.  Only one generation is kept on disk: a
+            # double rotation within one beat loses the middle one.
+            while True:
+                tail, used = self._read_terminal_window(
+                    path + ".1", self._ev_pos)
+                if used == 0:
+                    break
+                if not self._post_events(tail):
+                    return 0             # retry the same spot later
+                self._ev_pos += used
+            self._ev_pos = 0
+            self._ev_ino = None
+        if st is None:
+            return 0
+        self._ev_ino = st.st_ino
+        events, consumed = self._read_terminal_window(path,
+                                                      self._ev_pos)
+        if consumed == 0:
+            return 0
+        if not self._post_events(events):
+            return 0                     # cursor unmoved: re-read
+        self._ev_pos += consumed
         return len(events)
 
     def beat(self) -> bool:
@@ -149,7 +218,8 @@ class Heartbeat(threading.Thread):
             return False
         try:
             _request_retry(self.url,
-                           {"worker": self.worker, "snapshot": snap},
+                           {"worker": self.worker, "snapshot": snap,
+                            "meta": self.meta},
                            attempts=3)
             self.sent += 1
             return True

@@ -31,14 +31,16 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
-from .aggregate import merge, merge_events, merge_two
+from .aggregate import merge, merge_events, merge_health, merge_two
 from .events import (
     EVENT_TYPES, EVENTS_FILE, EventLog, SCHEMA_VERSION,
     TERMINAL_EVENTS, last_event_seq, read_events,
 )
 from .metrics import (
     EmaRate, Histogram, MetricsRegistry, StageTimer, STAGES,
+    percentiles_from_counts,
 )
+from .openmetrics import render_snapshot as render_openmetrics
 from .sink import StatsSink, parse_fuzzer_stats, read_latest_snapshot
 from .trace import TraceRecorder, load_chrome_trace
 
@@ -47,8 +49,9 @@ __all__ = [
     "MetricsRegistry", "SCHEMA_VERSION", "STAGES", "StageTimer",
     "StatsSink", "TERMINAL_EVENTS", "Telemetry", "TraceRecorder",
     "last_event_seq", "load_chrome_trace", "merge", "merge_events",
-    "merge_two", "parse_fuzzer_stats", "read_events",
-    "read_latest_snapshot",
+    "merge_health", "merge_two", "parse_fuzzer_stats",
+    "percentiles_from_counts", "read_events", "read_latest_snapshot",
+    "render_openmetrics",
 ]
 
 #: event types whose emission stamps an AFL find-recency gauge (the
@@ -66,7 +69,8 @@ class Telemetry:
                  interval_s: float = 5.0,
                  registry: Optional[MetricsRegistry] = None,
                  trace=None, events=None,
-                 fresh_events: bool = False):
+                 fresh_events: bool = False,
+                 events_max_bytes: int = 0):
         self.registry = registry or MetricsRegistry()
         # trace: None/False/0 = off; True = default ring; int = ring
         # capacity in events; a TraceRecorder passes through
@@ -86,7 +90,8 @@ class Telemetry:
         # fresh_events truncates an inherited log (a NEW campaign
         # reusing an output dir; --resume continues instead)
         if events is None:
-            events = (EventLog(output_dir, fresh=fresh_events)
+            events = (EventLog(output_dir, fresh=fresh_events,
+                               max_bytes=events_max_bytes)
                       if output_dir else None)
         elif events is False:
             events = None

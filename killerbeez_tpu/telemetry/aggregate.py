@@ -17,7 +17,12 @@ answer:
                   that has observed half a horizon contributes half
                   strength.  (rate*weight, weight) pairs add, which
                   is what makes the mean associative.
-  * histograms  — bucket-wise counts summed
+  * histograms  — bucket-wise counts summed (p50/p90/p99 re-derived
+                  from the merged counts — quantiles never average)
+  * health      — per-worker liveness dicts union; on conflict the
+                  record with the newest ``last_seen`` wins (tie:
+                  worse status), ``first_seen`` min / ``last_seen``
+                  max — a total order, so the fold stays associative
   * start_time  — min; ``t`` — max (the merged view spans the fleet)
   * event logs  — exact-duplicate-deduped union, sorted into one
                   fleet timeline (``merge_events``; snapshots carrying
@@ -28,6 +33,8 @@ from __future__ import annotations
 
 import json
 from typing import Dict, List, Optional
+
+from .metrics import percentiles_from_counts
 
 
 def _merge_rates(a: Dict[str, Dict[str, float]],
@@ -68,6 +75,46 @@ def _merge_hists(a: Dict[str, Dict], b: Dict[str, Dict]
         out[k] = {"counts": ca,
                   "total": ha.get("total", 0) + hb.get("total", 0),
                   "sum": ha.get("sum", 0.0) + hb.get("sum", 0.0)}
+        # quantiles are re-derived from the merged counts — merging
+        # per-worker p50s would be wrong AND order-dependent
+        out[k].update(percentiles_from_counts(ca))
+    return out
+
+
+#: health-status severity (worse = higher) — the ONE ordering behind
+#: both the merge tie-break here and the manager monitor's
+#: escalation checks (manager/fleet.py imports it)
+STATUS_RANK = {"healthy": 0, "stale": 1, "dead": 2}
+_STATUS_RANK = STATUS_RANK
+
+
+def merge_health(a: Optional[Dict[str, Dict]],
+                 b: Optional[Dict[str, Dict]]) -> Dict[str, Dict]:
+    """Fold per-worker health dicts (``{worker: {status, first_seen,
+    last_seen, ...}}``).  Per worker: the record with the greater
+    ``(last_seen, status severity)`` supplies the fields (a TOTAL
+    order — associative + commutative), then ``first_seen`` takes the
+    min and ``last_seen`` the max across both."""
+    def _key(h: Dict) -> tuple:
+        return (h.get("last_seen", 0.0),
+                _STATUS_RANK.get(h.get("status"), 0))
+
+    out = {w: dict(h) for w, h in (a or {}).items()}
+    for w, hb in (b or {}).items():
+        ha = out.get(w)
+        if ha is None:
+            out[w] = dict(hb)
+            continue
+        win = dict(hb) if _key(hb) >= _key(ha) else dict(ha)
+        fs = [h.get("first_seen") for h in (ha, hb)
+              if h.get("first_seen") is not None]
+        ls = [h.get("last_seen") for h in (ha, hb)
+              if h.get("last_seen") is not None]
+        if fs:
+            win["first_seen"] = min(fs)
+        if ls:
+            win["last_seen"] = max(ls)
+        out[w] = win
     return out
 
 
@@ -109,6 +156,9 @@ def merge_two(a: Dict[str, object], b: Dict[str, object]
     ev_a, ev_b = a.get("events"), b.get("events")
     if ev_a or ev_b:
         out["events"] = merge_events(ev_a or [], ev_b or [])
+    h_a, h_b = a.get("health"), b.get("health")
+    if h_a or h_b:
+        out["health"] = merge_health(h_a, h_b)
     st = [s.get("start_time") for s in (a, b)
           if s.get("start_time") is not None]
     ts = [s.get("t") for s in (a, b) if s.get("t") is not None]

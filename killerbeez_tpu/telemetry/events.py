@@ -48,12 +48,21 @@ SCHEMA_VERSION = 1
 
 EVENT_TYPES = ("new_path", "crash", "hang", "plateau",
                "crack_injection", "sync_round", "scheduler_pick",
-               "flush")
+               "flush",
+               # fleet-observatory records (manager-origin: the
+               # worker health registry and the alert evaluator emit
+               # these into the same campaign stream)
+               "worker_stale", "worker_dead", "worker_returned",
+               "alert")
 
 #: events a fleet worker forwards to the manager alongside heartbeats
 TERMINAL_EVENTS = ("crash", "hang", "plateau")
 
 EVENTS_FILE = "events.jsonl"
+
+#: rotated-out predecessor (``--events-max-mb``): at most one
+#: generation is kept — rotation replaces any previous ``.1``
+ROTATED_SUFFIX = ".1"
 
 
 def _resolve_path(path: str) -> str:
@@ -62,11 +71,7 @@ def _resolve_path(path: str) -> str:
     return path
 
 
-def last_event_seq(path: str, window: int = 1 << 16) -> int:
-    """Highest seq among the readable records in the file's tail
-    window (-1 when none) — the resume anchor.  O(1) in file size,
-    torn-tail tolerant, same discipline as the heartbeat tailer."""
-    path = _resolve_path(path)
+def _scan_tail_seq(path: str, window: int) -> int:
     try:
         with open(path, "rb") as f:
             f.seek(0, os.SEEK_END)
@@ -89,35 +94,53 @@ def last_event_seq(path: str, window: int = 1 << 16) -> int:
     return best
 
 
+def last_event_seq(path: str, window: int = 1 << 16) -> int:
+    """Highest seq among the readable records in the file's tail
+    window (-1 when none) — the resume anchor.  O(1) in file size,
+    torn-tail tolerant, same discipline as the heartbeat tailer.  A
+    log that was just rotated (empty live file) anchors on the
+    rotated predecessor's tail, so seq stays monotone across both
+    rotation and ``--resume``."""
+    path = _resolve_path(path)
+    best = _scan_tail_seq(path, window)
+    if best < 0:
+        best = _scan_tail_seq(path + ROTATED_SUFFIX, window)
+    return best
+
+
 def read_events(path: str, since_seq: int = -1,
                 types: Optional[List[str]] = None
                 ) -> Iterator[Dict[str, Any]]:
     """Yield records with seq > ``since_seq`` (optionally filtered by
-    type), skipping unparseable lines."""
+    type), skipping unparseable lines.  The rotated predecessor
+    (``events.jsonl.1``) is read first when present, so consumers
+    (kb-timeline, reconciliation) see one seamless stream across a
+    ``--events-max-mb`` rotation."""
     path = _resolve_path(path)
-    try:
-        f = open(path)
-    except OSError:
-        return
-    with f:
-        for line in f:
-            if not line.strip():
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if not isinstance(rec, dict):
-                continue
-            try:
-                if int(rec.get("seq", -1)) <= since_seq:
+    for p in (path + ROTATED_SUFFIX, path):
+        try:
+            f = open(p)
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                if not line.strip():
                     continue
-            except (TypeError, ValueError):
-                continue                 # foreign/corrupt record
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                try:
+                    if int(rec.get("seq", -1)) <= since_seq:
+                        continue
+                except (TypeError, ValueError):
+                    continue             # foreign/corrupt record
 
-            if types is not None and rec.get("type") not in types:
-                continue
-            yield rec
+                if types is not None and rec.get("type") not in types:
+                    continue
+                yield rec
 
 
 class EventLog:
@@ -128,13 +151,22 @@ class EventLog:
     run's timeline (its counters restart too, so stale events would
     break reconciliation and re-forward old terminal events); the
     default continues the existing log's monotone seq (``--resume``).
+
+    ``max_bytes`` (CLI ``--events-max-mb``) caps the live file: when
+    an append pushes past the cap the file rotates to
+    ``events.jsonl.1`` (replacing any previous generation) and a
+    fresh live file continues the SAME monotone seq, so long
+    campaigns hold at most two generations on disk while cursors and
+    ``--resume`` anchors stay valid.  0 = unbounded (default).
     """
 
     def __init__(self, path: str, time_fn=time.time,
-                 fresh: bool = False):
+                 fresh: bool = False, max_bytes: int = 0):
         self.path = _resolve_path(path)
         self._time = time_fn
         self._fh = None
+        self.max_bytes = int(max_bytes)
+        self.rotations = 0
         try:
             ensure_dir(os.path.dirname(self.path) or ".")
         except OSError as e:
@@ -144,6 +176,12 @@ class EventLog:
                 open(self.path, "w").close()
             except OSError as e:
                 WARNING_MSG("event log truncate failed: %s", e)
+            # a stale rotated generation from a PREVIOUS campaign
+            # must not leak into this timeline's readers
+            try:
+                os.unlink(self.path + ROTATED_SUFFIX)
+            except OSError:
+                pass
             self._seq = 0
         else:
             # monotone seq across --resume: continue past the
@@ -182,9 +220,32 @@ class EventLog:
             # emitting tier must never be able to kill the campaign
             self._fh.write(json.dumps(rec, default=str) + "\n")
             self._fh.flush()
+            if self.max_bytes > 0 and self._fh.tell() >= self.max_bytes:
+                self._rotate()
         except (OSError, TypeError, ValueError) as e:
             WARNING_MSG("event log append failed: %s", e)
         return rec
+
+    def _rotate(self) -> None:
+        """Roll the live file to ``events.jsonl.1`` (previous
+        generation replaced — the cap bounds TOTAL footprint at
+        ~2x max_bytes); the next emit reopens a fresh live file and
+        seq continues monotone from memory."""
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+        self._fh = None
+        try:
+            os.replace(self.path, self.path + ROTATED_SUFFIX)
+            self.rotations += 1
+        except OSError as e:
+            # a persistently failing replace (.1 is a directory,
+            # permissions) must not re-warn and re-attempt on every
+            # subsequent emit — rotation turns itself off
+            self.max_bytes = 0
+            WARNING_MSG("event log rotation failed (%s); rotation "
+                        "disabled, log grows unbounded", e)
 
     def close(self) -> None:
         if self._fh is not None:

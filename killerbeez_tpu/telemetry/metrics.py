@@ -82,6 +82,43 @@ class EmaRate:
         return {"rate": self._rate, "weight": self._weight}
 
 
+#: quantiles estimated from bucket counts (snapshot keys p50/p90/p99)
+PERCENTILES = ((0.5, "p50"), (0.9, "p90"), (0.99, "p99"))
+
+
+def _quantile_from_counts(counts, total: int, q: float) -> float:
+    """One q-quantile estimate over per-bucket counts against the
+    static HIST_BUCKETS edges: linear interpolation inside the
+    bucket, overflow bucket clamps to the last finite edge.  The
+    single implementation behind ``percentiles_from_counts`` and
+    ``Histogram.percentile`` — they must never diverge."""
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            if i >= len(HIST_BUCKETS):
+                return HIST_BUCKETS[-1]          # overflow bucket
+            lo = HIST_BUCKETS[i - 1] if i > 0 else 0.0
+            frac = (target - (cum - c)) / c if c else 1.0
+            return lo + frac * (HIST_BUCKETS[i] - lo)
+    return HIST_BUCKETS[-1]
+
+
+def percentiles_from_counts(counts) -> Dict[str, float]:
+    """p50/p90/p99 estimates from per-bucket counts.  Shared by
+    ``Histogram.as_dict`` and ``aggregate._merge_hists`` so merged
+    fleet histograms re-derive their quantiles from the merged
+    counts instead of averaging per-worker quantiles (which would be
+    wrong and non-associative)."""
+    counts = [int(c) for c in counts]
+    total = sum(counts)
+    if total <= 0:
+        return {}
+    return {key: _quantile_from_counts(counts, total, q)
+            for q, key in PERCENTILES}
+
+
 class Histogram:
     """Fixed-bucket histogram: ``buckets[i]`` counts observations
     <= HIST_BUCKETS[i]; the final slot is the overflow bucket."""
@@ -105,9 +142,17 @@ class Histogram:
         self.total += 1
         self.sum += v
 
+    def percentile(self, q: float) -> float:
+        """Estimate the q-quantile (0 < q <= 1) from the buckets."""
+        if self.total <= 0:
+            return 0.0
+        return _quantile_from_counts(self.counts, self.total, q)
+
     def as_dict(self) -> Dict[str, object]:
-        return {"counts": list(self.counts), "total": self.total,
-                "sum": self.sum}
+        d: Dict[str, object] = {"counts": list(self.counts),
+                                "total": self.total, "sum": self.sum}
+        d.update(percentiles_from_counts(self.counts))
+        return d
 
 
 class MetricsRegistry:

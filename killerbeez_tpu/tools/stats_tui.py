@@ -11,6 +11,10 @@ frames when piped (``--once`` prints a single frame and exits).
     kb-stats output/                         # local campaign
     kb-stats output/stats.jsonl --interval 2
     kb-stats --manager http://mgr:8650 --campaign 7   # fleet view
+    kb-stats output/ --once --openmetrics    # Prometheus text format
+
+``--once`` exits nonzero with a clear message when the campaign has
+produced no stats yet, so scripts can gate on it.
 """
 
 from __future__ import annotations
@@ -22,7 +26,8 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional
 
-from ..telemetry.metrics import STAGES
+from ..telemetry.metrics import STAGES, percentiles_from_counts
+from ..telemetry.openmetrics import render_snapshot
 from ..telemetry.sink import read_latest_snapshot as read_local
 
 BAR_W = 40
@@ -52,6 +57,27 @@ def _fmt_n(v: float) -> str:
 def _bar(frac: float, width: int = BAR_W) -> str:
     n = int(round(max(0.0, min(1.0, frac)) * width))
     return "#" * n + "-" * (width - n)
+
+
+def _fmt_secs(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:.2f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.1f}ms"
+    return f"{v * 1e6:.0f}us"
+
+
+def _stage_percentiles(snap: Dict[str, Any],
+                       stage: str) -> Dict[str, float]:
+    """p50/p99 for one stage: read from the snapshot when present
+    (new registries emit them), else re-derive from the bucket
+    counts (old snapshots)."""
+    h = snap.get("hists", {}).get(stage)
+    if not h:
+        return {}
+    if "p50" in h:
+        return h
+    return percentiles_from_counts(h.get("counts", []))
 
 
 def render(snap: Dict[str, Any]) -> str:
@@ -114,8 +140,13 @@ def render(snap: Dict[str, Any]) -> str:
         lines.append("  stage split (host-attention seconds):")
         for s, t in sorted(totals.items(), key=lambda kv: -kv[1]):
             if t > 0:
-                lines.append(f"    {s:<15} {_bar(t / acc)} "
-                             f"{t / acc:6.1%}  ({t:.2f}s)")
+                row = (f"    {s:<15} {_bar(t / acc)} "
+                       f"{t / acc:6.1%}  ({t:.2f}s)")
+                p = _stage_percentiles(snap, s)
+                if p:
+                    row += (f"  p50 {_fmt_secs(p['p50'])}"
+                            f" p99 {_fmt_secs(p['p99'])}")
+                lines.append(row)
     return "\n".join(lines)
 
 
@@ -147,19 +178,44 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="with --once: print the raw registry "
                         "snapshot as JSON (CI / scripts — no "
                         "rendering, no TTY assumptions)")
+    p.add_argument("--openmetrics", action="store_true",
+                   help="with --once: print the snapshot in the "
+                        "OpenMetrics text format (the same renderer "
+                        "behind the manager's /metrics; pipe to a "
+                        "node_exporter textfile collector)")
     args = p.parse_args(argv)
     if args.manager and not args.campaign:
         print("error: --manager needs --campaign", file=sys.stderr)
         return 2
-    if args.json and not args.once:
-        print("error: --json needs --once", file=sys.stderr)
+    if (args.json or args.openmetrics) and not args.once:
+        print("error: --json/--openmetrics need --once",
+              file=sys.stderr)
+        return 2
+    if args.json and args.openmetrics:
+        print("error: --json and --openmetrics are mutually "
+              "exclusive", file=sys.stderr)
         return 2
     if args.once:
         snap = _frame(args)
-        if snap is None:
-            print("no stats yet", file=sys.stderr)
+        # an empty dict is as useless as a missing file: scripts gate
+        # on this exit, so "no campaign stats" must be LOUD, not an
+        # all-zero report with exit 0
+        if snap is None or not snap.get("counters"):
+            if args.manager:
+                print(f"error: no fleet stats for campaign "
+                      f"{args.campaign!r} at {args.manager} (no "
+                      f"worker heartbeat yet, or wrong campaign "
+                      f"key)", file=sys.stderr)
+            else:
+                print(f"error: no campaign stats under {args.path!r} "
+                      f"(stats.jsonl/fuzzer_stats missing or empty "
+                      f"— is the fuzzer running with stats enabled, "
+                      f"i.e. without --no-stats?)", file=sys.stderr)
             return 1
-        print(json.dumps(snap) if args.json else render(snap))
+        if args.openmetrics:
+            sys.stdout.write(render_snapshot(snap))
+        else:
+            print(json.dumps(snap) if args.json else render(snap))
         return 0
     try:
         while True:

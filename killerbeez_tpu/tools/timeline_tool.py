@@ -13,6 +13,14 @@ debugging session.
     kb-timeline output/                 # human report + ANSI lane view
     kb-timeline output/ --json          # machine report
     kb-timeline output/trace.json --width 100 --bubble-ms 5
+    kb-timeline --fleet http://mgr:8650 --campaign 7   # fleet merge
+
+``--fleet`` pulls every worker's forwarded event stream (plus the
+manager's health/alert records) from ``/api/events/<campaign>`` and
+merges them onto ONE wall-clock axis — the records carry the same
+wall timestamps the local overlay anchors on ``wall_t0``, so a
+two-worker campaign reads as one timeline: who found what when,
+which worker went dead, when the alert fired.
 
 Not to be confused with ``kb-trace`` (the host-tier ptrace edge
 harvester, ``tools/tracer.py`` / ``native/``): kb-trace records what a
@@ -26,8 +34,10 @@ import argparse
 import json
 import os
 import sys
+import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..telemetry.aggregate import merge_events
 from ..telemetry.events import read_events
 from ..telemetry.sink import parse_fuzzer_stats
 from ..telemetry.trace import load_chrome_trace
@@ -128,11 +138,26 @@ def stage_report(spans: List[Dict[str, Any]]
     out: Dict[str, Dict[str, float]] = {}
     for s in spans:
         by.setdefault(s["name"], []).append((s["t0"], s["t1"]))
+    def _q(durs, permille):
+        # nearest-rank index ceil(q*n)-1 in exact integer math (a
+        # floor over n-1 would bias every tail percentile LOW — with
+        # 2 spans the p99 would report the MINIMUM duration)
+        n = len(durs)
+        rank = -(-permille * n // 1000)          # ceil
+        return durs[min(n - 1, max(0, rank - 1))]
+
     for name, ivals in by.items():
+        durs = sorted(t1 - t0 for t0, t1 in ivals)
         out[name] = {
-            "total_us": sum(t1 - t0 for t0, t1 in ivals),
+            "total_us": sum(durs),
             "count": len(ivals),
             "occupancy": _union_len(ivals) / window,
+            # span-duration quantiles (nearest rank) — the per-stage
+            # latency shape, matching the registry histograms'
+            # p50/p90/p99 keys
+            "p50_us": _q(durs, 500),
+            "p90_us": _q(durs, 900),
+            "p99_us": _q(durs, 990),
         }
     return out, window
 
@@ -223,6 +248,114 @@ def reconcile(events: List[Dict[str, Any]],
     return out
 
 
+# -- fleet mode ---------------------------------------------------------
+
+#: glyph per event type in the fleet lane view (one row per worker)
+FLEET_GLYPHS = {"new_path": ".", "crash": "C", "hang": "H",
+                "plateau": "P", "crack_injection": "K",
+                "sync_round": "s", "scheduler_pick": "r",
+                "flush": "f", "worker_stale": "S",
+                "worker_dead": "D", "worker_returned": "R",
+                "alert": "A"}
+
+
+def fetch_fleet_events(manager_url: str, campaign: str
+                       ) -> List[Dict[str, Any]]:
+    """Drain ``/api/events/<campaign>`` through its cursor and return
+    ONE merged, deduped, total-ordered stream with each record tagged
+    by its origin worker (``merge_events`` — the same fold the
+    heartbeat aggregates use)."""
+    events: List[Dict[str, Any]] = []
+    since = 0
+    while True:
+        url = (f"{manager_url}/api/events/{campaign}"
+               f"?since={since}")
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            body = json.loads(resp.read())
+        rows = body.get("events") or []
+        if not rows:
+            break
+        for r in rows:
+            rec = r.get("event")
+            if not isinstance(rec, dict):
+                continue
+            rec = dict(rec)
+            rec.setdefault("worker", r.get("worker", "?"))
+            events.append(rec)
+        latest = int(body.get("latest", since))
+        if latest <= since:
+            break
+        since = latest
+    return merge_events(events)
+
+
+def fleet_report(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-worker and per-type summary over the merged wall-clock
+    stream."""
+    counts: Dict[str, int] = {}
+    by_worker: Dict[str, Dict[str, int]] = {}
+    ts = [float(e.get("t", 0.0)) for e in events]
+    for e in events:
+        t = e.get("type", "?")
+        counts[t] = counts.get(t, 0) + 1
+        w = str(e.get("worker", "?"))
+        by_worker.setdefault(w, {})
+        by_worker[w][t] = by_worker[w].get(t, 0) + 1
+    active_alerts = {}
+    for e in events:                     # stream order = final state
+        if e.get("type") == "alert" and e.get("alert"):
+            active_alerts[e["alert"]] = bool(e.get("active"))
+    return {
+        "total": len(events),
+        "t0": min(ts) if ts else 0.0,
+        "t1": max(ts) if ts else 0.0,
+        "window_s": (max(ts) - min(ts)) if ts else 0.0,
+        "counts": counts,
+        "workers": by_worker,
+        "active_alerts": sorted(a for a, on in active_alerts.items()
+                                if on),
+    }
+
+
+def render_fleet(report: Dict[str, Any],
+                 events: List[Dict[str, Any]],
+                 width: int = 72) -> str:
+    """One wall-clock axis, one lane per worker (the manager's
+    health/alert records ride the ``_manager`` lane)."""
+    lines: List[str] = []
+    head = "kb-timeline — fleet event timeline (merged wall clock)"
+    lines.append(head)
+    lines.append("=" * len(head))
+    lines.append(
+        f"  window  : {report['window_s']:.1f}s  "
+        f"({report['total']} events, "
+        f"{len(report['workers'])} streams)")
+    pairs = ", ".join(f"{k} x{v}" for k, v in
+                      sorted(report["counts"].items()))
+    lines.append(f"  events  : {pairs}")
+    if report["active_alerts"]:
+        lines.append("  alerts  : "
+                     + ", ".join(report["active_alerts"])
+                     + " ACTIVE")
+    t0, window = report["t0"], max(report["window_s"], 1e-9)
+    scale = (width - 1) / window
+    label_w = max([len(w) for w in report["workers"]] + [6])
+    glyphs = "  ".join(f"{g}={n}" for n, g in FLEET_GLYPHS.items())
+    lines.append(f"  lanes ({glyphs}):")
+    for w in sorted(report["workers"]):
+        cells = [" "] * width
+        for e in events:
+            if str(e.get("worker", "?")) != w:
+                continue
+            i = int((float(e.get("t", 0.0)) - t0) * scale)
+            if 0 <= i < width:
+                cells[i] = FLEET_GLYPHS.get(e.get("type"), "#")
+        lines.append(f"  {w:<{label_w}} |{''.join(cells)}|")
+    lines.append(f"  {'':<{label_w}} |0{' ' * (width - 2)}|  "
+                 f"({report['window_s']:.1f}s window)")
+    return "\n".join(lines)
+
+
 # -- rendering ----------------------------------------------------------
 
 
@@ -292,10 +425,14 @@ def render(report: Dict[str, Any], lanes: List[str]) -> str:
             if name == "in_flight":
                 continue
             frac = v["total_us"] / acc
-            lines.append(
+            row = (
                 f"    {name:<15} {_fmt_us(v['total_us']):>10}  "
                 f"{frac:6.1%}  ({int(v['count'])} spans, "
                 f"{v['occupancy']:.1%} occupancy)")
+            if "p50_us" in v:
+                row += (f"  p50 {_fmt_us(v['p50_us'])}"
+                        f" p99 {_fmt_us(v['p99_us'])}")
+            lines.append(row)
         cp = report.get("critical_path")
         if cp:
             lines.append(f"  critical path : {cp} "
@@ -411,7 +548,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "4x the median dispatch gap)")
     p.add_argument("--no-lanes", action="store_true",
                    help="skip the ANSI lane view")
+    p.add_argument("--fleet", metavar="MANAGER_URL",
+                   help="merge the fleet's event streams from a "
+                        "manager (/api/events/<campaign>) onto one "
+                        "wall-clock axis instead of reading a local "
+                        "output dir; needs --campaign")
+    p.add_argument("--campaign",
+                   help="campaign key for --fleet (job id)")
     args = p.parse_args(argv)
+
+    if args.fleet:
+        if not args.campaign:
+            print("error: --fleet needs --campaign", file=sys.stderr)
+            return 2
+        try:
+            events = fetch_fleet_events(args.fleet, args.campaign)
+        except (OSError, ValueError) as e:
+            print(f"error: fleet event fetch from {args.fleet} "
+                  f"failed: {e}", file=sys.stderr)
+            return 1
+        if not events:
+            print(f"error: no events for campaign "
+                  f"{args.campaign!r} at {args.fleet}",
+                  file=sys.stderr)
+            return 1
+        report = fleet_report(events)
+        if args.json:
+            print(json.dumps({"report": report, "events": events},
+                             indent=2))
+        else:
+            print(render_fleet(report, events, width=args.width))
+        return 0
 
     path = args.path
     if os.path.isfile(path):

@@ -1,0 +1,294 @@
+"""Strict pure-python OpenMetrics 1.0 text-format parser — the
+conformance oracle for the manager's ``/metrics`` endpoint and
+``kb-stats --openmetrics`` (tests + the CI fleet lane import it; it
+deliberately has NO dependency on killerbeez_tpu so it can't share a
+bug with the renderer it checks).
+
+``parse_openmetrics(text)`` returns ``{family: {"type": ...,
+"help": ..., "samples": [(sample_name, labels_dict, value)]}}`` and
+raises ``ValueError`` on any violation of the checks below:
+
+  * exposition ends with exactly one ``# EOF`` as its final line
+  * every line is a ``# TYPE`` / ``# HELP`` / ``# UNIT`` metadata
+    line or a sample
+  * metric/label names match the spec charsets
+  * one TYPE per family, declared before its samples; families are
+    contiguous (no interleaving)
+  * samples carry only the suffixes their family's type allows
+    (counter -> ``_total``/``_created``; histogram -> ``_bucket`` /
+    ``_count`` / ``_sum`` / ``_created``; gauge -> bare name)
+  * label syntax: ``name="value"`` with ``\\\\``/``\\"``/``\\n``
+    escapes, no duplicate label names, no duplicate name+labelset
+    samples within a family
+  * values parse as floats; counter totals are >= 0 and not NaN
+  * histograms: every labelset has an ``le="+Inf"`` bucket,
+    cumulative bucket counts are non-decreasing in ``le`` order, and
+    ``_count`` equals the ``+Inf`` bucket
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+METRIC_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+
+_TYPES = ("counter", "gauge", "histogram", "summary", "unknown",
+          "info", "stateset", "gaugehistogram")
+
+_SUFFIXES = {
+    "counter": ("_total", "_created"),
+    "histogram": ("_bucket", "_count", "_sum", "_created"),
+    "gauge": ("",),
+    "unknown": ("",),
+    "info": ("_info",),
+}
+
+
+def _unescape(v: str) -> str:
+    out = []
+    i = 0
+    while i < len(v):
+        ch = v[i]
+        if ch == "\\":
+            if i + 1 >= len(v):
+                raise ValueError(f"dangling escape in {v!r}")
+            nxt = v[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+            elif nxt == '"':
+                out.append('"')
+            elif nxt == "n":
+                out.append("\n")
+            else:
+                raise ValueError(f"bad escape \\{nxt} in {v!r}")
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(body: str) -> Dict[str, str]:
+    labels: Dict[str, str] = {}
+    i = 0
+    while i < len(body):
+        m = re.match(r"([a-zA-Z_][a-zA-Z0-9_]*)=\"", body[i:])
+        if not m:
+            raise ValueError(f"bad label syntax at {body[i:]!r}")
+        name = m.group(1)
+        i += m.end()
+        j = i
+        while j < len(body):
+            if body[j] == "\\":
+                j += 2
+                continue
+            if body[j] == '"':
+                break
+            j += 1
+        else:
+            raise ValueError(f"unterminated label value in {body!r}")
+        value = _unescape(body[i:j])
+        if name in labels:
+            raise ValueError(f"duplicate label {name!r}")
+        labels[name] = value
+        i = j + 1
+        if i < len(body):
+            if body[i] != ",":
+                raise ValueError(
+                    f"expected ',' between labels in {body!r}")
+            i += 1
+    return labels
+
+
+def _parse_value(tok: str) -> float:
+    try:
+        return float(tok)
+    except ValueError:
+        raise ValueError(f"bad sample value {tok!r}")
+
+
+def _split_sample(line: str) -> Tuple[str, Dict[str, str], float]:
+    m = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)", line)
+    if not m:
+        raise ValueError(f"bad sample name in {line!r}")
+    name = m.group(1)
+    rest = line[m.end():]
+    labels: Dict[str, str] = {}
+    if rest.startswith("{"):
+        depth_end = -1
+        j = 1
+        in_q = False
+        while j < len(rest):
+            ch = rest[j]
+            if in_q:
+                if ch == "\\":
+                    j += 2
+                    continue
+                if ch == '"':
+                    in_q = False
+            elif ch == '"':
+                in_q = True
+            elif ch == "}":
+                depth_end = j
+                break
+            j += 1
+        if depth_end < 0:
+            raise ValueError(f"unterminated label set in {line!r}")
+        labels = _parse_labels(rest[1:depth_end])
+        rest = rest[depth_end + 1:]
+    if not rest.startswith(" "):
+        raise ValueError(f"missing value separator in {line!r}")
+    toks = rest.strip().split(" ")
+    if len(toks) not in (1, 2):      # optional timestamp
+        raise ValueError(f"trailing garbage in {line!r}")
+    return name, labels, _parse_value(toks[0])
+
+
+def _family_for(name: str, labels: Dict[str, str],
+                family: str, ftype: str) -> bool:
+    """Does this sample name belong to (family, ftype)?"""
+    for suffix in _SUFFIXES.get(ftype, ("",)):
+        if name == family + suffix:
+            return True
+    return False
+
+
+def _check_histogram(family: str,
+                     samples: List[Tuple[str, Dict[str, str], float]]
+                     ) -> None:
+    by_set: Dict[tuple, Dict[str, object]] = {}
+    for name, labels, value in samples:
+        key = tuple(sorted((k, v) for k, v in labels.items()
+                           if k != "le"))
+        slot = by_set.setdefault(key, {"buckets": [], "count": None,
+                                       "sum": None})
+        if name == family + "_bucket":
+            if "le" not in labels:
+                raise ValueError(
+                    f"{family}: bucket without le label")
+            le = labels["le"]
+            slot["buckets"].append(
+                (math.inf if le == "+Inf" else float(le), value))
+        elif name == family + "_count":
+            slot["count"] = value
+        elif name == family + "_sum":
+            slot["sum"] = value
+    for key, slot in by_set.items():
+        buckets = sorted(slot["buckets"])
+        if not buckets or buckets[-1][0] != math.inf:
+            raise ValueError(
+                f"{family}{dict(key)}: missing le=\"+Inf\" bucket")
+        prev = -1.0
+        for le, v in buckets:
+            if v < prev:
+                raise ValueError(
+                    f"{family}{dict(key)}: bucket counts decrease "
+                    f"at le={le}")
+            prev = v
+        if slot["count"] is not None \
+                and slot["count"] != buckets[-1][1]:
+            raise ValueError(
+                f"{family}{dict(key)}: _count != +Inf bucket")
+        if slot["count"] is not None and slot["sum"] is None:
+            raise ValueError(f"{family}{dict(key)}: _count without "
+                             f"_sum")
+
+
+def parse_openmetrics(text: str) -> Dict[str, Dict]:
+    if not text.endswith("\n"):
+        raise ValueError("exposition must end with a newline")
+    lines = text.split("\n")[:-1]
+    if not lines or lines[-1] != "# EOF":
+        raise ValueError("exposition must end with '# EOF'")
+    if "# EOF" in lines[:-1]:
+        raise ValueError("'# EOF' before the final line")
+    families: Dict[str, Dict] = {}
+    closed: set = set()
+    current: Optional[str] = None
+    for line in lines[:-1]:
+        if not line:
+            raise ValueError("blank line in exposition")
+        if line.startswith("#"):
+            m = re.match(r"# (TYPE|HELP|UNIT) "
+                         r"([a-zA-Z_:][a-zA-Z0-9_:]*)(?: (.*))?\Z",
+                         line)
+            if not m:
+                raise ValueError(f"bad metadata line {line!r}")
+            kind, name, payload = m.groups()
+            if name in closed:
+                raise ValueError(f"family {name} interleaved")
+            if kind == "TYPE":
+                slot = families.get(name)
+                if slot is not None and slot["type"] is not None:
+                    raise ValueError(f"duplicate TYPE for {name}")
+                if payload not in _TYPES:
+                    raise ValueError(f"unknown type {payload!r}")
+                if current is not None and current != name:
+                    closed.add(current)
+                slot = families.setdefault(
+                    name, {"type": None, "help": None,
+                           "samples": [], "_seen": set()})
+                slot["type"] = payload
+                current = name
+            else:
+                # HELP/UNIT may precede TYPE within the same block
+                if current is not None and current != name:
+                    closed.add(current)
+                current = name
+                slot = families.setdefault(
+                    name, {"type": None, "help": None,
+                           "samples": [], "_seen": set()})
+                if kind == "HELP":
+                    if slot["help"] is not None:
+                        raise ValueError(f"duplicate HELP for {name}")
+                    slot["help"] = payload or ""
+            continue
+        name, labels, value = _split_sample(line)
+        if not METRIC_NAME_RE.match(name):
+            raise ValueError(f"bad metric name {name!r}")
+        for ln in labels:
+            if not LABEL_NAME_RE.match(ln):
+                raise ValueError(f"bad label name {ln!r}")
+        if current is None:
+            raise ValueError(
+                f"sample {name!r} before any TYPE line")
+        fam = families[current]
+        if not _family_for(name, labels, current, fam["type"]):
+            raise ValueError(
+                f"sample {name!r} does not belong to family "
+                f"{current!r} (type {fam['type']})")
+        if fam["type"] == "counter":
+            if name.endswith("_total") and \
+                    (value < 0 or math.isnan(value)):
+                raise ValueError(
+                    f"counter {name} value {value} invalid")
+        key = (name, tuple(sorted(labels.items())))
+        if key in fam["_seen"]:
+            raise ValueError(f"duplicate sample {key}")
+        fam["_seen"].add(key)
+        fam["samples"].append((name, labels, value))
+    for fname, fam in families.items():
+        if fam["type"] is None:
+            raise ValueError(f"family {fname} has HELP but no TYPE")
+        if fam["type"] == "histogram":
+            _check_histogram(fname, fam["samples"])
+        fam.pop("_seen", None)
+    return families
+
+
+def sample_value(families: Dict[str, Dict], family: str,
+                 sample_name: str,
+                 labels: Optional[Dict[str, str]] = None
+                 ) -> Optional[float]:
+    """Convenience lookup for assertions."""
+    fam = families.get(family)
+    if fam is None:
+        return None
+    want = labels or {}
+    for name, lab, value in fam["samples"]:
+        if name == sample_name and lab == want:
+            return value
+    return None

@@ -101,6 +101,42 @@ def test_histogram_matches_linear_scan():
         assert h.counts[i] == want, f"bucket {i}"
 
 
+def test_histogram_percentile_estimates():
+    """p50/p90/p99 from bucket interpolation: every estimate lands
+    within its observation's bucket, as_dict carries the keys, and
+    percentiles_from_counts (the merge path) agrees."""
+    from killerbeez_tpu.telemetry.metrics import (
+        HIST_BUCKETS, percentiles_from_counts,
+    )
+    h = Histogram()
+    for _ in range(90):
+        h.observe(1e-4)                  # bucket around 1e-4
+    for _ in range(10):
+        h.observe(0.5)                   # slow tail
+    d = h.as_dict()
+    assert set(d) >= {"p50", "p90", "p99"}
+    # p50/p90 in the fast bucket, p99 in the slow one
+    assert 6.4e-05 < d["p50"] <= 1.28e-4
+    assert 6.4e-05 < d["p90"] <= 1.28e-4
+    assert 0.25 < d["p99"] <= 0.524288
+    assert d["p50"] <= d["p90"] <= d["p99"]
+    assert h.percentile(0.5) == d["p50"]
+    assert percentiles_from_counts(h.counts) == {
+        "p50": d["p50"], "p90": d["p90"], "p99": d["p99"]}
+    # overflow-bucket observations clamp to the last finite edge
+    h2 = Histogram()
+    h2.observe(1e9)
+    assert h2.as_dict()["p99"] == HIST_BUCKETS[-1]
+    # empty histogram: no percentile keys, percentile() is 0
+    assert "p50" not in Histogram().as_dict()
+    assert Histogram().percentile(0.5) == 0.0
+    # merged hists re-derive from merged counts (aggregate path)
+    m = merge_two({"hists": {"x": h.as_dict()}},
+                  {"hists": {"x": h.as_dict()}})
+    assert m["hists"]["x"]["total"] == 200
+    assert m["hists"]["x"]["p50"] == d["p50"]  # same distribution
+
+
 # -- registry + stage timer -------------------------------------------
 
 
@@ -171,6 +207,14 @@ def _rand_snapshot(rng):
                       "sum": rng.uniform(0, 5)}
                   for n in rng.sample(["execute", "triage"],
                                       rng.randrange(0, 3))},
+        # fleet health fields ride snapshots too (the /api/fleet
+        # merged view) and must fold associatively like the rest
+        "health": {w: {"status": rng.choice(["healthy", "stale",
+                                             "dead"]),
+                       "first_seen": rng.uniform(0, 100),
+                       "last_seen": rng.uniform(100, 200)}
+                   for w in rng.sample(["w1", "w2", "w3"],
+                                       rng.randrange(0, 3))},
     }
 
 
@@ -189,6 +233,7 @@ def _assert_snap_equal(a, b):
         assert a["hists"][k]["total"] == b["hists"][k]["total"]
     assert a.get("t") == pytest.approx(b.get("t"))
     assert a.get("start_time") == pytest.approx(b.get("start_time"))
+    assert a.get("health", {}) == b.get("health", {})
 
 
 def test_merge_is_associative_and_commutative():
@@ -238,6 +283,33 @@ def test_merge_semantics():
     assert m["rates"]["execs"]["rate"] == pytest.approx(800.0)
     assert m["rates"]["execs"]["weight"] == pytest.approx(1.5)
     assert merge([]) is None
+
+
+def test_merge_health_semantics():
+    """Per worker, the newest last_seen supplies the status (tie:
+    worse status wins), first_seen min's, last_seen max's."""
+    from killerbeez_tpu.telemetry import merge_health
+    a = {"health": {
+        "w1": {"status": "healthy", "first_seen": 10.0,
+               "last_seen": 100.0},
+        "w2": {"status": "dead", "first_seen": 5.0,
+               "last_seen": 50.0}}}
+    b = {"health": {
+        "w1": {"status": "stale", "first_seen": 20.0,
+               "last_seen": 90.0},      # older: loses the status
+        "w3": {"status": "healthy", "first_seen": 1.0,
+               "last_seen": 60.0}}}
+    m = merge_two(a, b)["health"]
+    assert m["w1"]["status"] == "healthy"     # newest record wins
+    assert m["w1"]["first_seen"] == 10.0      # field-wise min
+    assert m["w1"]["last_seen"] == 100.0      # field-wise max
+    assert m["w2"]["status"] == "dead"        # one-sided copies
+    assert m["w3"]["status"] == "healthy"
+    # same last_seen: the worse status wins (dead > healthy)
+    t = {"status": "healthy", "last_seen": 10.0}
+    d = {"status": "dead", "last_seen": 10.0}
+    assert merge_health({"w": t}, {"w": d})["w"]["status"] == "dead"
+    assert merge_health({"w": d}, {"w": t})["w"]["status"] == "dead"
 
 
 # -- sink: atomicity + formats ----------------------------------------
@@ -649,6 +721,86 @@ def test_event_log_write_failure_degrades(tmp_path, monkeypatch):
     assert log.last_times["new_path"] == rec["t"]
 
 
+def test_event_log_rotation_caps_size_and_keeps_seq(tmp_path):
+    """--events-max-mb: the live file rotates to events.jsonl.1 when
+    it crosses the cap, seq stays monotone across rotations, readers
+    see one seamless stream (rotated tail first) and a resumed log
+    anchors past the rotated generation."""
+    d = str(tmp_path)
+    log = EventLog(d, max_bytes=600)     # a few records per file
+    for i in range(30):
+        log.emit("new_path", md5="%032x" % i)
+    log.close()
+    assert log.rotations >= 1
+    live = os.path.join(d, "events.jsonl")
+    rotated = live + ".1"
+    assert os.path.exists(rotated)
+    # both generations stay under ~the cap (the live file may not
+    # exist at all right after a rotation on the final record)
+    assert os.path.getsize(rotated) < 600 + 200
+    if os.path.exists(live):
+        assert os.path.getsize(live) < 600 + 200
+    # the combined stream is seq-ordered and gapless over the last
+    # two generations
+    seqs = [r["seq"] for r in read_events(d)]
+    assert seqs == sorted(seqs)
+    assert seqs[-1] == 29
+    assert seqs == list(range(seqs[0], 30))
+    # resume continues past the newest record, even if the live file
+    # was JUST rotated (absent/empty) — the anchor falls back to the
+    # .1 tail
+    if os.path.exists(live):
+        os.replace(live, rotated)
+    log2 = EventLog(d)
+    assert log2.next_seq == 30
+    log2.emit("crash", md5="c" * 32, unique_crashes=1)
+    log2.close()
+    assert last_event_seq(d) == 30
+    # a FRESH campaign clears both generations
+    log3 = EventLog(d, fresh=True)
+    log3.emit("new_path", md5="f" * 32)
+    log3.close()
+    assert not os.path.exists(rotated)
+    assert [r["seq"] for r in read_events(d)] == [0]
+
+
+def test_heartbeat_forwarder_survives_rotation(tmp_path,
+                                               monkeypatch):
+    """A rotation between beats (live file shrinks below the cursor)
+    drains the rotated generation's tail, then continues on the
+    fresh live file — no terminal event is lost."""
+    from killerbeez_tpu.manager import worker as w
+    out = tmp_path / "o"
+    out.mkdir()
+    (out / "stats.jsonl").write_text(json.dumps(_snap(1)) + "\n")
+    posts = []
+    monkeypatch.setattr(
+        w, "_request_retry",
+        lambda url, payload=None, **kw: posts.append((url, payload)))
+    hb = w.Heartbeat("http://mgr", "7", "w1", str(out), interval=99)
+    log = EventLog(str(out), max_bytes=1 << 20)  # no auto-rotation
+    log.emit("crash", md5="a" * 32, unique_crashes=1)
+    hb.beat()
+    log.emit("crash", md5="b" * 32, unique_crashes=2)
+    log._rotate()                        # rotate with b unforwarded
+    log.emit("crash", md5="c" * 32, unique_crashes=3)
+    log.close()
+    hb.beat()
+    sent = [e["md5"] for _, p in posts if p and "events" in p
+            for e in p["events"]]
+    assert sent == ["a" * 32, "b" * 32, "c" * 32]
+    assert hb.events_sent == 3
+    # a rotation that lands BEFORE the first beat (startup crash
+    # storm) is drained too: a fresh Heartbeat forwards the .1
+    # generation up front, then the live file
+    posts.clear()
+    hb2 = w.Heartbeat("http://mgr", "7", "w2", str(out), interval=99)
+    hb2.beat()
+    sent = [e["md5"] for _, p in posts if p and "events" in p
+            for e in p["events"]]
+    assert sent == ["a" * 32, "b" * 32, "c" * 32]
+
+
 def _rand_events(rng, worker):
     return [{"v": 1, "seq": i, "t": rng.uniform(0, 100),
              "worker": worker,
@@ -727,6 +879,25 @@ def _chrome_doc(spans, instants=()):
     evs.sort(key=lambda e: e["ts"])
     return {"traceEvents": evs, "displayTimeUnit": "ms",
             "otherData": {"wall_t0": 1000.0}}
+
+
+def test_timeline_stage_quantiles_nearest_rank():
+    """p50/p90/p99 over span durations use ceil-based nearest rank —
+    a floor over n-1 would report the MINIMUM as the p99 of a 2-span
+    stage."""
+    from killerbeez_tpu.tools.timeline_tool import stage_report
+    spans = [{"name": "s", "tid": 0, "t0": 0.0, "t1": 100.0},
+             {"name": "s", "tid": 0, "t0": 200.0, "t1": 1100.0}]
+    st, _ = stage_report(spans)
+    assert st["s"]["p50_us"] == 100.0
+    assert st["s"]["p90_us"] == 900.0    # the tail, not the min
+    assert st["s"]["p99_us"] == 900.0
+    spans10 = [{"name": "s", "tid": 0, "t0": 0.0, "t1": float(i + 1)}
+               for i in range(10)]
+    st, _ = stage_report(spans10)
+    assert st["s"]["p50_us"] == 5.0
+    assert st["s"]["p90_us"] == 9.0
+    assert st["s"]["p99_us"] == 10.0     # ceil(9.9)-1 = the max
 
 
 def test_timeline_detects_host_bound_bubble(tmp_path):

@@ -283,3 +283,67 @@ def test_picker_batched_matches_single_exec(corpus_bin, tmp_path):
     np.testing.assert_array_equal(batched, single)
     drv.cleanup()
     instr.cleanup()
+
+
+def test_kb_stats_once_exits_nonzero_without_stats(tmp_path, capsys):
+    """Scripts gate on ``kb-stats --once``: a missing or empty
+    campaign (no stats.jsonl/fuzzer_stats, or a vacuous snapshot)
+    must exit nonzero with a clear message — never an all-zero
+    report with exit 0."""
+    from killerbeez_tpu.tools.stats_tui import main as stats_main
+    # missing path
+    assert stats_main([str(tmp_path / "nope"), "--once"]) == 1
+    assert "no campaign stats" in capsys.readouterr().err
+    # dir exists, no stats files
+    d = tmp_path / "out"
+    d.mkdir()
+    assert stats_main([str(d), "--once"]) == 1
+    # stats.jsonl present but vacuous ({} tail line) — the bug this
+    # satellite pinned: it used to print an empty report and exit 0
+    (d / "stats.jsonl").write_text("{}\n")
+    assert stats_main([str(d), "--once"]) == 1
+    err = capsys.readouterr().err
+    assert "fuzzer_stats" in err and str(d) in err
+    # --json mode gates identically
+    assert stats_main([str(d), "--once", "--json"]) == 1
+    capsys.readouterr()
+    # a real snapshot renders and exits 0
+    snap = {"t": 10.0, "start_time": 0.0, "elapsed": 10.0,
+            "counters": {"execs": 128}, "gauges": {}, "rates": {},
+            "derived": {"execs_per_sec": 12.8,
+                        "execs_per_sec_ema": 0.0}}
+    (d / "stats.jsonl").write_text(json.dumps(snap) + "\n")
+    assert stats_main([str(d), "--once"]) == 0
+    assert "execs : 128" in capsys.readouterr().out
+
+
+def test_kb_stats_openmetrics_mode(tmp_path, capsys):
+    """``kb-stats --once --openmetrics`` renders the snapshot in the
+    OpenMetrics text format (validated by the strict parser the CI
+    fleet lane uses) and stage rows gain p50/p99 in the TUI."""
+    import sys as _sys
+    _sys.path.insert(0, os.path.dirname(__file__))
+    from openmetrics_parser import parse_openmetrics, sample_value
+
+    from killerbeez_tpu.telemetry import MetricsRegistry
+    from killerbeez_tpu.tools.stats_tui import main as stats_main
+    reg = MetricsRegistry()
+    reg.count("execs", 2048)
+    reg.observe("triage", 0.004)
+    reg.observe("triage", 0.012)
+    d = tmp_path / "out"
+    d.mkdir()
+    (d / "stats.jsonl").write_text(
+        json.dumps(reg.snapshot()) + "\n")
+    assert stats_main([str(d), "--once", "--openmetrics"]) == 0
+    fams = parse_openmetrics(capsys.readouterr().out)
+    assert sample_value(fams, "kbz_execs", "kbz_execs_total") == 2048
+    assert fams["kbz_triage_duration_seconds"]["type"] == "histogram"
+    # rendered TUI frame surfaces the stage quantiles
+    assert stats_main([str(d), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "p50" in out and "p99" in out
+    # flag plumbing: --openmetrics needs --once, excludes --json
+    assert stats_main([str(d), "--openmetrics"]) == 2
+    assert stats_main([str(d), "--once", "--openmetrics",
+                       "--json"]) == 2

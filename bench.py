@@ -499,6 +499,93 @@ def bench_crack(targets=None, batch=256, budget_execs=131072,
                      "solver_injected", 0)))
 
 
+def bench_descend(targets=None, batch=256, budget_execs=65536,
+                  plateau=4, chunk_batches=8, descend_budget=16,
+                  descend_lanes=256, gate=False):
+    """--descend: gradient-search A/B lane.  Same blind-seed regime as
+    --crack, but on the CHECKSUM universes (imgparse/tlvstack) where
+    the exact solver's ceiling is known (36/68 and 173/186 static
+    edges): run crack-only vs crack+descend and report static-EDGE
+    coverage.  ``gate=True`` exits nonzero unless the descend lane
+    exceeds the solver ceiling (coverage the exact tier provably
+    cannot reach, so any pass proves the search tier earned edges).
+    """
+    import json as _json
+    import shutil
+    import numpy as np
+    from killerbeez_tpu.drivers.factory import driver_factory
+    from killerbeez_tpu.fuzzer.crack import BranchCracker
+    from killerbeez_tpu.fuzzer.loop import Fuzzer
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    from killerbeez_tpu.models import targets as targets_mod
+    from killerbeez_tpu.models import targets_cgc  # noqa: F401
+    from killerbeez_tpu.mutators.factory import mutator_factory
+
+    #: the exact solver's ceiling per target (solved edges; PR 4) —
+    #: the descend lane must END ABOVE it
+    floors = {"imgparse_vm": 36, "tlvstack_vm": 173}
+    ok = True
+    for target in (targets or ("imgparse_vm", "tlvstack_vm")):
+        prog = targets_mod.get_target(target)
+        slots = np.asarray(prog.edge_slot)
+        for mode in ("crack", "descend"):
+            instr = instrumentation_factory(
+                "jit_harness", _json.dumps(
+                    {"target": target, "novelty": "throughput"}))
+            mut = mutator_factory("havoc", '{"seed": 11}',
+                                  b"\x00" * 8)
+            drv = driver_factory("file", None, instr, mut)
+            out = os.path.join(REPO, "bench_out",
+                               f"descend_{target}_{mode}")
+            shutil.rmtree(out, ignore_errors=True)
+            fz = Fuzzer(drv, output_dir=out, batch_size=batch,
+                        write_findings=False)
+            # crank the per-crack caps: the lane's job is sweeping a
+            # whole static universe within a bounded exec budget, not
+            # bounding a live campaign's pause
+            fz.cracker = BranchCracker(
+                prog, plateau_batches=plateau,
+                descend=(descend_budget if mode == "descend" else 0),
+                descend_lanes=descend_lanes,
+                max_solves=512, max_descends=8)
+            t0 = time.time()
+            while fz.stats.iterations < budget_execs:
+                fz.run(fz.stats.iterations + chunk_batches * batch)
+            dt = time.time() - t0
+            vb = np.asarray(instr.virgin_bits)
+            covered = set(np.flatnonzero(vb != 0xFF).tolist())
+            edges_covered = int(sum(1 for s in slots
+                                    if int(s) in covered))
+            reg = fz.telemetry.registry
+            emit(f"descend-{mode}",
+                 f"gradient-search {mode} on {target} (-b {batch}, "
+                 f"plateau {plateau}, blind 8-byte seed)",
+                 fz.stats.iterations / dt if dt else 0.0,
+                 target=target,
+                 edges_covered=edges_covered,
+                 edges_total=int(prog.n_edges),
+                 solver_ceiling=floors.get(target),
+                 execs=fz.stats.iterations,
+                 crashes=fz.stats.crashes,
+                 solver_solved=int(reg.counters.get(
+                     "solver_solved", 0)),
+                 search_attempts=int(reg.counters.get(
+                     "search_attempts", 0)),
+                 search_descended=int(reg.counters.get(
+                     "search_descended", 0)),
+                 search_exhausted=int(reg.counters.get(
+                     "search_exhausted", 0)))
+            if mode == "descend" and target in floors \
+                    and edges_covered <= floors[target]:
+                print(f"FAIL: {target} descend lane covered "
+                      f"{edges_covered} static edges <= solver "
+                      f"ceiling {floors[target]}", file=sys.stderr)
+                ok = False
+    return 0 if (ok or not gate) else 1
+
+
 def bench_multichip_smoke():
     """Config 5: sharded step on a virtual 8-device CPU mesh, run in a
     subprocess (the driver env exposes one real chip; see
@@ -654,6 +741,35 @@ def main():
         bench_crack(targets=tgts or None, batch=batch,
                     budget_execs=budget)
         return 0
+
+    if "--descend" in sys.argv[1:]:
+        # gradient-search A/B mode (checksum universes):
+        #   python bench.py --descend [target ...] [-b BATCH]
+        #       [-n EXECS] [--budget DISPATCHES] [--gate]
+        rest = [a for a in sys.argv[1:] if a != "--descend"]
+        gate = "--gate" in rest
+        if gate:
+            rest.remove("--gate")
+        batch, budget, dbudget, tgts = 256, 65536, 16, []
+        j = 0
+        while j < len(rest):
+            if rest[j] == "-b":
+                batch = int(rest[j + 1]); j += 2
+            elif rest[j] == "-n":
+                budget = int(rest[j + 1]); j += 2
+            elif rest[j] == "--budget":
+                dbudget = int(rest[j + 1]); j += 2
+            else:
+                tgts.append(rest[j]); j += 1
+        from killerbeez_tpu.models.targets import target_names
+        bad = [t for t in tgts if t not in target_names()]
+        if bad:
+            print(f"error: unknown target(s) {bad} "
+                  f"(choose from {target_names()})", file=sys.stderr)
+            return 2
+        return bench_descend(targets=tgts or None, batch=batch,
+                             budget_execs=budget,
+                             descend_budget=dbudget, gate=gate)
 
     if "--trace-overhead" in sys.argv[1:]:
         # flight-recorder cost mode: optional trailing args override

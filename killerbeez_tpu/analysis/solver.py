@@ -388,6 +388,28 @@ class SolveResult:
         return d
 
 
+def unknown_kind(reason: str) -> str:
+    """Stable category of an ``unknown`` reason string — the search
+    tier's intake taxonomy, pinned by regression fixtures so a solver
+    change that silently reshapes the frontier is caught:
+
+      budget    — the path-search / enumeration budget ran out first
+                  (raising --budget may flip the verdict)
+      visit-cap — loop-carried state beyond max_visits passes is not
+                  modeled (checksum-style loops; raising the cap
+                  rarely helps — this is the descent tier's intake)
+      model     — the bounded input model intervened (reads forced
+                  in-bounds, length capped at max_len)
+    """
+    if "budget" in reason:
+        return "budget"
+    if "visit" in reason:
+        return "visit-cap"
+    if "bounded input model" in reason:
+        return "model"
+    return "other"
+
+
 @dataclass
 class _State:
     pc: int

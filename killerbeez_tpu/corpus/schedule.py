@@ -138,7 +138,17 @@ class Scheduler:
     def credit_period(self, active: Optional[list],
                       period: int = 1) -> None:
         """Close one feedback period: decay every arm's stats and
-        charge the period's selection to the arm that generated it."""
+        charge the period's selection to the arm that generated it.
+
+        ``DECAY`` is the PER-BATCH forgetting rate; ``period`` is how
+        many batches this period spanned (the loop's -fb cadence), so
+        the compounded ``DECAY ** period`` keeps an arm's stats
+        half-life a fixed number of EXECUTIONS regardless of how
+        often rotation fires — at the default cadence of 8 this is
+        0.8^8 ~ 0.17 per call, intentionally much stronger than a
+        flat 0.8-per-period would be, not an accidental 9x change.
+        ``min(..., 16)`` only floors the factor (0.8^16 ~ 0.03) so
+        extreme cadences don't flush history to zero in one call."""
         g = self.DECAY ** min(period or 1, 16)
         self.base_stats[0] *= g
         self.base_stats[1] *= g

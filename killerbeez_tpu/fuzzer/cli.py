@@ -104,6 +104,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "inputs (analysis/solver.py) and inject them; "
                         "solve results persist to the corpus store's "
                         "solver.json so resumes don't re-solve")
+    p.add_argument("--descend", type=int, nargs="?", const=48,
+                   default=0, metavar="N",
+                   help="with --crack: escalate solver-UNKNOWN edges "
+                        "(checksum loops, deep loop-carried state) to "
+                        "the gradient-guided search tier — batched "
+                        "branch-distance descent on device, up to N "
+                        "dispatches per edge (default 48 when bare); "
+                        "verified witnesses inject like solved "
+                        "inputs, verdicts cache in solver.json so "
+                        "--resume never re-descends")
+    p.add_argument("--descend-lanes", type=int, default=1024,
+                   metavar="B",
+                   help="candidate lanes per descent dispatch "
+                        "(default 1024)")
     p.add_argument("--no-focus", action="store_true",
                    help="with --crack: do NOT install the Angora-"
                         "style focused-mutation byte masks derived "
@@ -344,6 +358,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.schedule == "rare-edge":
             _wire_rare_edge_signer(fuzzer, driver)
             _wire_static_prior(fuzzer, driver)
+        if args.descend and not args.crack:
+            print("error: --descend escalates the crack stage's "
+                  "solver-unknown frontier — it needs --crack",
+                  file=sys.stderr)
+            return 2
         if args.crack:
             prog = getattr(instrumentation, "program", None)
             if prog is None or not instrumentation.device_backed \
@@ -355,7 +374,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             from .crack import BranchCracker
             fuzzer.cracker = BranchCracker(
                 prog, plateau_batches=args.crack,
-                focus=not args.no_focus, store=fuzzer.store)
+                focus=not args.no_focus, store=fuzzer.store,
+                descend=args.descend,
+                descend_lanes=args.descend_lanes)
         stats = fuzzer.run(args.iterations)
         # both rates read the SAME registry the loop recorded into —
         # the CLI never recomputes from its own wall clock

@@ -19,6 +19,18 @@ become a byte mask the havoc/zzuf mutators honor — Angora's "don't
 burn mutations on bytes no uncovered branch reads", bought statically
 instead of with dynamic taint.  ``--no-focus`` disables the masks;
 campaigns without a cracker never see one (parity-pinned).
+
+Third tier: **gradient-guided escalation** (``--descend``).  Edges
+the solver honestly reports ``unknown`` — checksum loops, deep
+loop-carried state — escalate to the search tier
+(``search/descent.py``): batched branch-distance descent on device,
+seeded from corpus entries that reach the edge's source block,
+mutation dimensions restricted to the solver's dependency-byte mask.
+Verified witnesses inject through the same path as solved inputs
+(same honesty contract: concretely re-checked before emission), and
+per-edge verdicts (``descended``/``exhausted``, steps, final
+distance) cache in the same ``solver.json`` sidecar so ``--resume``
+never re-descends.
 """
 
 from __future__ import annotations
@@ -45,11 +57,22 @@ class BranchCracker:
     #: host-side pause bounded)
     MAX_SOLVES_PER_CRACK = 32
 
+    #: at most this many descent escalations per crack invocation
+    #: (a descent is many device dispatches — later plateaus pick up
+    #: the rest, with fresher seeds from whatever cracked meanwhile)
+    MAX_DESCENDS_PER_CRACK = 4
+
+    #: seed-pool size cap for descent populations
+    MAX_DESCENT_SEEDS = 96
+
     def __init__(self, program, *, plateau_batches: int = 16,
                  budget: int = DEFAULT_BUDGET,
                  max_visits: int = DEFAULT_MAX_VISITS,
                  max_len: int = DEFAULT_MAX_LEN,
-                 focus: bool = True, store=None):
+                 focus: bool = True, store=None,
+                 descend: int = 0, descend_lanes: int = 1024,
+                 max_solves: Optional[int] = None,
+                 max_descends: Optional[int] = None):
         self.program = program
         self.plateau_batches = max(int(plateau_batches), 1)
         self.budget = int(budget)
@@ -57,6 +80,16 @@ class BranchCracker:
         self.max_len = int(max_len)
         self.focus = bool(focus)
         self.store = store
+        #: descent step budget per edge (device dispatches); 0 = the
+        #: search tier is off and solver-unknown edges stay unknown
+        self.descend = int(descend)
+        self.descend_lanes = int(descend_lanes)
+        #: per-crack work caps (instance-tunable: bench/offline
+        #: callers crank them to sweep a whole universe in one crack)
+        self.max_solves = int(max_solves) if max_solves \
+            else self.MAX_SOLVES_PER_CRACK
+        self.max_descends = int(max_descends) if max_descends \
+            else self.MAX_DESCENDS_PER_CRACK
         ef = np.asarray(program.edge_from)
         et = np.asarray(program.edge_to)
         slots = np.asarray(program.edge_slot)
@@ -138,7 +171,7 @@ class BranchCracker:
 
         fresh = [e for e in uncovered if self._key(e) not in self.cache]
         t0 = time.time()
-        for e in fresh[:self.MAX_SOLVES_PER_CRACK]:
+        for e in fresh[:self.max_solves]:
             reg.count("solver_attempts")
             res = solve_edge(self.program, e, budget=self.budget,
                              max_visits=self.max_visits,
@@ -154,28 +187,38 @@ class BranchCracker:
                 if "budget" in res.reason:
                     reg.count("solver_budget_bailed")
             self.cache[self._key(e)] = entry
-        if self.store is not None and fresh:
+
+        # gradient-guided escalation: the edges the solver just (or
+        # previously) reported unknown are exactly the search tier's
+        # intake — descend their branch distances on device.  Returns
+        # ATTEMPTS, not witnesses: an exhausted verdict also mutates
+        # the cache and must persist, or --resume re-descends it
+        searched = self._descend_frontier(fuzzer, uncovered) \
+            if self.descend else 0
+
+        if self.store is not None and (fresh or searched):
             self.store.save_solver_cache(self.cache)
 
-        # inject every cached solve whose edge is STILL uncovered —
-        # includes solves restored from a resumed campaign's sidecar
+        # inject every cached solve/descent whose edge is STILL
+        # uncovered — includes results restored from a resumed
+        # campaign's sidecar
         bufs = []
         for e in uncovered:
             entry = self.cache.get(self._key(e))
-            if entry and entry.get("status") == "solved" \
+            if entry and entry.get("status") in ("solved", "descended") \
                     and "input_hex" in entry:
                 bufs.append(bytes.fromhex(entry["input_hex"]))
         injected = self._inject(fuzzer, bufs) if bufs else 0
         if fresh or injected:
             fuzzer.telemetry.event(
                 "crack_injection", injected=int(injected),
-                attempts=len(fresh[:self.MAX_SOLVES_PER_CRACK]),
+                attempts=len(fresh[:self.max_solves]),
                 frontier=len(uncovered),
                 solve_seconds=round(time.time() - t0, 3))
             INFO_MSG(
                 "crack: %d uncovered edges, %d solve attempts "
                 "(%.2fs), %d candidates injected",
-                len(uncovered), len(fresh[:self.MAX_SOLVES_PER_CRACK]),
+                len(uncovered), len(fresh[:self.max_solves]),
                 time.time() - t0, injected)
 
         # focus mask from whatever frontier remains unsolved
@@ -183,6 +226,94 @@ class BranchCracker:
             remaining = self.uncovered_edges(instr)
             self._update_mask(fuzzer, remaining)
         return injected
+
+    # -- the search-tier escalation (search/descent.py) -----------------
+
+    def _seed_pool(self, fuzzer) -> List[bytes]:
+        """Descent seed candidates: rotation arms, the base seed, and
+        every cached solver/descent witness (those reach the deepest
+        known blocks — exactly where the frontier lives)."""
+        pool: List[bytes] = []
+        for entry in self.cache.values():
+            if "input_hex" in entry:
+                pool.append(bytes.fromhex(entry["input_hex"]))
+        sched = getattr(fuzzer, "scheduler", None)
+        if sched is not None:
+            pool.extend(a.buf for a in sched.arms)
+            if sched.base_seed:
+                pool.append(sched.base_seed)
+        seen = set()
+        out = []
+        for b in pool:
+            if b and b not in seen:
+                seen.add(b)
+                out.append(b)
+        return out[:self.MAX_DESCENT_SEEDS]
+
+    def _descend_frontier(self, fuzzer, uncovered) -> int:
+        """Escalate solver-unknown uncovered edges to branch-distance
+        descent; returns how many edges were ATTEMPTED (the cache
+        mutated — the caller persists on any nonzero return).  One
+        attempt per edge per campaign lineage: verdicts (including
+        ``exhausted``) cache under the edge's ``search`` key, so
+        plateaus and ``--resume`` never re-descend."""
+        from ..search import descend_edge, seeds_reaching_block
+        cand = []
+        for e in uncovered:
+            entry = self.cache.get(self._key(e))
+            if entry is not None and entry.get("status") == "unknown" \
+                    and "search" not in entry:
+                cand.append(e)
+        reg = fuzzer.telemetry.registry
+        reg.gauge("search_frontier", len(cand))
+        if not cand:
+            return 0
+        seeds = self._seed_pool(fuzzer)
+        if self._dataflow is None:
+            self._dataflow = analyze_dataflow(self.program)
+        tr = fuzzer.telemetry.trace
+        # one reference-interpreter trace per seed per crack: the
+        # reach filter and the engine's path-guard extraction share it
+        traces: Dict[bytes, object] = {}
+        n = attempted = 0
+        t0 = time.time()
+        for e in cand[:self.max_descends]:
+            reg.count("search_attempts")
+            attempted += 1
+            mask = edge_dep_mask(self.program, [e], self._dataflow)
+            se = seeds_reaching_block(self.program, seeds, e[0],
+                                      cap=24, trace_cache=traces) \
+                or seeds[:16]
+            res = descend_edge(self.program, e, se or [b"\x00"],
+                               mask=mask, lanes=self.descend_lanes,
+                               budget=self.descend,
+                               max_len=self.max_len, trace=tr,
+                               trace_cache=traces)
+            entry = dict(self.cache.get(self._key(e)) or {})
+            d = res.as_dict()
+            entry["search"] = {k: d[k] for k in
+                               ("status", "steps", "evals",
+                                "best_dist", "objective")}
+            if res.status == "descended":
+                reg.count("search_descended")
+                entry["status"] = "descended"
+                entry["input_hex"] = res.input.hex()
+                entry["reason"] = (f"branch-distance descent: witness "
+                                   f"after {res.steps} batches")
+                seeds.append(res.input)   # chain: deeper edges seed
+                n += 1                    # from this witness
+            else:
+                reg.count("search_exhausted")
+            self.cache[self._key(e)] = entry
+            fuzzer.telemetry.event(
+                "descent", edge=f"{e[0]}:{e[1]}", status=res.status,
+                steps=int(res.steps), evals=int(res.evals),
+                best_dist=(None if res.input else float(res.best_dist)))
+        if attempted:
+            INFO_MSG("descend: %d unknown edges, %d attempts, %d "
+                     "cracked (%.2fs)", len(cand), attempted, n,
+                     time.time() - t0)
+        return attempted
 
     def _inject(self, fuzzer, bufs: List[bytes]) -> int:
         """Run solved candidates through the MAIN instrumentation (so

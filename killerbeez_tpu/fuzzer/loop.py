@@ -257,6 +257,7 @@ class Fuzzer:
         self._active_entry: Optional[list] = None
         self._iter_base = 0             # execs restored by --resume
         self._fb_batches = 0
+        self._accum_warned = False
         self._dbg = None
         self.stats = FuzzStats(telemetry.registry)
         self._seen = {k: set() for k in ("crashes", "hangs", "new_paths")}
@@ -804,7 +805,12 @@ class Fuzzer:
         arm's stats and charges the period to the arm ENTRY that
         actually generated it — when the cap pops the active arm the
         index goes stale but the entry object is still the generator
-        (the find credits go to the same object)."""
+        (the find credits go to the same object).
+
+        ``feedback`` rides along as the period LENGTH in batches: the
+        scheduler's decay is defined per batch, and one call here
+        closes a whole -fb-batch period, so it compounds the factor
+        (0.8**feedback) — see ``Scheduler.credit_period``."""
         self.scheduler.credit_period(self._active_entry, self.feedback)
         reg = self.telemetry.registry
         reg.gauge("corpus_arms", len(self.scheduler.arms))
@@ -870,6 +876,18 @@ class Fuzzer:
         if self.feedback:
             while k > 1 and self.feedback % k:
                 k -= 1
+            if self.accumulate > 1 and k != self.accumulate \
+                    and not self._accum_warned:
+                # an explicit -K is being overridden — say so (this
+                # used to degrade silently)
+                self._accum_warned = True
+                WARNING_MSG(
+                    "accumulate: explicit -K %d degraded to %d — a "
+                    "superbatch may not stride a corpus-feedback "
+                    "rotation boundary, so K must divide the "
+                    "feedback cadence (-fb %d); pass a -K that "
+                    "divides -fb (or adjust -fb) to keep it",
+                    self.accumulate, k, self.feedback)
         return k
 
     def _run_superbatch(self, k: int, pending, depth) -> None:

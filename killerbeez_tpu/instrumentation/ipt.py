@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING
+from .. import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_RUNNING
 from ..models import targets as targets_mod
 from ..models.vm import _run_batch_impl
 from ..ops.hashing import murmur3_32
@@ -221,6 +221,13 @@ class IptInstrumentation(Instrumentation):
         # sequential membership+insert: in-batch duplicates count once
         # (exact single-exec-loop parity, like jit_harness "exact")
         for i, p in enumerate(pairs):
+            if statuses[i] == FUZZ_ERROR:
+                # a failed exec publishes a zeroed bitmap, so its
+                # (tip, tnt) pair is 0 — not a path identity.  It
+                # must not enter the hash sets: the first error in a
+                # campaign used to count as a new path and record the
+                # offending input as a finding.
+                continue
             if p not in self.hashes:
                 self.hashes.add(p)
                 new_paths[i] = 1

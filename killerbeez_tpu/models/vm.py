@@ -622,6 +622,138 @@ def run_batch(program: Program, inputs: jax.Array, lengths: jax.Array,
                            program.n_edges, record_stream)
 
 
+# --------------------------------------------------------------------
+# Distance-returning execute variant (the gradient-search objective)
+# --------------------------------------------------------------------
+#
+# Angora (arxiv 1803.01307) treats an uncracked branch as a black-box
+# distance function over the input bytes and descends it; the batched
+# engine makes the expensive half of that — "evaluate the objective on
+# thousands of candidate inputs" — one device dispatch.  The variant
+# below threads a per-lane best-distance accumulator through the SAME
+# ``_step_batched`` transition as the production engine: coverage
+# counts, statuses, steps and path hashes are bit-identical when the
+# distance output is ignored (parity-pinned in tests/test_search.py).
+
+#: distance of a lane that never reached the target branch while in
+#: the objective's source block (float32-representable "infinity")
+DIST_UNREACHED = 3.0e38
+
+
+def _branch_distance(sel: int, x, y):
+    """Angora's branch-distance table for ONE comparison direction:
+    0.0 exactly when ``x <sel> y`` holds (judged in exact int32), a
+    positive magnitude otherwise.  ``sel`` is the CANONICAL compare —
+    callers wanting the fall-through successor pass the negated
+    compare (eq<->ne, lt<->ge), so distance 0 always means "the
+    branch goes the way the target edge needs".  Magnitudes are
+    float32 (|operand| < 2^24 exact — byte-derived values in
+    practice); only the zero test must be, and is, exact."""
+    xf = x.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    if sel == CMP_EQ:
+        sat = x == y
+        mag = jnp.abs(xf - yf)
+    elif sel == CMP_NE:
+        sat = x != y
+        mag = jnp.float32(1.0)
+    elif sel == CMP_LT:
+        sat = x < y
+        mag = xf - yf + jnp.float32(1.0)
+    else:  # CMP_GE
+        sat = x >= y
+        mag = yf - xf
+    return jnp.where(sat, jnp.float32(0.0),
+                     jnp.maximum(mag, jnp.float32(1.0)))
+
+
+@partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
+                                   "specs"))
+def _run_batch_dist_impl(instrs, edge_table, inputs, lengths, mem_size,
+                         max_steps, n_edges, specs):
+    """``_run_batch_impl`` plus per-lane min-distance accumulators
+    for K observed branches at once.
+
+    ``specs`` is a static tuple of ``(branch_pc, from_idx, sel,
+    x_idx, y_idx)`` tuples.  Each distance is sampled from the state
+    ENTERING a step, before ``_step_batched`` runs it, whenever a
+    still-running lane is about to execute that branch pc with that
+    source block as its last block (``prev_idx == from_idx + 1`` —
+    the edge-table row key), so the observations never perturb the
+    transition.  One dispatch therefore scores a whole guard
+    CURRICULUM (the path conditions into a frontier block plus the
+    frontier branch itself) for every candidate."""
+    b = inputs.shape[0]
+    state0 = (jnp.zeros(b, jnp.int32),
+              jnp.zeros((b, N_REGS), jnp.int32),
+              jnp.zeros((b, mem_size), jnp.int32),
+              jnp.zeros(b, jnp.int32),
+              jnp.full(b, FUZZ_RUNNING, jnp.int32),
+              jnp.zeros(b, jnp.int32),
+              jnp.zeros(b, jnp.int32),                     # prev_idx
+              jnp.zeros((b, n_edges + 1), jnp.uint8),      # counts
+              jnp.zeros(b, jnp.uint32),                    # path_hash
+              jnp.zeros((b, 0), jnp.int32),
+              jnp.int32(0),
+              jnp.zeros(b, jnp.int32))
+    best0 = jnp.full((b, len(specs)), DIST_UNREACHED, jnp.float32)
+    bufs_t = inputs.T
+    lengths = lengths.astype(jnp.int32)
+
+    def cond(carry):
+        s, _ = carry
+        return jnp.any(s[4] == FUZZ_RUNNING) & (s[10] < max_steps)
+
+    def body(carry):
+        s, best = carry
+        running = s[4] == FUZZ_RUNNING
+        cols = []
+        for k, (branch_pc, from_idx, sel, x_idx, y_idx) \
+                in enumerate(specs):
+            at = (s[0] == branch_pc) & (s[6] == from_idx + 1) & running
+            d = _branch_distance(sel, s[1][:, x_idx], s[1][:, y_idx])
+            cols.append(jnp.where(at, jnp.minimum(best[:, k], d),
+                                  best[:, k]))
+        best = jnp.stack(cols, axis=1)
+        return (_step_batched(instrs, edge_table, bufs_t, lengths,
+                              mem_size, False, s), best)
+
+    final, best = jax.lax.while_loop(cond, body, (state0, best0))
+    return VMResult(status=final[4], exit_code=final[5],
+                    counts=final[7], steps=final[11],
+                    path_hash=final[8], edge_ids=None), best
+
+
+def run_batch_distances(program: Program, inputs: jax.Array,
+                        lengths: jax.Array,
+                        specs) -> Tuple[VMResult, jax.Array]:
+    """Execute a candidate batch and return, per lane, the minimum
+    branch distance observed at each of the K ``specs`` (tuples of
+    ``(branch_pc, from_idx, sel, x_idx, y_idx)``) — float32[B, K],
+    ``DIST_UNREACHED`` where never sampled.  The VMResult is
+    bit-identical to ``run_batch(..., record_stream=False)``.
+    ``search/objective.py`` derives specs from target edges."""
+    specs = tuple(tuple(int(v) for v in s) for s in specs)
+    if not specs:
+        raise ValueError("at least one branch spec is required")
+    return _run_batch_dist_impl(
+        jnp.asarray(program.instrs), jnp.asarray(program.edge_table),
+        inputs, lengths, program.mem_size, program.max_steps,
+        program.n_edges, specs)
+
+
+def run_batch_distance(program: Program, inputs: jax.Array,
+                       lengths: jax.Array, *, branch_pc: int,
+                       from_idx: int, sel: int, x_idx: int,
+                       y_idx: int) -> Tuple[VMResult, jax.Array]:
+    """Single-branch convenience wrapper over
+    ``run_batch_distances`` (returns float32[B])."""
+    res, best = run_batch_distances(
+        program, inputs, lengths,
+        ((branch_pc, from_idx, sel, x_idx, y_idx),))
+    return res, best[:, 0]
+
+
 def compile_runner(program: Program, record_stream: bool = True):
     """Return a jitted ``(inputs, lengths) -> VMResult`` closure with
     the instruction tensor baked in (constant-folded by XLA)."""

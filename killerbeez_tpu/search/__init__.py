@@ -1,0 +1,38 @@
+"""Gradient-guided search over KBVM branch distances.
+
+The third coverage tier, picking up where the exact layers stop:
+
+  static analysis (PR 3)  — describes every branch;
+  exact solver (PR 4)     — solves the described conditions, honest
+                            ``unknown`` on checksum-style loops;
+  search (this package)   — descends the unknowns: Angora-style
+                            branch-distance minimization with the
+                            objective evaluated for thousands of
+                            candidates per device dispatch.
+
+  objective.py  deciding-branch extraction: which OP_BR (and which
+                direction) a frontier edge needs, as the static args
+                of ``vm.run_batch_distance``
+  descent.py    the batched descent engine: elite front, coordinate
+                probes, ES mutants, recombination, restarts — and
+                the verified-witness honesty contract
+  soft.py       float32-relaxed soft-KBVM: true ``jax.grad`` through
+                arithmetic-only path slices, proposals only
+
+Consumers: the crack stage's escalation path (``fuzzer/crack.py``,
+``--descend``), the ``kb-descend`` tool, and ``bench.py --descend``.
+"""
+
+from .descent import (
+    DEFAULT_DESCENT_BUDGET, DEFAULT_LANES, DescentResult, descend_edge,
+    seeds_reaching_block,
+)
+from .objective import BranchObjective, edge_objectives
+from .soft import SoftSlice, soft_refine, trace_slice
+
+__all__ = [
+    "DEFAULT_DESCENT_BUDGET", "DEFAULT_LANES", "DescentResult",
+    "descend_edge", "seeds_reaching_block",
+    "BranchObjective", "edge_objectives",
+    "SoftSlice", "soft_refine", "trace_slice",
+]

@@ -546,3 +546,36 @@ def test_kb_corpus_ls_stats_compact(tmp_path, capsys):
     assert kbc(["compact", cdir]) == 0
     kept = {e.md5 for e in store.load()}
     assert b.md5 not in kept and len(kept) == 2
+
+
+def test_explicit_accumulate_degrade_warns(capsys):
+    """ADVICE r5: an explicit -K silently degraded to a divisor of
+    -fb; the constraint (superbatches may not stride a rotation
+    boundary) must be named at WARNING."""
+    from killerbeez_tpu.fuzzer.loop import Fuzzer
+
+    class _Drv:
+        supports_batch = True
+        mutator = None
+        instrumentation = None
+        stage_timer = None
+
+        def supports_fused_multi(self):
+            return True
+
+    fz = Fuzzer(_Drv(), write_findings=False, accumulate=5,
+                feedback=8, telemetry=False)
+    assert fz._resolve_accumulate() == 4    # largest K<=5 dividing 8
+    err = capsys.readouterr().err
+    assert "degraded" in err and "-fb" in err
+    # the warning names the explicit K and fires once
+    assert "5" in err
+    fz._resolve_accumulate()
+    assert "degraded" not in capsys.readouterr().err
+    # auto K (accumulate=0) degrades silently — nothing explicit to
+    # contradict
+    fz2 = Fuzzer(_Drv(), write_findings=False, accumulate=0,
+                 feedback=3, telemetry=False)
+    capsys.readouterr()
+    assert fz2._resolve_accumulate() == 3   # largest divisor of 3 <= 8
+    assert "degraded" not in capsys.readouterr().err

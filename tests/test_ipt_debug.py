@@ -242,3 +242,27 @@ def test_debug_state_merge(corpus_bin):
     drv.cleanup()
     a.cleanup()
     b.cleanup()
+
+
+def test_ipt_error_lanes_skip_novelty_sets():
+    """A FUZZ_ERROR lane publishes a zeroed bitmap: its (tip, tnt)
+    pair 0 is not a path identity and must not enter the hash sets —
+    the first exec error in a campaign used to count once as a new
+    path and record the offending input as a finding."""
+    from killerbeez_tpu import FUZZ_ERROR
+    instr = make_ipt()
+    statuses = np.array([FUZZ_ERROR, FUZZ_NONE, FUZZ_ERROR],
+                        dtype=np.int32)
+    res = instr._update_sets(statuses, [0, 0, 0],
+                             np.zeros(3, dtype=np.int32))
+    # error lanes report nothing; the genuine pair-0 exec still
+    # counts exactly once
+    assert res.new_paths.tolist() == [0, 1, 0]
+    assert not res.unique_crashes.any() and not res.unique_hangs.any()
+    assert instr.hashes == {0}
+    # a later crash on pair 0 is still judged against a set the
+    # error lanes never polluted
+    res2 = instr._update_sets(np.array([FUZZ_CRASH], dtype=np.int32),
+                              [0], np.zeros(1, dtype=np.int32))
+    assert res2.new_paths.tolist() == [0]
+    assert res2.unique_crashes.tolist() == [True]

@@ -1,0 +1,443 @@
+"""Gradient-guided search tier (killerbeez_tpu/search/).
+
+Covers the acceptance contract of the subsystem:
+
+  * the distance-returning execute variant is parity-pinned against
+    the standard engine when the distance output is ignored
+    (bit-exact coverage maps, statuses, steps, path hashes);
+  * distances follow Angora's table (0 exactly at satisfaction,
+    monotone magnitudes elsewhere, DIST_UNREACHED off-branch);
+  * objective extraction finds the deciding branch (and direction)
+    of a frontier edge;
+  * descent cracks edges the exact solver provably cannot solve
+    (imgparse/tlvstack checksum and stack-depth loops), and every
+    emitted witness is concretely verified;
+  * the soft-KBVM gradient tier is eligible exactly on
+    arithmetic-only path slices and proposes distance-reducing
+    candidates;
+  * the crack-stage escalation caches verdicts in solver.json so a
+    resumed campaign never re-descends;
+  * the solver's ``unknown`` reasons are pinned by kind on the
+    checksum frontier, keeping the search tier's intake set stable.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from killerbeez_tpu.analysis.solver import (
+    concrete_run, solve_edge, unknown_kind,
+)
+from killerbeez_tpu.models import targets, targets_cgc  # noqa: F401
+from killerbeez_tpu.models.compiler import Assembler
+from killerbeez_tpu.models.vm import (
+    CMP_EQ, CMP_GE, DIST_UNREACHED, run_batch, run_batch_distance,
+)
+from killerbeez_tpu.mutators.base import pack_byte_rows
+from killerbeez_tpu.search import (
+    descend_edge, edge_objectives, seeds_reaching_block, soft_refine,
+    trace_slice,
+)
+
+
+def _imgparse():
+    return targets.get_target("imgparse_vm")
+
+
+def _tlvstack():
+    return targets.get_target("tlvstack_vm")
+
+
+# --------------------------------------------------------------------
+# distance engine
+# --------------------------------------------------------------------
+
+def test_distance_engine_parity_bit_exact():
+    """Ignoring the distance output, the variant must be bit-exact
+    with the production engine — coverage maps included."""
+    prog = _imgparse()
+    rows = [b"QIMGH\x03\x00\x00\x00\x00\x00", b"QIMG", b"\xff" * 16,
+            b"", b"QIMGC\x01AA"]
+    bufs, lens = pack_byte_rows(rows)
+    base = run_batch(prog, bufs, lens, record_stream=False)
+    obj = edge_objectives(prog, (13, 14))[0]
+    var, dist = run_batch_distance(prog, bufs, lens,
+                                   **obj.dist_kwargs())
+    for f in ("status", "exit_code", "counts", "steps", "path_hash"):
+        np.testing.assert_array_equal(np.asarray(getattr(base, f)),
+                                      np.asarray(getattr(var, f)), f)
+    assert np.asarray(dist).shape == (len(rows),)
+
+
+def test_distance_semantics_monotone():
+    """eq-objective: |x - y| exactly, 0 at satisfaction, UNREACHED
+    for lanes that never sample the branch in-block."""
+    a = Assembler("dist_toy")
+    a.block()                       # 0
+    a.ldi(2, 0)
+    a.ldb(1, 2)                     # r1 = input[0]
+    a.ldi(2, 42)
+    a.br("eq", 1, 2, "win")
+    a.block()                       # 1 (miss)
+    a.halt(0)
+    a.label("win")
+    a.block()                       # 2
+    a.halt(0)
+    prog = a.build()
+    obj = [o for o in edge_objectives(prog, (0, 2))][0]
+    assert obj.sel == CMP_EQ and obj.want_taken
+    rows = [bytes([v]) for v in (0, 40, 41, 42, 44, 255)]
+    bufs, lens = pack_byte_rows(rows)
+    res, dist = run_batch_distance(prog, bufs, lens,
+                                   **obj.dist_kwargs())
+    d = np.asarray(dist)
+    assert d.tolist() == [42.0, 2.0, 1.0, 0.0, 2.0, 213.0]
+    # the satisfied lane actually traversed the edge
+    e_idx = [(int(f), int(t)) for f, t in
+             zip(prog.edge_from, prog.edge_to)].index((0, 2))
+    assert np.asarray(res.counts)[3, e_idx] == 1
+    # a lane that never reaches the branch reads UNREACHED
+    ge_obj = edge_objectives(prog, (0, 1))[0]
+    assert ge_obj.sel != CMP_EQ     # negated: fall-through wanted
+    _, d2 = run_batch_distance(prog, np.zeros((1, 8), np.uint8),
+                               np.array([0], np.int32),
+                               branch_pc=ge_obj.branch_pc,
+                               from_idx=5,  # no such source block
+                               sel=ge_obj.sel, x_idx=ge_obj.x_idx,
+                               y_idx=ge_obj.y_idx)
+    assert np.asarray(d2)[0] == np.float32(DIST_UNREACHED)
+
+
+def test_edge_objectives_checksum_edge():
+    """imgparse (13,14) is the H-chunk len==3 guard: one deciding
+    branch, fall-through direction, canonicalized to eq."""
+    objs = edge_objectives(_imgparse(), (13, 14))
+    assert len(objs) == 1
+    assert objs[0].sel == CMP_EQ and not objs[0].want_taken
+    # guard chains surface every deciding branch, program order
+    objs = edge_objectives(_imgparse(), (14, 15))
+    assert len(objs) == 4
+    # an edge outside the universe has no objectives
+    assert edge_objectives(_imgparse(), (0, 999)) == []
+
+
+# --------------------------------------------------------------------
+# descent
+# --------------------------------------------------------------------
+
+def test_descend_cracks_imgparse_checksum_edge():
+    """(13,14) is solver-unknown (checksum loop); descent must crack
+    it from the solver's own witness for the dispatch edge."""
+    prog = _imgparse()
+    assert solve_edge(prog, (13, 14)).status == "unknown"
+    seed = solve_edge(prog, (11, 13)).input
+    assert seed is not None
+    res = descend_edge(prog, (13, 14), [seed], lanes=128, budget=12)
+    assert res.status == "descended"
+    # the honesty contract, re-checked here independently
+    assert (13, 14) in concrete_run(prog, res.input).edges
+    assert res.steps <= 12 and res.evals >= res.steps * 64
+
+
+def test_descend_cracks_tlvstack_stack_depth_edge():
+    """tlvstack (28,29) (op_swap needs sp >= 2) requires INSERTING
+    push commands before the swap — the structural moves' regression
+    case: no fixed-position byte move can add a command record."""
+    prog = _tlvstack()
+    assert solve_edge(prog, (28, 29)).status == "unknown"
+    # seed with the witness of the edge INTO the swap handler's head
+    preds = [(int(f), int(t)) for f, t in
+             zip(prog.edge_from, prog.edge_to) if int(t) == 28]
+    seeds = [r.input for e in preds
+             if (r := solve_edge(prog, e)).input]
+    assert seeds
+    se = seeds_reaching_block(prog, seeds, 28) or seeds
+    res = descend_edge(prog, (28, 29), se, lanes=256, budget=24)
+    assert res.status == "descended"
+    assert (28, 29) in concrete_run(prog, res.input).edges
+
+
+def test_descend_exhausted_is_honest():
+    """An impossible intake (source block never reaches the target's
+    region with usable seeds) exhausts with no witness rather than
+    guessing."""
+    a = Assembler("never")
+    a.block()                       # 0
+    a.ldi(2, 0)
+    a.ldb(1, 2)
+    a.ldi(2, 1)
+    a.alu("mul", 3, 1, 2)
+    a.ldi(2, 256)                   # a byte can never be 256
+    a.br("eq", 3, 2, "win")
+    a.block()                       # 1
+    a.halt(0)
+    a.label("win")
+    a.block()                       # 2
+    a.halt(0)
+    prog = a.build()
+    res = descend_edge(prog, (0, 2), [b"\x00"], lanes=64, budget=4)
+    assert res.status == "exhausted"
+    assert res.input is None
+    assert res.steps == 4
+    assert res.best_dist > 0
+
+
+def test_descend_spans_on_descent_lane():
+    """kb-timeline contract: every descent dispatch is a span on the
+    dedicated ``descent`` lane."""
+    from killerbeez_tpu.telemetry.trace import TraceRecorder
+    prog = _imgparse()
+    seed = solve_edge(prog, (11, 13)).input
+    tr = TraceRecorder(max_events=4096)
+    descend_edge(prog, (13, 14), [seed], lanes=64, budget=4, trace=tr)
+    chrome = tr.to_chrome()
+    lane_tid = tr.lane_id("descent")
+    spans = [e for e in chrome["traceEvents"]
+             if e.get("name") == "descend_batch"
+             and e.get("tid") == lane_tid and e.get("ph") == "B"]
+    assert spans, "descent batches must land on the descent lane"
+    assert all("edge" in s.get("args", {}) for s in spans)
+
+
+def test_seeds_reaching_block_filter():
+    prog = _imgparse()
+    seed = solve_edge(prog, (11, 13)).input
+    assert seeds_reaching_block(prog, [seed, b"zzz"], 13) == [seed]
+    # entry pseudo-block accepts everything
+    assert len(seeds_reaching_block(prog, [seed, b"zzz"], -1)) == 2
+
+
+# --------------------------------------------------------------------
+# soft-KBVM gradient tier
+# --------------------------------------------------------------------
+
+def _arith_prog():
+    """r3 = 3*input[0] + input[1]; branch eq r3, 200."""
+    a = Assembler("arith")
+    a.block()                       # 0
+    a.ldi(2, 0)
+    a.ldb(1, 2)                     # r1 = b0
+    a.ldi(2, 3)
+    a.alu("mul", 3, 1, 2)           # r3 = 3*b0
+    a.ldi(2, 1)
+    a.ldb(1, 2)                     # r1 = b1
+    a.alu("add", 3, 3, 1)           # r3 += b1
+    a.ldi(2, 200)
+    a.br("eq", 3, 2, "win")
+    a.block()                       # 1
+    a.halt(0)
+    a.label("win")
+    a.block()                       # 2
+    a.halt(0)
+    return a.build()
+
+
+def test_soft_slice_eligibility():
+    prog = _arith_prog()
+    obj = edge_objectives(prog, (0, 2))[0]
+    sl = trace_slice(prog, b"\x00\x00", obj)
+    assert sl.eligible
+    # bit ops poison eligibility
+    a = Assembler("bitop")
+    a.block()
+    a.ldi(2, 0)
+    a.ldb(1, 2)
+    a.ldi(2, 255)
+    a.alu("and", 3, 1, 2)
+    a.ldi(2, 77)
+    a.br("eq", 3, 2, "win")
+    a.block()
+    a.halt(0)
+    a.label("win")
+    a.block()
+    a.halt(0)
+    bprog = a.build()
+    bobj = edge_objectives(bprog, (0, 2))[0]
+    bsl = trace_slice(bprog, b"\x00\x00", bobj)
+    assert not bsl.eligible and "ALU" in bsl.reason
+    assert soft_refine(bprog, b"\x00\x00", bobj) == []
+
+
+def test_soft_refine_descends_distance():
+    """One gradient step must propose candidates strictly closer to
+    satisfying 3*b0 + b1 == 200 than the start point."""
+    prog = _arith_prog()
+    obj = edge_objectives(prog, (0, 2))[0]
+    start = b"\x00\x00"
+
+    def gap(buf):
+        return abs(3 * buf[0] + buf[1] - 200)
+
+    cands = soft_refine(prog, start, obj)
+    assert cands
+    assert min(gap(c) for c in cands) < gap(start)
+
+
+def test_soft_tier_inside_descent():
+    """The full engine cracks the arithmetic target and reports the
+    soft tier's participation."""
+    prog = _arith_prog()
+    res = descend_edge(prog, (0, 2), [b"\x00\x00"], lanes=64,
+                       budget=16)
+    assert res.status == "descended"
+    assert 3 * res.input[0] + res.input[1] == 200
+
+
+# --------------------------------------------------------------------
+# solver intake fixtures (satellite): the unknown REASONS are pinned
+# --------------------------------------------------------------------
+
+def test_unknown_kind_taxonomy():
+    assert unknown_kind("path-search budget exhausted (7 expansions)") \
+        == "budget"
+    assert unknown_kind("no satisfiable path within the visit/step "
+                        "caps (loop-carried state beyond 2 passes is "
+                        "not modeled)") == "visit-cap"
+    assert unknown_kind("no satisfiable path under the bounded input "
+                        "model (reads forced in-bounds, length capped "
+                        "at 64 — raise max_len or accept unknown)") \
+        == "model"
+    assert unknown_kind("anything else") == "other"
+
+
+# the search tier's intake on the checksum universes: these edges ARE
+# unknown, for the visit-cap reason, at default budgets.  A solver
+# improvement that flips one to solved must update this fixture (and
+# the kb-descend floors) explicitly rather than silently reshaping
+# the frontier.
+_IMGPARSE_CHECKSUM_EDGES = [(13, 14), (14, 15), (16, 17), (24, 24),
+                            (33, 31)]
+_TLVSTACK_DEPTH_EDGES = [(12, 13), (28, 29), (30, 31)]
+
+
+@pytest.mark.parametrize("edge", _IMGPARSE_CHECKSUM_EDGES)
+def test_imgparse_intake_reason_pinned(edge):
+    r = solve_edge(_imgparse(), edge)
+    assert r.status == "unknown"
+    assert unknown_kind(r.reason) == "visit-cap"
+
+
+@pytest.mark.parametrize("edge", _TLVSTACK_DEPTH_EDGES)
+def test_tlvstack_intake_reason_pinned(edge):
+    r = solve_edge(_tlvstack(), edge)
+    assert r.status == "unknown"
+    assert unknown_kind(r.reason) == "visit-cap"
+
+
+def test_budget_kind_surfaces_when_budget_tiny():
+    r = solve_edge(_imgparse(), (13, 14), budget=50)
+    assert r.status == "unknown"
+    assert unknown_kind(r.reason) == "budget"
+
+
+# --------------------------------------------------------------------
+# crack-stage escalation (fuzzer/crack.py --descend)
+# --------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def blind_campaign(tmp_path_factory):
+    """ONE escalated blind campaign shared by the e2e assertions —
+    small enough for CI but long enough to plateau: the crack trigger
+    pads its window by PIPELINE_DEPTH batches, so n must comfortably
+    exceed (plateau + depth) * batch."""
+    from killerbeez_tpu.drivers.factory import driver_factory
+    from killerbeez_tpu.fuzzer.crack import BranchCracker
+    from killerbeez_tpu.fuzzer.loop import Fuzzer
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    from killerbeez_tpu.mutators.factory import mutator_factory
+    tmp_path = tmp_path_factory.mktemp("blind")
+    instr = instrumentation_factory(
+        "jit_harness", json.dumps({"target": "imgparse_vm",
+                                   "novelty": "throughput"}))
+    mut = mutator_factory("havoc", '{"seed": 11}', b"\x00" * 8)
+    drv = driver_factory("file", None, instr, mut)
+    fz = Fuzzer(drv, output_dir=str(tmp_path / "out"),
+                batch_size=64, write_findings=False,
+                corpus_dir=str(tmp_path / "corpus"))
+    fz.cracker = BranchCracker(instr.program,
+                               plateau_batches=2, store=fz.store,
+                               descend=16, descend_lanes=256)
+    fz.run(8192)
+    return fz, instr.program
+
+
+def test_cracker_escalates_and_caches(blind_campaign):
+    """End-to-end: a blind campaign with --descend must record
+    descent attempts, produce at least one verified witness on the
+    checksum frontier, inject it, and cache the verdict (including
+    exhausted ones) in the solver.json sidecar so the next crack —
+    and a --resume — never re-descends."""
+    fz, prog = blind_campaign
+    reg = fz.telemetry.registry
+    assert reg.counters.get("search_attempts", 0) >= 1
+    assert reg.counters.get("search_descended", 0) >= 1
+    searched = {k: v for k, v in fz.cracker.cache.items()
+                if "search" in v}
+    assert searched
+    for v in searched.values():
+        assert v["search"]["status"] in ("descended", "exhausted")
+        if v.get("status") == "descended":
+            # the cached witness really traverses its edge
+            f, t = (int(x) for x in
+                    next(k for k, vv in fz.cracker.cache.items()
+                         if vv is v).split(":"))
+            buf = bytes.fromhex(v["input_hex"])
+            assert (f, t) in concrete_run(prog, buf).edges
+    # sidecar persisted
+    disk = fz.store.load_solver_cache()
+    assert any("search" in v for v in disk.values())
+
+    # a fresh cracker over the same store re-attempts NOTHING
+    from killerbeez_tpu.fuzzer.crack import BranchCracker
+    c2 = BranchCracker(prog, plateau_batches=2, store=fz.store,
+                       descend=16, descend_lanes=256)
+    attempted = [e for e in c2.edges
+                 if "search" in (c2.cache.get(f"{e[0]}:{e[1]}") or {})]
+    before = reg.counters.get("search_attempts", 0)
+    instr = fz.driver.instrumentation
+    n = c2._descend_frontier(fz, attempted)
+    assert n == 0
+    assert reg.counters.get("search_attempts", 0) == before
+
+
+def test_exhausted_verdicts_persist_without_fresh_solves(blind_campaign,
+                                                         tmp_path):
+    """Regression: a crack where every edge already has a cached
+    solve verdict (fresh == []) but descents run must still persist
+    the cache — exhausted search verdicts included — or --resume
+    re-descends them."""
+    from killerbeez_tpu.corpus.store import CorpusStore
+    from killerbeez_tpu.fuzzer.crack import BranchCracker
+    fz, prog = blind_campaign
+    store = CorpusStore(str(tmp_path / "c2"))
+    c = BranchCracker(prog, plateau_batches=2, store=store,
+                      descend=2, descend_lanes=64)
+    # pre-cache every edge as solver-unknown: no fresh solves happen
+    for e in c.edges:
+        c.cache[f"{e[0]}:{e[1]}"] = {"status": "unknown", "reason": "x"}
+    store.save_solver_cache(c.cache)
+    c.crack(fz)
+    disk = store.load_solver_cache()
+    searched = [k for k, v in disk.items() if "search" in v]
+    assert searched, "attempted-but-exhausted verdicts must persist"
+
+
+def test_descended_witnesses_inject_through_main_path(blind_campaign):
+    """Coverage beyond the solver ceiling: with escalation on, the
+    campaign's virgin map must light static edges the exact solver
+    cannot solve."""
+    fz, prog = blind_campaign
+    instr = fz.driver.instrumentation
+    vb = np.asarray(instr.virgin_bits)
+    covered = set(np.flatnonzero(vb != 0xFF).tolist())
+    slot_of = {(int(f), int(t)): int(s) for f, t, s in
+               zip(prog.edge_from, prog.edge_to, prog.edge_slot)}
+    descended = [tuple(int(x) for x in k.split(":"))
+                 for k, v in fz.cracker.cache.items()
+                 if v.get("status") == "descended"]
+    assert descended
+    assert any(slot_of[e] in covered for e in descended)

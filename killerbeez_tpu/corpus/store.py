@@ -9,9 +9,16 @@ full corpus, bandit stats and lineage instead of starting over.
     <corpus-dir>/
         <md5>            raw input bytes (same naming as new_paths/)
         <md5>.json       metadata sidecar (schema below)
-        campaign.json    scheduler + campaign state (atomic snapshot)
-        mutator.state    mutator resume state (JSON string)
-        instrumentation.state   coverage resume state (JSON string)
+        checkpoint.json  ONE atomic campaign checkpoint epoch
+                         (campaign + solver + event seq + component
+                         states; resilience/checkpoint.py) — the
+                         resume source of truth
+        checkpoint.json.prev  previous epoch (torn-write fallback)
+        campaign.json    legacy scheduler/campaign state (read when
+                         no checkpoint exists)
+        solver.json      legacy / offline-tool solver cache
+        mutator.state    legacy mutator resume state (JSON string)
+        instrumentation.state   legacy coverage resume state
 
 Sidecar schema (docs/CORPUS.md):
 
@@ -38,6 +45,8 @@ import os
 import time
 from typing import Any, Dict, List, Optional
 
+from ..resilience import checkpoint as _ckpt
+from ..resilience.chaos import chaos_point
 from ..utils.fileio import ensure_dir, md5_hex
 from ..utils.logging import WARNING_MSG
 
@@ -45,8 +54,10 @@ STATE_FILE = "campaign.json"
 MUTATOR_STATE_FILE = "mutator.state"
 INSTR_STATE_FILE = "instrumentation.state"
 SOLVER_STATE_FILE = "solver.json"
+CHECKPOINT_FILE = _ckpt.CHECKPOINT_FILE
 _RESERVED = (STATE_FILE, MUTATOR_STATE_FILE, INSTR_STATE_FILE,
-             SOLVER_STATE_FILE)
+             SOLVER_STATE_FILE, CHECKPOINT_FILE,
+             CHECKPOINT_FILE + _ckpt.PREV_SUFFIX)
 
 
 def coverage_hash(sig: Optional[List[int]],
@@ -114,6 +125,10 @@ class CorpusEntry:
 
 
 def _atomic_write(path: str, data: bytes) -> None:
+    # chaos seam: every store write (entries, sidecars, campaign /
+    # solver state, checkpoint epochs) can be made to tear, hit
+    # ENOSPC, or die mid-write under --chaos
+    chaos_point("persist", path=path, data=data)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
@@ -136,6 +151,9 @@ class CorpusStore:
     def __init__(self, root: str):
         self.root = str(root)
         ensure_dir(self.root)
+        #: last checkpoint doc THIS process saved (single-writer
+        #: cache; None until the first save — readers then hit disk)
+        self._ckpt_doc: Optional[Dict[str, Any]] = None
         # continue the admission counter past any existing entries:
         # writing into a pre-populated store without load() (e.g.
         # --corpus-dir reused without --resume) must not mint
@@ -211,7 +229,8 @@ class CorpusStore:
         except OSError:
             return entries
         for name in sorted(names):
-            if name in _RESERVED or name.endswith((".json", ".tmp")):
+            if name in _RESERVED or \
+                    name.endswith((".json", ".tmp", ".prev")):
                 continue
             path = os.path.join(self.root, name)
             if not os.path.isfile(path):
@@ -239,12 +258,60 @@ class CorpusStore:
         try:
             return sum(1 for n in os.listdir(self.root)
                        if n not in _RESERVED
-                       and not n.endswith((".json", ".tmp"))
+                       and not n.endswith((".json", ".tmp", ".prev"))
                        and os.path.isfile(os.path.join(self.root, n)))
         except OSError:
             return 0
 
+    # -- crash-consistent checkpoint (resilience/checkpoint.py) ---------
+
+    def save_checkpoint(self, doc: Dict[str, Any]) -> Optional[int]:
+        """Write ONE atomic checkpoint epoch covering campaign state,
+        solver cache, event seq and component states — a kill at any
+        instruction resumes to a consistent campaign.  Sections the
+        caller omits (e.g. ``solver`` on a crack-less interval
+        persist) carry forward from the previous epoch instead of
+        being dropped; ``components`` carries forward PER KEY, so a
+        transient ``get_state()`` failure on one component cannot
+        erase its last good state from the epoch chain."""
+        prev = self.load_checkpoint()
+        if prev:
+            for section in ("campaign", "solver", "event_seq"):
+                if section not in doc and section in prev:
+                    doc[section] = prev[section]
+            pc = prev.get("components")
+            if isinstance(pc, dict):
+                dc = doc.get("components")
+                if isinstance(dc, dict):
+                    for k, v in pc.items():
+                        dc.setdefault(k, v)
+                elif "components" not in doc:
+                    doc["components"] = pc
+            if not doc.get("epoch"):
+                doc["epoch"] = int(prev.get("epoch", 0)) + 1
+        epoch = _ckpt.save(self.root, doc, atomic_write=_atomic_write)
+        if epoch is not None:
+            cached = dict(doc)
+            cached["epoch"] = epoch
+            self._ckpt_doc = cached
+        return epoch
+
+    def load_checkpoint(self) -> Optional[Dict[str, Any]]:
+        # this process is the only checkpoint writer for its corpus
+        # dir, so the last successfully saved doc is authoritative —
+        # interval persists never re-read/re-parse the (potentially
+        # large) document from disk
+        if self._ckpt_doc is not None:
+            return self._ckpt_doc
+        return _ckpt.load(self.root)
+
     # -- campaign state -------------------------------------------------
+    #
+    # load_state / load_solver_cache / load_component_state read the
+    # CHECKPOINT first (the unified epoch is the source of truth) and
+    # fall back to the legacy per-file layout, so pre-checkpoint
+    # campaigns and offline tools keep working.  The legacy savers
+    # remain for non-loop callers (kb-descend rounds, bench sweeps).
 
     def save_state(self, state: Dict[str, Any]) -> None:
         try:
@@ -254,6 +321,9 @@ class CorpusStore:
             WARNING_MSG("campaign state write failed: %s", e)
 
     def load_state(self) -> Optional[Dict[str, Any]]:
+        ck = self.load_checkpoint()
+        if ck and isinstance(ck.get("campaign"), dict):
+            return ck["campaign"]
         try:
             with open(os.path.join(self.root, STATE_FILE)) as f:
                 return json.load(f)
@@ -271,6 +341,11 @@ class CorpusStore:
             WARNING_MSG("%s state write failed: %s", which, e)
 
     def load_component_state(self, which: str) -> Optional[str]:
+        ck = self.load_checkpoint()
+        if ck:
+            comp = ck.get("components") or {}
+            if isinstance(comp.get(which), str):
+                return comp[which]
         name = (MUTATOR_STATE_FILE if which == "mutator"
                 else INSTR_STATE_FILE)
         try:
@@ -284,14 +359,25 @@ class CorpusStore:
     def save_solver_cache(self, cache: Dict[str, Any]) -> None:
         """Per-edge solve results ("f:t" -> {status, input_hex,
         reason}) — the solver is a pure function of the program, so a
-        resumed campaign re-injects/skips instead of re-solving."""
+        resumed campaign re-injects/skips instead of re-solving.
+        Loop-attached crackers persist through the unified checkpoint
+        instead (fuzzer._persist_campaign); this file remains the
+        offline-tool path.  When a checkpoint already exists the
+        cache ALSO writes through a fresh epoch — checkpoint-first
+        loaders would otherwise shadow these newer verdicts with the
+        epoch's stale solver section."""
         try:
             _atomic_write(os.path.join(self.root, SOLVER_STATE_FILE),
                           json.dumps(cache).encode())
         except OSError as e:
             WARNING_MSG("solver cache write failed: %s", e)
+        if self.load_checkpoint() is not None:
+            self.save_checkpoint({"solver": dict(cache)})
 
     def load_solver_cache(self) -> Dict[str, Any]:
+        ck = self.load_checkpoint()
+        if ck and isinstance(ck.get("solver"), dict):
+            return ck["solver"]
         try:
             with open(os.path.join(self.root, SOLVER_STATE_FILE)) as f:
                 d = json.load(f)

@@ -15,15 +15,20 @@ ROUND, and — because ``maybe_sync()`` runs on the fuzzing-loop
 thread, not a heartbeat thread — the in-loop default is a single
 attempt per request (``attempts=1``): the interval gate already
 retries at round granularity, so a dead manager costs one failed
-connection per round instead of inline backoff sleeps.  Everything
-degrades to warnings — corpus sync must never stall or kill the
-fuzzing loop.
+connection per round instead of inline backoff sleeps.  Failed
+rounds widen the gate with DECORRELATED jitter (next extra delay
+drawn from U[interval, 3x previous], capped) so a recovering manager
+is not hit by the whole fleet in interval-lockstep, and the
+``sync_consecutive_failures`` gauge tells kb-fleet's stall alert
+"partitioned" apart from "plateaued".  Everything degrades to
+warnings — corpus sync must never stall or kill the fuzzing loop.
 """
 
 from __future__ import annotations
 
 import base64
 import contextlib
+import random
 import time
 from typing import Any, Dict, List, Optional, Set
 
@@ -38,13 +43,28 @@ class CorpusSync:
 
     def __init__(self, manager_url: str, campaign: str,
                  worker: str = "anon", interval_s: float = 30.0,
-                 attempts: int = 1):
+                 attempts: int = 1, backoff_cap: Optional[float] = None,
+                 rng: Optional[random.Random] = None):
         self.url = f"{manager_url.rstrip('/')}/api/corpus/{campaign}"
         self.campaign = str(campaign)
         self.worker = worker
         self.interval_s = float(interval_s)
         self.attempts = int(attempts)
         self._last_sync = 0.0
+        # round backoff after transport failures: DECORRELATED jitter
+        # (next extra delay ~ U[interval, 3*previous], capped) — a
+        # whole fleet whose manager just recovered must NOT retry in
+        # interval-lockstep, which is exactly what a deterministic
+        # backoff would produce across workers started together
+        self.backoff_cap = (float(backoff_cap) if backoff_cap
+                            else 16.0 * self.interval_s)
+        self._backoff = 0.0              # extra delay beyond interval
+        self._rng = rng or random.Random()
+        #: consecutive failed rounds — surfaced as the
+        #: ``sync_consecutive_failures`` gauge so kb-fleet's
+        #: coverage-stall alert can tell "plateaued" from
+        #: "partitioned"
+        self.consecutive_failures = 0
         self._pushed: Set[str] = set()      # cov_hashes sent (or known)
         self._pending: List[CorpusEntry] = []   # admitted, not yet sent
         self._store_scanned = False
@@ -103,9 +123,10 @@ class CorpusSync:
 
     # -- pull -----------------------------------------------------------
 
-    def pull(self) -> List[CorpusEntry]:
+    def pull(self) -> Optional[List[CorpusEntry]]:
         """GET peers' entries newer than the cursor; returns the new
-        (locally unseen, not self-authored) ones."""
+        (locally unseen, not self-authored) ones — None on transport
+        failure (the round counts as failed and backs off)."""
         from urllib.parse import quote
         try:
             resp = self._request(
@@ -114,7 +135,7 @@ class CorpusSync:
                       f"&exclude={quote(self.worker, safe='')}")
         except Exception as e:
             WARNING_MSG("corpus pull from %s failed: %s", self.url, e)
-            return []
+            return None
         if not resp:
             return []
         self._cursor = max(self._cursor, int(resp.get("latest", 0)))
@@ -146,7 +167,8 @@ class CorpusSync:
         drain) still reach the fleet.  Returns True when a sync round
         ran."""
         now = time.time()
-        if not force and now - self._last_sync < self.interval_s:
+        gate = self.interval_s + self._backoff
+        if not force and now - self._last_sync < gate:
             return False
         self._last_sync = now
         # flight recorder: the round gets its own trace lane (a slow
@@ -197,7 +219,11 @@ class CorpusSync:
         # pull: peers' frontier into store + rotation
         pulled = 0
         if not failed:
-            for e in self.pull():
+            got = self.pull()
+            if got is None:
+                failed = True
+                got = []
+            for e in got:
                 if e.md5 in fuzzer._seen["new_paths"]:
                     continue        # already local (e.g. post-resume)
                 pulled += 1
@@ -218,6 +244,22 @@ class CorpusSync:
             reg.count("corpus_synced_out", sent)
         if pulled:
             reg.count("corpus_synced_in", pulled)
+        if failed:
+            self.consecutive_failures += 1
+            self._backoff = min(
+                self.backoff_cap,
+                self._rng.uniform(self.interval_s,
+                                  max(self.interval_s,
+                                      3.0 * self._backoff)))
+            DEBUG_MSG("corpus sync: round failed (%d in a row); "
+                      "next round in ~%.1fs",
+                      self.consecutive_failures,
+                      self.interval_s + self._backoff)
+        else:
+            self.consecutive_failures = 0
+            self._backoff = 0.0
+        reg.gauge("sync_consecutive_failures",
+                  self.consecutive_failures)
         reg.gauge("corpus_arms", len(fuzzer.scheduler.arms))
         fuzzer.telemetry.event(
             "sync_round", pushed=int(sent), pulled=int(pulled),

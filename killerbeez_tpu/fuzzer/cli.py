@@ -155,6 +155,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "rotation; kb-timeline and the heartbeat "
                         "forwarder read the rotated tail "
                         "transparently; 0 = unbounded, the default)")
+    p.add_argument("--watchdog", type=float, nargs="?", const=8.0,
+                   default=0.0, metavar="MULT",
+                   help="dispatch watchdog: every blocking device "
+                        "wait gets a deadline of MULT x the EMA "
+                        "batch time (default 8 when bare), clamped "
+                        "to [--watchdog-min, --watchdog-max]; a "
+                        "stalled dispatch dumps in-flight lane state "
+                        "(watchdog_dump.json + trace.json), emits a "
+                        "watchdog_stall event, checkpoints, and "
+                        "exits 86 so kbz-supervise restarts into "
+                        "--resume")
+    p.add_argument("--watchdog-min", type=float, default=5.0,
+                   metavar="S",
+                   help="watchdog deadline floor in seconds "
+                        "(default 5)")
+    p.add_argument("--watchdog-max", type=float, default=120.0,
+                   metavar="S",
+                   help="watchdog deadline ceiling in seconds "
+                        "(default 120)")
+    p.add_argument("--chaos", metavar="SPEC",
+                   help="fault-injection spec (JSON, or @file) fired "
+                        "at the chaos points — device dispatch/wait, "
+                        "persistence writes, manager RPC; see "
+                        "docs/RESILIENCE.md (also honored from the "
+                        "KBZ_CHAOS env var, which is how "
+                        "kbz-supervise --chaos injects faults into "
+                        "one child launch)")
     p.add_argument("--no-stats", action="store_true",
                    help="disable the periodic campaign stats files "
                         "(fuzzer_stats / plot_data / stats.jsonl in "
@@ -277,6 +304,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         setup_logging(args.logging_options)
 
+        # chaos harness: explicit --chaos wins; KBZ_CHAOS is how a
+        # supervisor injects faults into one child launch
+        from ..resilience import chaos as _chaos
+        _chaos.configure(args.chaos or os.environ.get("KBZ_CHAOS"))
+
         if args.seed_file:
             seed = read_file(args.seed_file)
         elif args.seed_string:
@@ -341,6 +373,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                                       or f"worker-{os.getpid()}"),
                               interval_s=args.sync_interval)
 
+        watchdog = None
+        if args.watchdog > 0:
+            from ..resilience.watchdog import DispatchWatchdog
+            watchdog = DispatchWatchdog(
+                multiplier=args.watchdog,
+                min_deadline=args.watchdog_min,
+                max_deadline=args.watchdog_max)
+
         fuzzer = Fuzzer(driver, output_dir=args.output,
                         batch_size=args.batch_size,
                         debug_triage=args.debug_triage,
@@ -354,7 +394,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         sync=sync,
                         trace=args.trace,
                         profile_device=args.profile_device,
-                        events_max_mb=args.events_max_mb)
+                        events_max_mb=args.events_max_mb,
+                        watchdog=watchdog)
         if args.schedule == "rare-edge":
             _wire_rare_edge_signer(fuzzer, driver)
             _wire_static_prior(fuzzer, driver)
@@ -377,7 +418,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                 focus=not args.no_focus, store=fuzzer.store,
                 descend=args.descend,
                 descend_lanes=args.descend_lanes)
-        stats = fuzzer.run(args.iterations)
+        try:
+            stats = fuzzer.run(args.iterations)
+        except Exception as e:
+            # run()'s finally already checkpointed; classify a
+            # device loss for the supervisor (exit 87 -> it
+            # re-probes devices before restarting into --resume)
+            from ..resilience import (
+                DEVICE_LOST_EXIT_CODE, is_device_loss,
+            )
+            if is_device_loss(e):
+                fuzzer.telemetry.event("device_lost",
+                                       error=str(e)[:300])
+                print(f"error: device lost: {e}", file=sys.stderr)
+                return DEVICE_LOST_EXIT_CODE
+            raise
         # both rates read the SAME registry the loop recorded into —
         # the CLI never recomputes from its own wall clock
         INFO_MSG(

@@ -197,7 +197,7 @@ class BranchCracker:
             if self.descend else 0
 
         if self.store is not None and (fresh or searched):
-            self.store.save_solver_cache(self.cache)
+            self._persist_verdicts(fuzzer)
 
         # inject every cached solve/descent whose edge is STILL
         # uncovered — includes results restored from a resumed
@@ -226,6 +226,22 @@ class BranchCracker:
             remaining = self.uncovered_edges(instr)
             self._update_mask(fuzzer, remaining)
         return injected
+
+    def _persist_verdicts(self, fuzzer) -> None:
+        """Fresh verdicts hit disk through the loop's unified
+        checkpoint when this cracker is the loop's (ONE atomic epoch:
+        the corpus state and the solver cache can never disagree
+        about a kill again — the old separate solver.json write left
+        a window where a kill between the corpus persist and the
+        cache save forgot crack verdicts).  Offline callers
+        (kb-descend rounds, bench sweeps) keep the standalone
+        solver.json path."""
+        if fuzzer is not None and \
+                getattr(fuzzer, "cracker", None) is self and \
+                getattr(fuzzer, "store", None) is self.store:
+            fuzzer._persist_campaign(now=True)
+        else:
+            self.store.save_solver_cache(self.cache)
 
     # -- the search-tier escalation (search/descent.py) -----------------
 

@@ -12,6 +12,8 @@ reference-shaped scalar loop.
 from __future__ import annotations
 
 import base64
+import contextlib
+import json
 import os
 import time
 from typing import Dict, Optional, Union
@@ -22,6 +24,7 @@ from .. import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_NONE
 from ..corpus.schedule import Arm, Scheduler, make_scheduler
 from ..corpus.store import CorpusStore
 from ..drivers.base import Driver
+from ..resilience.chaos import chaos_point
 from ..telemetry import MetricsRegistry, Telemetry
 from ..utils.fileio import ensure_dir, md5_hex, write_buffer_to_file
 from ..utils.logging import CRITICAL_MSG, DEBUG_MSG, INFO_MSG, WARNING_MSG
@@ -167,7 +170,8 @@ class Fuzzer:
                  persist_interval: float = 5.0,
                  trace=None,
                  profile_device: int = 0,
-                 events_max_mb: float = 0.0):
+                 events_max_mb: float = 0.0,
+                 watchdog=None):
         self.driver = driver
         self.output_dir = output_dir
         self.batch_size = int(batch_size)
@@ -249,6 +253,18 @@ class Fuzzer:
         self._batch_seq = 0
         self._persist_interval = float(persist_interval)
         self._last_persist = 0.0
+        #: dispatch watchdog (resilience/watchdog.py): a deadline on
+        #: every blocking device wait; a stall dumps in-flight lane
+        #: state and escalates to a supervisor-mediated restart
+        self.watchdog = watchdog
+        if watchdog is not None:
+            watchdog.registry = telemetry.registry
+            watchdog.telemetry = telemetry
+            watchdog.dump_fn = self._watchdog_dump
+            watchdog.note_batch(self.batch_size)
+        #: live view of the pipeline's pending deque for the watchdog
+        #: dump (set by _run_batched)
+        self._pending = None
         # the arm whose candidates the batch being TRIAGED came from:
         # with a deep pipeline, triage lags generation, so finds must
         # credit the GENERATING arm (entry object, robust to corpus
@@ -301,19 +317,25 @@ class Fuzzer:
 
     # -- campaign persistence / resume (corpus/store.py) ----------------
 
-    def _persist_campaign(self, force: bool = False) -> None:
-        """Flush scheduler + campaign state to the corpus store.
-        Interval writes cover a hard kill (scheduler stats, counters,
-        arm sidecars — all host-side, no device sync); ``force`` (run
-        end, including interrupts) adds the mutator/instrumentation
-        resume states, whose serialization may join the device
-        pipeline."""
+    def _persist_campaign(self, force: bool = False,
+                          now: bool = False) -> None:
+        """Flush the campaign to the corpus store as ONE atomic
+        checkpoint epoch (resilience/checkpoint.py): scheduler +
+        counters + solver cache + event seq land together, so a kill
+        at any instruction resumes consistent — there is no window
+        where the corpus reflects crack verdicts the solver cache has
+        forgotten.  ``now`` skips the interval gate but stays
+        host-side; ``force`` (run end, including interrupts) adds the
+        mutator/instrumentation resume states, whose serialization
+        may join the device pipeline (never from the watchdog — the
+        device is the thing that is stuck)."""
         if self.store is None:
             return
-        now = time.time()
-        if not force and now - self._last_persist < self._persist_interval:
+        t = time.time()
+        if not force and not now and \
+                t - self._last_persist < self._persist_interval:
             return
-        self._last_persist = now
+        self._last_persist = t
         base = self.scheduler.base_seed
         reg = self.telemetry.registry
         counters = dict(reg.counters)
@@ -322,7 +344,7 @@ class Fuzzer:
         # resumed campaign divides restored execs by a near-zero
         # denominator and reports an absurd lifetime rate
         counters["run_seconds"] = reg.active_seconds()
-        self.store.save_state({
+        doc = {"campaign": {
             "version": 1,
             "scheduler_state": self.scheduler.state_dict(),
             "counters": counters,
@@ -335,25 +357,35 @@ class Fuzzer:
             "feedback": self.feedback,
             "base_seed_b64": (base64.b64encode(base).decode()
                               if base else None),
-            "saved_at": now,
-        })
-        if not force:
-            return
-        for arm in self.scheduler.arms:
-            self.store.update_meta(arm.to_entry())
-        mut = getattr(self.driver, "mutator", None)
-        instr = getattr(self.driver, "instrumentation", None)
-        for which, comp in (("mutator", mut),
-                            ("instrumentation", instr)):
-            if comp is None:
-                continue
-            try:
-                self.store.save_component_state(which,
-                                                comp.get_state())
-            except NotImplementedError:
-                pass
-            except Exception as e:
-                WARNING_MSG("%s state persist failed: %s", which, e)
+            "saved_at": t,
+        }}
+        if self.cracker is not None:
+            doc["solver"] = self.cracker.cache
+        if self.telemetry.events is not None:
+            # the log's high-water at save time: resume anchors seq
+            # at max(file tail, checkpoint) so a torn/lost log can
+            # never regress the stream
+            doc["event_seq"] = self.telemetry.events.next_seq
+        if force:
+            components = {}
+            mut = getattr(self.driver, "mutator", None)
+            instr = getattr(self.driver, "instrumentation", None)
+            for which, comp in (("mutator", mut),
+                                ("instrumentation", instr)):
+                if comp is None:
+                    continue
+                try:
+                    components[which] = comp.get_state()
+                except NotImplementedError:
+                    pass
+                except Exception as e:
+                    WARNING_MSG("%s state persist failed: %s",
+                                which, e)
+            doc["components"] = components
+        self.store.save_checkpoint(doc)
+        if force:
+            for arm in self.scheduler.arms:
+                self.store.update_meta(arm.to_entry())
 
     def _restore_campaign(self) -> None:
         """Rebuild scheduler arms, campaign counters and component
@@ -413,6 +445,17 @@ class Fuzzer:
             except Exception as e:
                 WARNING_MSG("%s state restore failed (fresh %s "
                             "state): %s", which, which, e)
+        # no-event-seq-regression invariant: even if events.jsonl was
+        # torn away or truncated, the checkpoint's high-water keeps
+        # the resumed stream monotone for every cursor consumer
+        ck = self.store.load_checkpoint()
+        if ck and self.telemetry.events is not None:
+            try:
+                # event_seq is the checkpointed NEXT seq to mint
+                self.telemetry.events.ensure_seq_at_least(
+                    int(ck.get("event_seq", 0)))
+            except (TypeError, ValueError):
+                pass
         # -n counts THIS invocation's executions; restored lifetime
         # counters keep stats files and rates cumulative
         self._iter_base = int(self.stats.iterations)
@@ -540,8 +583,20 @@ class Fuzzer:
             # corpus feedback keeps only EDGE-novel findings (ret 2:
             # a brand-new edge, not just a new hit-count bucket) —
             # bucket-only findings are overwhelmingly shallow
-            # variants that dilute the rotation
-            if recorded and new_path == 2 and \
+            # variants that dilute the rotation.  ``heal``: a kill
+            # can land BETWEEN the finding write and the store
+            # write-through, leaving the finding on disk (so the
+            # resume replay dedups it, recorded=False) but absent
+            # from the store — re-admit exactly that case so no
+            # admission is ever lost, without ever minting a
+            # duplicate arm (store md5 + rotation scan)
+            heal = (not recorded and self.store is not None
+                    and new_path == 2
+                    and not os.path.exists(
+                        self.store.entry_path(digest))
+                    and not any(getattr(a, "md5", None) == digest
+                                for a in self.scheduler.arms))
+            if (recorded or heal) and new_path == 2 and \
                     (self.feedback or self.store is not None):
                 arm = Arm(buf,
                           parent=getattr(self._credit_arm, "md5",
@@ -587,6 +642,8 @@ class Fuzzer:
             else:
                 self._run_single(n_iterations)
         finally:
+            if self.watchdog is not None:
+                self.watchdog.stop()
             self._profile_stop()
             self.telemetry.registry.run_ended()
             self.telemetry.flush()
@@ -661,7 +718,12 @@ class Fuzzer:
         timer = self.telemetry.timer
         if packed is not None:
             from ..instrumentation.base import unpack_verdicts
-            with timer("host_transfer"):
+            with self._wd_guard("host_transfer"), \
+                    timer("host_transfer"):
+                # chaos seam INSIDE the guard: a "hang" here is
+                # exactly what a wedged device looks like from the
+                # host — a lazy array that never materializes
+                chaos_point("device_wait")
                 pk = np.asarray(packed)      # prefetched: cache hit
             statuses, new_paths, uc, uh = unpack_verdicts(pk)
             statuses = statuses.astype(np.int32)
@@ -669,7 +731,9 @@ class Fuzzer:
             # host-backed results are already numpy (instant); device
             # results without a prefetched pack block here — exactly
             # the wait this stage exists to expose
-            with timer("host_transfer"):
+            with self._wd_guard("host_transfer"), \
+                    timer("host_transfer"):
+                chaos_point("device_wait")
                 statuses = np.asarray(res.statuses)
                 new_paths = np.asarray(res.new_paths)
             uc = uh = None
@@ -789,6 +853,50 @@ class Fuzzer:
         except Exception as e:
             WARNING_MSG("device profile stop failed: %s", e)
 
+    def _wd_guard(self, stage: str):
+        """Watchdog deadline over one blocking region (no-op without
+        a watchdog installed)."""
+        if self.watchdog is None:
+            return contextlib.nullcontext()
+        return self.watchdog.guard(stage)
+
+    def _watchdog_dump(self, stage: str, waited: float,
+                       deadline: float) -> None:
+        """Stall post-mortem, called from the WATCHDOG thread while
+        the main thread is stuck: snapshot the in-flight pipeline
+        lanes into <output>/watchdog_dump.json, overlay them on the
+        flight recorder and export trace.json, then checkpoint the
+        host-side campaign state (``now=True``, never ``force`` —
+        component serialization could join the stuck pipeline)."""
+        pend = []
+        for item in list(self._pending or []):
+            out, room, iters, packed, arm_entry, lane = item
+            pend.append({"iterations": int(iters), "room": int(room),
+                         "lane": lane,
+                         "arm": getattr(arm_entry, "md5", None)})
+        tr = self.telemetry.trace
+        if tr is not None:
+            for p in pend:
+                tr.instant("watchdog_in_flight", args=p)
+            if self.write_findings:
+                self.telemetry.export_trace(
+                    os.path.join(self.output_dir, "trace.json"))
+        if self.write_findings:
+            doc = {"t": time.time(), "stage": stage,
+                   "waited_s": round(waited, 3),
+                   "deadline_s": round(deadline, 3),
+                   "iterations": int(self.stats.iterations),
+                   "batch_seq": int(self._batch_seq),
+                   "pending": pend}
+            try:
+                write_buffer_to_file(
+                    os.path.join(self.output_dir,
+                                 "watchdog_dump.json"),
+                    json.dumps(doc, default=str).encode())
+            except OSError as e:
+                WARNING_MSG("watchdog dump write failed: %s", e)
+        self._persist_campaign(now=True)
+
     def _trace_lane(self, tr) -> int:
         """Point the recorder at THIS batch's pipeline lane (one of
         PIPELINE_DEPTH slots, reused round-robin — a slot is free by
@@ -901,8 +1009,10 @@ class Fuzzer:
             # the fused dispatch is ONE device call covering k
             # batches; its execute span lands on the first slot
             self._trace_lane(tr)
-        packed, bufs, lens, compact = \
-            self.driver.test_batch_fused_multi(b, k)
+        with self._wd_guard("dispatch"):
+            chaos_point("device_dispatch")
+            packed, bufs, lens, compact = \
+                self.driver.test_batch_fused_multi(b, k)
         ph = _StackedRows(packed)
         idxh, sbh, slh, cnth = (_StackedRows(a) for a in compact)
         for j in range(k):
@@ -954,6 +1064,7 @@ class Fuzzer:
         from collections import deque
         mut = self.driver.mutator
         pending: "deque" = deque()
+        self._pending = pending         # watchdog-dump visibility
         # sharded campaigns execute fixed whole-mesh batches; a tail
         # smaller than the quantum is skipped with a warning instead
         # of dying mid-run
@@ -1062,9 +1173,11 @@ class Fuzzer:
                     # mutate/execute spans (driver stage timer) land
                     # on this batch's pipeline lane
                     lane = self._trace_lane(tr)
-                out = self.driver.test_batch(room,
-                                             pad_to=self.batch_size,
-                                             prefetch_next=max(nxt, 0))
+                with self._wd_guard("dispatch"):
+                    chaos_point("device_dispatch")
+                    out = self.driver.test_batch(
+                        room, pad_to=self.batch_size,
+                        prefetch_next=max(nxt, 0))
                 self.stats.iterations += room
                 packed = self._prefetch(out)
                 if tr is not None:
@@ -1113,7 +1226,9 @@ class Fuzzer:
             # one "batch" (the flag must not silently no-op here)
             if self.profile_device and not self._prof_active:
                 self._profile_start()
-            with self.telemetry.timer("execute"):
+            with self._wd_guard("execute"), \
+                    self.telemetry.timer("execute"):
+                chaos_point("device_dispatch")
                 result = self.driver.test_next_input()
             if result is None:  # mutator exhausted (reference -2)
                 INFO_MSG("mutator exhausted after %d iterations",

@@ -202,11 +202,15 @@ class AflInstrumentation(Instrumentation):
             # as per-target child env, not the fuzzer's own environ
             extra_env.append("KB_MODULES=1")
         if self.options["qemu_mode"]:
-            # budget for kb-trace's UnTracer full-map re-run: it must
-            # finish inside the exec's status window or the exec is
-            # misreported as a hang (kb_trace.c kb_rerun_budget)
+            # kb-trace's UnTracer full-map re-run must finish inside
+            # the exec's status window or the exec is misreported as
+            # a hang: pass the FULL per-exec timeout — the tracer
+            # arms its guard with what is LEFT of it after the fast
+            # exec (max(min, timeout - elapsed); a fixed fraction
+            # starved slow targets whose normal runtime approaches
+            # the timeout — kb_trace.c kb_rerun_budget)
             extra_env.append(
-                f"KB_TRACE_BUDGET={0.8 * float(self.options['timeout'])}")
+                f"KB_TRACE_BUDGET={float(self.options['timeout'])}")
         if extra_env:
             kwargs["extra_env"] = extra_env
         workers = int(self.options["workers"])

@@ -35,6 +35,10 @@ RESULT_DIRS = {"crashes": "crash", "hangs": "hang",
 
 def _request(url: str, payload: Optional[Dict[str, Any]] = None,
              method: str = "POST") -> Any:
+    # chaos seam: every manager RPC (work claim, heartbeat, corpus
+    # sync, event forward) can be made to 500 or partition mid-round
+    from ..resilience.chaos import chaos_point
+    chaos_point("manager_rpc", url=url)
     data = json.dumps(payload).encode() if payload is not None else None
     req = urllib.request.Request(
         url, data=data, method=method,
@@ -434,6 +438,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("-l", "--logging-options")
     args = p.parse_args(argv)
     setup_logging(args.logging_options)
+    # chaos harness: a supervised/chaos-tested worker picks its fault
+    # spec up from KBZ_CHAOS (the manager_rpc seam in _request fires
+    # nothing otherwise)
+    from ..resilience.chaos import configure_from_env
+    configure_from_env()
     n = work_loop(args.manager_url, args.name, once=args.once,
                   in_process=args.in_process,
                   corpus_sync_s=args.corpus_sync)

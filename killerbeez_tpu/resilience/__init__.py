@@ -1,0 +1,74 @@
+"""Campaign resilience subsystem — the manager/BOINC tier's fault
+model (PAPER.md §L3+: workers die constantly, campaigns survive
+anyway) brought to the TPU tier.
+
+Four pieces:
+
+  * ``chaos.py``      — deterministic fault injection at every seam
+                        (device dispatch, persistence, manager RPC,
+                        SIGKILL at randomized points); the test
+                        harness that proves the rest works.
+  * ``watchdog.py``   — dispatch watchdog: a deadline on every
+                        blocking device wait, scaled from the EMA
+                        batch time; a stuck dispatch dumps state and
+                        escalates to a supervisor-mediated restart.
+  * ``checkpoint.py`` — crash-consistent campaign checkpoints: ONE
+                        atomic ``checkpoint.json`` epoch covering
+                        scheduler/campaign state, solver cache, event
+                        seq and component states, so a kill at any
+                        instruction resumes consistent.
+  * ``supervisor.py`` — ``kbz-supervise``: runs the fuzz loop as a
+                        child, classifies exits (clean / crash /
+                        device-lost / watchdog-kill) and restarts
+                        into ``--resume`` with capped exponential
+                        backoff, re-probing JAX devices on device
+                        loss and degrading (mesh shrink, native-tier
+                        fallback) when chips stay dead.
+
+Exit-code contract between the loop and the supervisor (chosen clear
+of the CLI's 0/1/2 usage codes and shells' 126+ conventions):
+
+  * ``WATCHDOG_EXIT_CODE`` (86) — the dispatch watchdog killed a
+    stuck device wait after dumping in-flight state.
+  * ``DEVICE_LOST_EXIT_CODE`` (87) — the loop died on a device-loss
+    error (XlaRuntimeError / preemption); devices need re-probing
+    before a restart is worth attempting.
+"""
+
+from __future__ import annotations
+
+#: the dispatch watchdog killed the process over a stuck device wait
+WATCHDOG_EXIT_CODE = 86
+
+#: the loop exited on a classified device-loss error
+DEVICE_LOST_EXIT_CODE = 87
+
+#: substrings (lowercased) that mark an exception or a stderr line as
+#: a device loss rather than a plain crash: JAX/XLA runtime failures,
+#: TPU preemptions, and the chaos harness's injected stand-in
+_DEVICE_LOSS_MARKERS = (
+    "xlaruntimeerror", "device_lost", "device lost", "data_loss",
+    "preempt", "tpu_terminated", "slice became unhealthy",
+    "failed to connect to all addresses", "deadline_exceeded",
+    "device or resource busy",
+)
+
+
+def is_device_loss(exc_or_text) -> bool:
+    """True when an exception (or a stderr line) looks like the
+    accelerator went away — the class of failure where restarting
+    without re-probing devices would just die again."""
+    if isinstance(exc_or_text, BaseException):
+        text = f"{type(exc_or_text).__name__}: {exc_or_text}"
+    else:
+        text = str(exc_or_text)
+    low = text.lower()
+    return any(m in low for m in _DEVICE_LOSS_MARKERS)
+
+
+from .chaos import chaos_point  # noqa: E402  (hot-path no-op hook)
+
+__all__ = [
+    "DEVICE_LOST_EXIT_CODE", "WATCHDOG_EXIT_CODE", "chaos_point",
+    "is_device_loss",
+]

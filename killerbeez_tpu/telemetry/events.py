@@ -41,6 +41,7 @@ import os
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
+from ..resilience.chaos import chaos_point
 from ..utils.fileio import ensure_dir
 from ..utils.logging import WARNING_MSG
 
@@ -53,7 +54,11 @@ EVENT_TYPES = ("new_path", "crash", "hang", "plateau",
                # worker health registry and the alert evaluator emit
                # these into the same campaign stream)
                "worker_stale", "worker_dead", "worker_returned",
-               "alert")
+               "alert",
+               # resilience records (resilience/): a dispatch the
+               # watchdog had to kill, and a classified device loss
+               # the supervisor will re-probe for
+               "watchdog_stall", "device_lost")
 
 #: events a fleet worker forwards to the manager alongside heartbeats
 TERMINAL_EVENTS = ("crash", "hang", "plateau")
@@ -195,6 +200,13 @@ class EventLog:
     def next_seq(self) -> int:
         return self._seq
 
+    def ensure_seq_at_least(self, seq: int) -> None:
+        """Raise the next seq to at least ``seq`` — the resume path
+        floors the stream at the checkpoint's high-water so a torn or
+        truncated log can never make seq regress for cursor
+        consumers."""
+        self._seq = max(self._seq, int(seq))
+
     def emit(self, etype: str, **fields) -> Dict[str, Any]:
         """Append one record; returns it (even when the write failed —
         in-process consumers still see the event)."""
@@ -204,6 +216,10 @@ class EventLog:
         self._seq += 1
         self.last_times[etype] = rec["t"]
         try:
+            # chaos seam: the event append is a persistence path too
+            # (ENOSPC degrades to the warning below; kill mode is the
+            # mid-append power cut readers must heal from)
+            chaos_point("event_append", path=self.path)
             if self._fh is None:
                 self._fh = open(self.path, "a")
                 # a previous process killed mid-append leaves a torn

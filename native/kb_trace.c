@@ -72,6 +72,7 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/personality.h>
+#include <time.h>
 #include <sys/ptrace.h>
 #include <sys/shm.h>
 #include <sys/time.h>
@@ -481,15 +482,38 @@ static void kb_guard_alarm(int sig) {
 /* Re-run time budget: the re-run happens inside the exec's status
  * window, so it must finish before the FUZZER's per-exec timeout or
  * the exec is misreported as a hang (and a long enough overrun tears
- * the forkserver down).  The fuzzer passes its budget via
- * KB_TRACE_BUDGET (seconds, fractional); default/cap 10s.  Armed via
+ * the forkserver down).  The fuzzer passes the FULL per-exec timeout
+ * via KB_TRACE_BUDGET (seconds, fractional); default/cap 10s.  The
+ * guard is armed with what is LEFT of that window —
+ * max(min_budget, timeout - elapsed_fast_exec) — because for targets
+ * whose normal runtime approaches the timeout, a fixed fraction of
+ * it ignores the time the fast exec already spent: fast-exec +
+ * full-trace re-run would overrun the window, the exec would be
+ * misreported as a hang, the re-armed leaders would re-fire, and the
+ * pattern would repeat on every novelty-bearing exec.  Armed via
  * setitimer, not alarm(), so sub-second fuzzer timeouts are
  * honored. */
+#define KB_RERUN_MIN_BUDGET 0.05
+
+static struct timespec kb_exec_t0;
+
+static void kb_exec_mark(void) {
+  clock_gettime(CLOCK_MONOTONIC, &kb_exec_t0);
+}
+
+static double kb_exec_elapsed(void) {
+  struct timespec now;
+  clock_gettime(CLOCK_MONOTONIC, &now);
+  return (double)(now.tv_sec - kb_exec_t0.tv_sec) +
+         (double)(now.tv_nsec - kb_exec_t0.tv_nsec) / 1e9;
+}
+
 static double kb_rerun_budget(void) {
   const char *e = getenv("KB_TRACE_BUDGET");
   double d = e ? atof(e) : 0;
   if (d <= 0 || d > 10) d = 10;
-  if (d < 0.05) d = 0.05;
+  d -= kb_exec_elapsed();
+  if (d < KB_RERUN_MIN_BUDGET) d = KB_RERUN_MIN_BUDGET;
   return d;
 }
 
@@ -1247,6 +1271,16 @@ int main(int argc, char **argv) {
     if (kb_template > 0 && !kb_env_flag("KB_TRACE_FULL") &&
         !kb_opt_hash && kb_load_heads(argv[1]))
       kb_untracer_arm(argv[1]);
+    if (kb_untracer)
+      /* the default engine changes the coverage SEMANTICS, not just
+       * the speed — say so once, loudly, so campaigns know which
+       * fidelity they ran under without KB_TRACE_DEBUG archaeology */
+      fprintf(stderr,
+              "kb_trace: UnTracer engine active — coverage is "
+              "block-granular (a new edge between already-seen "
+              "blocks or a hit-count change is not reported); set "
+              "KB_TRACE_FULL=1 to restore edge-fidelity "
+              "block-stepping\n");
 #endif
   }
 
@@ -1273,6 +1307,9 @@ int main(int argc, char **argv) {
 
       case KB_CMD_FORK:
       case KB_CMD_FORK_RUN: {
+        /* the fuzzer's per-exec status window opens here: the
+         * UnTracer re-run budget is measured from this mark */
+        kb_exec_mark();
         child = -1;
         child_tmpl = 0;
 #if defined(__x86_64__)

@@ -1,0 +1,805 @@
+"""Resilience suite — chaos harness, crash-consistent checkpoints,
+dispatch watchdog, campaign supervisor (killerbeez_tpu/resilience/).
+
+The invariants pinned here are ISSUE 8's acceptance criteria:
+
+  * no finding is ever lost after admission (SIGKILL at randomized
+    persistence points + resume ends with the fault-free control
+    run's exact findings/corpus sets);
+  * the event seq never regresses (across rotation, kills, resumes,
+    and a torn/lost log healed from the checkpoint high-water);
+  * no duplicate corpus arms after kill/resume cycles;
+  * a supervised campaign survives an injected device loss AND a
+    SIGKILL and converges to the control run's state;
+  * the watchdog kills a synthetically-hung dispatch within 2x the
+    armed deadline.
+
+CLI-level cases run the fuzzer in a SUBPROCESS (SIGKILL faults must
+not kill pytest); the CI chaos lane runs this whole file.
+"""
+
+import errno
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from killerbeez_tpu.corpus.store import CorpusStore
+from killerbeez_tpu.resilience import (
+    DEVICE_LOST_EXIT_CODE, WATCHDOG_EXIT_CODE, chaos_point,
+    is_device_loss,
+)
+from killerbeez_tpu.resilience import chaos as chaos_mod
+from killerbeez_tpu.resilience import checkpoint as ckpt
+from killerbeez_tpu.resilience.supervisor import (
+    CLEAN, CRASH, DEVICE_LOST, Supervisor, WATCHDOG, classify_exit,
+    shrink_mesh,
+)
+from killerbeez_tpu.resilience.watchdog import DispatchWatchdog
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _chaos_off():
+    """Never leak a configured chaos engine between tests."""
+    yield
+    chaos_mod.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# chaos engine
+# ---------------------------------------------------------------------------
+
+def test_chaos_off_is_noop():
+    chaos_mod.configure(None)
+    chaos_point("device_dispatch")      # nothing configured: no-op
+    chaos_point("persist", path="/nope", data=b"x")
+
+
+def test_chaos_hit_trigger_fires_exactly_once():
+    chaos_mod.configure({"faults": [
+        {"point": "device_dispatch", "mode": "raise", "hit": 3}]})
+    chaos_point("device_dispatch")
+    chaos_point("device_dispatch")
+    with pytest.raises(chaos_mod.XlaRuntimeError) as ei:
+        chaos_point("device_dispatch")
+    assert is_device_loss(ei.value)     # classified like the real one
+    chaos_point("device_dispatch")      # hit 4: armed once, not again
+
+
+def test_chaos_every_trigger_and_counters():
+    eng = chaos_mod.configure({"faults": [
+        {"point": "manager_rpc", "mode": "enospc", "every": 2}]})
+    chaos_point("manager_rpc")
+    with pytest.raises(OSError):
+        chaos_point("manager_rpc")
+    chaos_point("manager_rpc")
+    with pytest.raises(OSError):
+        chaos_point("manager_rpc")
+    assert eng.state()["hits"]["manager_rpc"] == 4
+    assert eng.state()["fired"]["manager_rpc/enospc"] == 2
+
+
+def test_chaos_prob_trigger_is_seed_deterministic():
+    def fire_pattern(seed):
+        eng = chaos_mod.configure({"seed": seed, "faults": [
+            {"point": "p", "mode": "enospc", "prob": 0.5}]})
+        pat = []
+        for _ in range(32):
+            try:
+                chaos_point("p")
+                pat.append(0)
+            except OSError:
+                pat.append(1)
+        return pat, eng
+    a, _ = fire_pattern(7)
+    b, _ = fire_pattern(7)
+    c, _ = fire_pattern(8)
+    assert a == b                       # same seed: same fault train
+    assert a != c
+    assert 0 < sum(a) < 32
+
+
+def test_chaos_spec_from_json_string_and_file(tmp_path):
+    eng = chaos_mod.configure(
+        '{"faults": [{"point": "x", "mode": "timeout"}]}')
+    assert eng.faults[0].mode == "timeout"
+    f = tmp_path / "spec.json"
+    f.write_text('{"faults": [{"point": "y", "mode": "http500"}]}')
+    eng = chaos_mod.configure(f"@{f}")
+    assert eng.faults[0].point == "y"
+    with pytest.raises(ValueError):
+        chaos_mod.configure({"faults": [{"point": "z",
+                                         "mode": "nonsense"}]})
+
+
+def test_chaos_http_modes_raise_urllib_errors():
+    import urllib.error
+    chaos_mod.configure({"faults": [
+        {"point": "rpc", "mode": "http500", "hit": 1},
+        {"point": "rpc", "mode": "timeout", "hit": 2}]})
+    with pytest.raises(urllib.error.HTTPError):
+        chaos_point("rpc", url="http://x")
+    with pytest.raises(urllib.error.URLError):
+        chaos_point("rpc", url="http://x")
+
+
+def test_chaos_torn_write_tears_in_place_and_store_survives(tmp_path):
+    """The ``torn`` mode bypasses temp+rename and leaves half the
+    payload at the FINAL path: every loader must degrade, none may
+    raise."""
+    store = CorpusStore(str(tmp_path))
+    store.save_state({"version": 1, "counters": {"execs": 1}})
+    chaos_mod.configure({"faults": [
+        {"point": "persist", "mode": "torn", "hit": 1}]})
+    store.save_state({"version": 1, "counters": {"execs": 2}})
+    chaos_mod.configure(None)
+    raw = (tmp_path / "campaign.json").read_text()
+    with pytest.raises(ValueError):
+        json.loads(raw)                 # really torn on disk
+    assert store.load_state() is None   # degrades, no raise
+    assert store.load() == []
+
+
+def test_chaos_enospc_on_persist_never_kills_the_store(tmp_path):
+    store = CorpusStore(str(tmp_path))
+    chaos_mod.configure({"faults": [
+        {"point": "persist", "mode": "enospc", "every": 1}]})
+    from killerbeez_tpu.corpus.store import CorpusEntry
+    assert store.put(CorpusEntry(b"abc")) is False  # warned, survived
+    store.save_state({"v": 1})
+    assert store.save_checkpoint({"campaign": {"v": 1}}) is None
+
+
+# ---------------------------------------------------------------------------
+# exit classification / mesh degradation
+# ---------------------------------------------------------------------------
+
+def test_is_device_loss_markers():
+    assert is_device_loss(RuntimeError("DEVICE_LOST: slice gone"))
+    assert is_device_loss("XlaRuntimeError: INTERNAL")
+    assert is_device_loss("TPU worker preempted")
+    assert not is_device_loss(ValueError("bad option"))
+    assert not is_device_loss("assertion failed")
+
+
+def test_classify_exit():
+    assert classify_exit(0, []) == CLEAN
+    assert classify_exit(WATCHDOG_EXIT_CODE, []) == WATCHDOG
+    assert classify_exit(DEVICE_LOST_EXIT_CODE, []) == DEVICE_LOST
+    assert classify_exit(1, ["XlaRuntimeError: DEVICE_LOST"]) \
+        == DEVICE_LOST
+    assert classify_exit(1, ["ValueError: x"]) == CRASH
+    assert classify_exit(-signal.SIGKILL, []) == CRASH
+
+
+def test_shrink_mesh():
+    assert shrink_mesh("4,2", 8) == "4,2"       # fits: unchanged
+    assert shrink_mesh("4,2", 4) == "2,2"       # dp halves
+    assert shrink_mesh("4,2", 2) == "1,2"
+    assert shrink_mesh("4,2", 1) is None        # mp won't fit at all
+    assert shrink_mesh("bogus", 8) is None
+
+
+# ---------------------------------------------------------------------------
+# crash-consistent checkpoint
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_epoch_monotone_roundtrip(tmp_path):
+    root = str(tmp_path)
+    assert ckpt.load(root) is None
+    e1 = ckpt.save(root, {"campaign": {"execs": 1}})
+    e2 = ckpt.save(root, {"campaign": {"execs": 2}})
+    assert (e1, e2) == (1, 2)
+    doc = ckpt.load(root)
+    assert doc["epoch"] == 2 and doc["campaign"]["execs"] == 2
+
+
+def test_checkpoint_torn_live_file_heals_from_prev(tmp_path):
+    """Torn-tail healing pinned: garbage over the live checkpoint
+    (chaos ``torn``, fs corruption) falls back to the previous
+    epoch instead of losing the campaign."""
+    root = str(tmp_path)
+    ckpt.save(root, {"campaign": {"execs": 1}})
+    ckpt.save(root, {"campaign": {"execs": 2}})
+    live = tmp_path / ckpt.CHECKPOINT_FILE
+    live.write_text('{"epoch": 3, "campaign": {"ex')   # torn mid-write
+    doc = ckpt.load(root)
+    assert doc["epoch"] == 1            # .prev holds the epoch before
+    assert doc["campaign"]["execs"] == 1
+    # the next save continues the epoch line past the healed doc
+    assert ckpt.save(root, {"campaign": {"execs": 3}}) == 2
+
+
+def test_checkpoint_sections_carry_forward(tmp_path):
+    """An interval persist without a cracker must not drop the solver
+    section a previous epoch recorded."""
+    store = CorpusStore(str(tmp_path))
+    store.save_checkpoint({"campaign": {"a": 1},
+                           "solver": {"0:1": {"status": "solved"}},
+                           "event_seq": 9})
+    store.save_checkpoint({"campaign": {"a": 2}})
+    ck = store.load_checkpoint()
+    assert ck["campaign"] == {"a": 2}
+    assert ck["solver"] == {"0:1": {"status": "solved"}}
+    assert ck["event_seq"] == 9
+
+
+def test_checkpoint_torn_live_never_destroys_prev_on_next_save(
+        tmp_path):
+    """A torn live file must NOT be hardlinked over ``.prev`` by the
+    next save: with the old behavior, a write failure (or a kill)
+    after that link left NO readable checkpoint at all."""
+    root = str(tmp_path)
+    ckpt.save(root, {"campaign": {"execs": 1}})
+    ckpt.save(root, {"campaign": {"execs": 2}})
+    (tmp_path / ckpt.CHECKPOINT_FILE).write_text('{"epoch": 3, "ca')
+    chaos_mod.configure({"faults": [
+        {"point": "persist", "mode": "enospc", "hit": 1}]})
+    store = CorpusStore(root)
+    assert store.save_checkpoint({"campaign": {"execs": 3}}) is None
+    chaos_mod.configure(None)
+    doc = ckpt.load(root)               # .prev survived the failure
+    assert doc is not None and doc["campaign"]["execs"] == 1
+
+
+def test_checkpoint_components_carry_forward_per_key(tmp_path):
+    """A transient get_state() failure on ONE component (its key
+    simply missing from the save) must not erase that component's
+    last good state from the epoch chain."""
+    store = CorpusStore(str(tmp_path))
+    store.save_checkpoint({"components": {"mutator": "X",
+                                          "instrumentation": "Y"}})
+    store.save_checkpoint({"campaign": {"a": 2},
+                           "components": {"instrumentation": "Z"}})
+    assert store.load_component_state("mutator") == "X"
+    assert store.load_component_state("instrumentation") == "Z"
+
+
+def test_offline_solver_cache_not_shadowed_by_checkpoint(tmp_path):
+    """An offline caller (kb-descend round, bench sweep) writing
+    solver.json after a loop campaign checkpointed must not have its
+    fresher verdicts shadowed by the epoch's stale solver section —
+    save_solver_cache writes through a new epoch too."""
+    store = CorpusStore(str(tmp_path))
+    store.save_checkpoint({"campaign": {"a": 1},
+                           "solver": {"0:1": {"status": "solved"}}})
+    store2 = CorpusStore(str(tmp_path))     # fresh-process stand-in
+    cache = store2.load_solver_cache()
+    cache["2:3"] = {"status": "unsat"}
+    store2.save_solver_cache(cache)
+    got = CorpusStore(str(tmp_path)).load_solver_cache()
+    assert got["2:3"]["status"] == "unsat"
+    assert got["0:1"]["status"] == "solved"
+    # the campaign section survived the solver write-through
+    assert CorpusStore(str(tmp_path)).load_state() == {"a": 1}
+
+
+def test_chaos_configure_from_env(monkeypatch):
+    """kbz-worker picks its fault spec up from KBZ_CHAOS (the
+    manager_rpc seam in worker._request fires nothing otherwise)."""
+    monkeypatch.setenv(
+        "KBZ_CHAOS",
+        '{"faults": [{"point": "manager_rpc", "mode": "timeout"}]}')
+    eng = chaos_mod.configure_from_env()
+    assert eng is not None and eng.faults[0].point == "manager_rpc"
+    monkeypatch.delenv("KBZ_CHAOS")
+    assert chaos_mod.configure_from_env() is None
+
+
+def test_store_loaders_prefer_checkpoint_then_legacy(tmp_path):
+    store = CorpusStore(str(tmp_path))
+    # legacy-only layout reads fine (pre-checkpoint campaign)
+    store.save_state({"version": 1, "legacy": True})
+    store.save_solver_cache({"0:1": {"status": "unsat"}})
+    store.save_component_state("mutator", "legacy-state")
+    assert store.load_state()["legacy"] is True
+    assert store.load_solver_cache()["0:1"]["status"] == "unsat"
+    assert store.load_component_state("mutator") == "legacy-state"
+    # a checkpoint takes over as the source of truth
+    store.save_checkpoint({
+        "campaign": {"version": 1, "legacy": False},
+        "solver": {"0:1": {"status": "solved"}},
+        "components": {"mutator": "ck-state"}})
+    assert store.load_state()["legacy"] is False
+    assert store.load_solver_cache()["0:1"]["status"] == "solved"
+    assert store.load_component_state("mutator") == "ck-state"
+    # checkpoint artifacts never masquerade as corpus entries
+    assert store.load() == [] and len(store) == 0
+
+
+def test_event_seq_heals_from_checkpoint_after_log_loss(tmp_path):
+    """Rotation + kill + total log loss: the checkpointed high-water
+    floors the resumed stream — seq never regresses for cursors."""
+    from killerbeez_tpu.telemetry.events import EventLog
+    log = EventLog(str(tmp_path), max_bytes=400)
+    for i in range(40):
+        log.emit("new_path", md5=f"x{i}")
+    assert log.rotations > 0            # really rotated
+    high = log.next_seq
+    log.close()
+    store = CorpusStore(str(tmp_path / "corpus"))
+    store.save_checkpoint({"event_seq": high})
+    # the kill also eats BOTH log generations
+    os.unlink(tmp_path / "events.jsonl")
+    os.unlink(tmp_path / "events.jsonl.1")
+    fresh = EventLog(str(tmp_path))     # tail scan finds nothing
+    assert fresh.next_seq == 0
+    fresh.ensure_seq_at_least(
+        int(store.load_checkpoint()["event_seq"]))
+    rec = fresh.emit("flush")
+    assert rec["seq"] == high           # monotone across the loss
+
+
+# ---------------------------------------------------------------------------
+# dispatch watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_fires_within_2x_deadline():
+    stalls = []
+    fired = []
+    wd = DispatchWatchdog(multiplier=1.0, min_deadline=0.4,
+                          max_deadline=0.4,
+                          action=lambda: fired.append(
+                              time.monotonic()))
+    wd.dump_fn = lambda *a: stalls.append(a)
+    t0 = time.monotonic()
+    with wd.guard("host_transfer"):
+        # a synthetically-hung wait: sleep well past the deadline;
+        # the monitor thread must fire while we are "stuck"
+        while not fired and time.monotonic() - t0 < 3.0:
+            time.sleep(0.02)
+    assert fired, "watchdog never fired on a hung wait"
+    waited = fired[0] - t0
+    assert waited <= 2 * 0.4 + 0.1      # within 2x the armed deadline
+    assert stalls and stalls[0][0] == "host_transfer"
+    wd.stop()
+
+
+def test_watchdog_no_false_positive_when_disarmed():
+    fired = []
+    wd = DispatchWatchdog(min_deadline=0.2, max_deadline=0.2,
+                          action=lambda: fired.append(1))
+    for _ in range(5):
+        with wd.guard("dispatch"):
+            pass                        # fast waits disarm in time
+    time.sleep(0.6)                     # idle time is NOT guarded
+    assert not fired
+    wd.stop()
+
+
+def test_watchdog_deadline_scales_from_registry_ema():
+    from killerbeez_tpu.telemetry import EmaRate, MetricsRegistry
+    reg = MetricsRegistry()
+    r = EmaRate()
+    r._rate, r._weight = 1000.0, 1.0    # 1000 execs/s, fully warm
+    reg.rates["execs"] = r
+    wd = DispatchWatchdog(registry=reg, multiplier=10.0,
+                          min_deadline=1.0, max_deadline=120.0)
+    wd.note_batch(512)
+    assert wd.ema_batch_seconds() == pytest.approx(0.512)
+    assert wd.deadline() == pytest.approx(5.12)
+    # clamped to the ceiling when the EMA says "very slow"
+    r._rate = 1.0
+    assert wd.deadline() == 120.0
+    # cold start (no estimate at all) grants the ceiling: the first
+    # dispatch includes XLA compilation and must not false-positive
+    wd2 = DispatchWatchdog(min_deadline=1.0, max_deadline=60.0)
+    assert wd2.deadline() == 60.0
+
+
+# ---------------------------------------------------------------------------
+# sync backoff (manager partitions)
+# ---------------------------------------------------------------------------
+
+class _SyncFuzzerStub:
+    """The minimal surface _sync_round touches."""
+
+    def __init__(self):
+        from killerbeez_tpu.corpus.schedule import make_scheduler
+        from killerbeez_tpu.telemetry import Telemetry
+        self.telemetry = Telemetry(None)
+        self.scheduler = make_scheduler("rr")
+        self.store = None
+        self.feedback = 0
+        self._seen = {"new_paths": set()}
+
+
+def test_sync_partition_backoff_decorrelated_and_findings_survive(
+        monkeypatch):
+    import random
+    import urllib.error
+    from killerbeez_tpu.corpus.store import CorpusEntry
+    from killerbeez_tpu.corpus.sync import CorpusSync
+    fz = _SyncFuzzerStub()
+    sync = CorpusSync("http://127.0.0.1:1", "c", worker="w",
+                      interval_s=1.0, rng=random.Random(0))
+    entry = CorpusEntry(b"finding")
+    sync.note_entry(entry)
+
+    def down(*a, **k):
+        raise urllib.error.URLError("partitioned")
+    monkeypatch.setattr(sync, "_request", down)
+    reg = fz.telemetry.registry
+    backoffs = []
+    for i in range(4):
+        assert sync.maybe_sync(fz, force=True)
+        assert sync.consecutive_failures == i + 1
+        assert reg.gauges["sync_consecutive_failures"] == i + 1
+        backoffs.append(sync._backoff)
+        # decorrelated jitter: at least the interval, capped
+        assert sync.interval_s <= sync._backoff <= sync.backoff_cap
+    assert len(set(backoffs)) > 1       # jittered, not lockstep
+    # interval gate widens by the backoff (no immediate lockstep
+    # retry against a just-recovered manager)
+    sync._last_sync = time.time()
+    assert not sync.maybe_sync(fz)
+    # the admitted finding was REQUEUED, not lost: when the manager
+    # returns, it is pushed
+    sent = []
+
+    def up(payload=None, method="POST", query=""):
+        if method == "GET":
+            return {"entries": [], "latest": 0}
+        sent.append(payload["md5"])
+        return {"new": True}
+    monkeypatch.setattr(sync, "_request", up)
+    assert sync.maybe_sync(fz, force=True)
+    assert sync.consecutive_failures == 0 and sync._backoff == 0.0
+    assert reg.gauges["sync_consecutive_failures"] == 0
+    assert entry.md5 in sent            # no finding lost
+
+
+def test_sync_chaos_manager_faults(monkeypatch):
+    """The chaos ``manager_rpc`` seam: an injected 500 drops the
+    entry from sync (HTTP-rejected, never retried), an injected
+    partition requeues it."""
+    from killerbeez_tpu.corpus.store import CorpusEntry
+    from killerbeez_tpu.corpus.sync import CorpusSync
+    fz = _SyncFuzzerStub()
+    sync = CorpusSync("http://127.0.0.1:1", "c", worker="w",
+                      interval_s=0.0)
+    chaos_mod.configure({"faults": [
+        {"point": "manager_rpc", "mode": "timeout", "hit": 1},
+        {"point": "manager_rpc", "mode": "http500", "hit": 2}]})
+    e1 = CorpusEntry(b"one")
+    sync.note_entry(e1)
+    assert sync.maybe_sync(fz, force=True)
+    assert sync.consecutive_failures == 1       # partitioned round
+    assert sync._pending and sync._pending[0].md5 == e1.md5  # requeued
+    # next round: the 500 — manager saw it and refused; dropped
+    assert sync.maybe_sync(fz, force=True)
+    assert e1.cov_hash in sync._pushed
+    assert not sync._pending
+
+
+# ---------------------------------------------------------------------------
+# CLI-level chaos (subprocess: SIGKILL faults must not kill pytest)
+# ---------------------------------------------------------------------------
+
+SEED = b"\x00" * 8
+
+
+def _cli_args(out, extra=()):
+    return ["file", "jit_harness", "havoc",
+            "-i", '{"target": "cgc_like", "novelty": "throughput"}',
+            "-m", '{"seed": 11}', "-fb", "0",
+            "-sf", "seed.bin", "-o", out, "-b", "256", "-n", "1024",
+            "--corpus-dir", os.path.join(out, "corpus"), *extra]
+
+
+def _run_cli(tmp_path, args, timeout=180):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO_ROOT +
+                os.pathsep + env.get("PYTHONPATH", "")})
+    (tmp_path / "seed.bin").write_bytes(SEED)
+    return subprocess.run(
+        [sys.executable, "-m", "killerbeez_tpu.fuzzer", *args],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def _findings(root):
+    out = {}
+    for kind in ("crashes", "hangs", "new_paths"):
+        d = os.path.join(root, kind)
+        out[kind] = sorted(
+            n for n in (os.listdir(d) if os.path.isdir(d) else [])
+            if len(n) == 32)
+    return out
+
+
+def _store_md5s(root):
+    d = os.path.join(root, "corpus")
+    return sorted(n for n in os.listdir(d) if len(n) == 32)
+
+
+def _event_seqs(root):
+    seqs = []
+    for p in (os.path.join(root, "events.jsonl.1"),
+              os.path.join(root, "events.jsonl")):
+        if os.path.exists(p):
+            for line in open(p):
+                if line.strip():
+                    seqs.append(json.loads(line)["seq"])
+    return seqs
+
+
+@pytest.fixture(scope="module")
+def control_run(tmp_path_factory):
+    """The fault-free control campaign every chaos run must converge
+    to (same argv, same seed: the candidate stream is deterministic
+    with -fb 0)."""
+    tmp = tmp_path_factory.mktemp("control")
+    r = _run_cli(tmp, _cli_args("ctl"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    f = _findings(str(tmp / "ctl"))
+    assert any(f.values()), "control run found nothing to compare"
+    return {"findings": f, "store": _store_md5s(str(tmp / "ctl"))}
+
+
+@pytest.mark.parametrize("kill_hit", [
+    2,
+    pytest.param(5, marks=pytest.mark.slow),
+    pytest.param(6, marks=pytest.mark.slow),
+])
+def test_sigkill_at_persistence_point_resume_invariants(
+        tmp_path, control_run, kill_hit):
+    """SIGKILL at randomized persistence points: after resume, the
+    campaign ends with the control run's EXACT findings + corpus
+    sets, no duplicate arms, and a monotone event seq.  This argv
+    produces exactly 6 persist writes (2 admissions x entry+sidecar
+    + interval and final checkpoint epochs): hit 2 lands between the
+    finding write and the store write-through, 6 on the run-end
+    checkpoint itself."""
+    spec = json.dumps({"faults": [
+        {"point": "persist", "mode": "kill", "hit": kill_hit}]})
+    r = _run_cli(tmp_path, _cli_args("out", ["--chaos", spec]))
+    assert r.returncode == -signal.SIGKILL
+    r = _run_cli(tmp_path, _cli_args("out", ["--resume"]))
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = str(tmp_path / "out")
+    # no finding lost after admission; no duplicates minted
+    assert _findings(out) == control_run["findings"]
+    assert _store_md5s(out) == control_run["store"]
+    entries = CorpusStore(os.path.join(out, "corpus")).load()
+    md5s = [e.md5 for e in entries]
+    assert len(md5s) == len(set(md5s))  # no duplicate corpus arms
+    seqs = _event_seqs(out)
+    assert seqs and all(b > a for a, b in zip(seqs, seqs[1:]))
+
+
+def test_device_loss_classified_exit_87_and_checkpointed(tmp_path):
+    spec = json.dumps({"faults": [
+        {"point": "device_dispatch", "mode": "raise", "hit": 2}]})
+    r = _run_cli(tmp_path, _cli_args("out", ["--chaos", spec]))
+    assert r.returncode == DEVICE_LOST_EXIT_CODE
+    assert "device lost" in r.stderr.lower()
+    out = tmp_path / "out"
+    # run()'s finally checkpointed before the classified exit
+    assert (out / "corpus" / "checkpoint.json").exists()
+    evs = [json.loads(l) for l in open(out / "events.jsonl")
+           if l.strip()]
+    assert any(e["type"] == "device_lost" for e in evs)
+
+
+def test_enospc_everywhere_degrades_but_campaign_completes(tmp_path):
+    """Disk full on EVERY persistence write: the campaign must still
+    run to completion (warnings, not raises)."""
+    spec = json.dumps({"faults": [
+        {"point": "persist", "mode": "enospc", "every": 1}]})
+    r = _run_cli(tmp_path, _cli_args("out", ["--chaos", spec]))
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_watchdog_kills_hung_dispatch_within_2x_deadline(tmp_path):
+    """Acceptance: a synthetically-hung device wait dies by watchdog
+    (exit 86) within 2x the armed deadline, leaving the stall event
+    and the in-flight dump."""
+    spec = json.dumps({"faults": [
+        {"point": "device_wait", "mode": "hang", "hit": 2,
+         "seconds": 60}]})
+    r = _run_cli(tmp_path, _cli_args("out", [
+        "--chaos", spec, "--watchdog", "4",
+        "--watchdog-min", "1", "--watchdog-max", "15"]))
+    assert r.returncode == WATCHDOG_EXIT_CODE, r.stderr[-2000:]
+    out = tmp_path / "out"
+    evs = [json.loads(l) for l in open(out / "events.jsonl")
+           if l.strip()]
+    stalls = [e for e in evs if e["type"] == "watchdog_stall"]
+    assert stalls
+    s = stalls[0]
+    assert s["waited_s"] <= 2 * s["deadline_s"]
+    dump = json.loads((out / "watchdog_dump.json").read_text())
+    assert dump["stage"] == s["stage"]
+    assert isinstance(dump["pending"], list)
+
+
+def test_events_rotation_plus_solver_kill_resume(tmp_path):
+    """Satellite: rotation mid-campaign + a kill + resume, with the
+    crack stage's verdicts riding the unified checkpoint — seq stays
+    monotone across BOTH generations and the resumed cracker starts
+    warm from the checkpoint's solver section."""
+    # -b 64: the plateau window is (plateau + PIPELINE_DEPTH) x b, so
+    # a small batch lets the crack fire inside -n; the 2KB event cap
+    # rotates mid-campaign (~30 events between finds, scheduler
+    # picks, plateau/crack records and flushes)
+    args = ["file", "jit_harness", "havoc",
+            "-i", '{"target": "test", "novelty": "throughput"}',
+            "-m", '{"seed": 11}', "-sf", "seed.bin",
+            "-o", "out", "-b", "64", "-n", "8192",
+            "--corpus-dir", os.path.join("out", "corpus"),
+            "--crack", "2", "--events-max-mb", "0.002"]
+    spec = json.dumps({"faults": [
+        {"point": "event_append", "mode": "kill", "hit": 25}]})
+    r = _run_cli(tmp_path, args + ["--chaos", spec])
+    assert r.returncode == -signal.SIGKILL
+    r = _run_cli(tmp_path, args + ["--resume"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = str(tmp_path / "out")
+    assert os.path.exists(os.path.join(out, "events.jsonl.1"))
+    seqs = _event_seqs(out)
+    assert seqs and all(b > a for a, b in zip(seqs, seqs[1:]))
+    ck = CorpusStore(os.path.join(out, "corpus")).load_checkpoint()
+    assert any(v.get("status") == "solved"
+               for v in ck["solver"].values())
+    assert ck["event_seq"] <= max(seqs) + 1
+    # a fresh cracker over the store starts warm (no re-solving)
+    from killerbeez_tpu.fuzzer.crack import BranchCracker
+    from killerbeez_tpu.models.targets import get_target
+    prog = get_target("test")
+    c2 = BranchCracker(prog,
+                       store=CorpusStore(os.path.join(out, "corpus")))
+    assert c2.cache == ck["solver"]
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+def _stub_child(tmp_path, rcs):
+    """A child command that exits with rcs[launch#] and records its
+    argv — a fuzzer stand-in for supervisor-policy tests."""
+    script = tmp_path / "stub.py"
+    script.write_text(
+        "import json, os, sys\n"
+        "d = os.path.dirname(os.path.abspath(__file__))\n"
+        "p = os.path.join(d, 'launches.json')\n"
+        "hist = json.load(open(p)) if os.path.exists(p) else []\n"
+        "hist.append(sys.argv[1:])\n"
+        "json.dump(hist, open(p, 'w'))\n"
+        f"rcs = {rcs!r}\n"
+        "sys.exit(rcs[min(len(hist) - 1, len(rcs) - 1)])\n")
+    return [sys.executable, str(script)]
+
+
+def _launches(tmp_path):
+    return json.load(open(tmp_path / "launches.json"))
+
+
+def test_supervisor_restarts_into_resume(tmp_path):
+    sup = Supervisor(["-o", str(tmp_path / "out")],
+                     child_cmd=_stub_child(tmp_path, [1, 0]),
+                     backoff_base=0.01, backoff_cap=0.05)
+    assert sup.run() == 0
+    launches = _launches(tmp_path)
+    assert len(launches) == 2 and sup.restarts == 1
+    assert "--resume" not in launches[0]
+    assert "--resume" in launches[1]            # restart resumes
+    assert "--corpus-dir" in launches[0]        # injected: something
+    #                                             to resume FROM
+    recs = [json.loads(l) for l in
+            open(tmp_path / "out" / "supervisor.jsonl")]
+    classes = [r.get("class") for r in recs if r["event"] == "exit"]
+    assert classes == [CRASH, CLEAN]
+
+
+def test_supervisor_respects_restart_budget(tmp_path):
+    sup = Supervisor(["-o", str(tmp_path / "out")],
+                     child_cmd=_stub_child(tmp_path, [1]),
+                     max_restarts=2, backoff_base=0.01,
+                     backoff_cap=0.02)
+    assert sup.run() == 1
+    assert len(_launches(tmp_path)) == 3        # initial + 2 restarts
+
+
+def test_supervisor_backoff_capped_exponential_with_jitter():
+    import random
+    sup = Supervisor(["-o", "x"], backoff_base=1.0, backoff_cap=8.0,
+                     rng=random.Random(0))
+    delays = []
+    for streak in (1, 2, 3, 4, 5, 6):
+        sup.streak = streak
+        delays.append(sup.backoff_seconds())
+    # jittered around base*2^(n-1), never beyond 1.5x the cap
+    assert delays[0] <= 1.5
+    assert max(delays) <= 8.0 * 1.5
+    assert len(set(delays)) > 1                 # not constant
+
+
+def test_supervisor_device_loss_probe_and_mesh_degrade(tmp_path):
+    sup = Supervisor(["-o", str(tmp_path / "out"), "--mesh", "4,2"],
+                     child_cmd=_stub_child(tmp_path, [87, 0]),
+                     probe_cmd="echo 4", backoff_base=0.01,
+                     backoff_cap=0.02)
+    assert sup.run() == 0
+    launches = _launches(tmp_path)
+    i = launches[1].index("--mesh")
+    assert launches[1][i + 1] == "2,2"          # dp=4 -> dp=2
+    recs = [json.loads(l) for l in
+            open(tmp_path / "out" / "supervisor.jsonl")]
+    assert any(r["event"] == "degrade" and r["mesh_to"] == "2,2"
+               for r in recs)
+
+
+def test_supervisor_native_fallback_when_no_device_returns(tmp_path):
+    fallback = f"stdin return_code havoc -o {tmp_path / 'out'}"
+    sup = Supervisor(["-o", str(tmp_path / "out")],
+                     child_cmd=_stub_child(tmp_path, [87, 0]),
+                     probe_cmd="echo 0", probe_attempts=2,
+                     fallback=fallback, backoff_base=0.01,
+                     backoff_cap=0.02,
+                     sleep_fn=lambda s: None)
+    assert sup.run() == 0
+    launches = _launches(tmp_path)
+    assert launches[1][:3] == ["stdin", "return_code", "havoc"]
+    assert "--resume" in launches[1]
+
+
+def test_supervisor_gives_up_without_fallback(tmp_path):
+    sup = Supervisor(["-o", str(tmp_path / "out")],
+                     child_cmd=_stub_child(tmp_path, [87]),
+                     probe_cmd="echo 0", probe_attempts=2,
+                     backoff_base=0.01, backoff_cap=0.02,
+                     sleep_fn=lambda s: None)
+    assert sup.run() == 87
+    recs = [json.loads(l) for l in
+            open(tmp_path / "out" / "supervisor.jsonl")]
+    assert any(r["event"] == "giveup" for r in recs)
+
+
+def test_supervise_cli_requires_fuzzer_argv(capsys):
+    from killerbeez_tpu.resilience.supervisor import main
+    assert main(["--max-restarts", "1", "--"]) == 2
+
+
+def test_supervised_campaign_survives_device_loss_and_sigkill(
+        tmp_path, control_run):
+    """THE acceptance e2e: a supervised CLI campaign eats an injected
+    device loss AND a SIGKILL at a persistence point, restarts into
+    --resume each time, and ends with the fault-free control run's
+    exact admitted-findings set and a monotone event seq."""
+    spec = json.dumps({"seed": 3, "faults": [
+        {"point": "device_dispatch", "mode": "raise", "hit": 3},
+        {"point": "persist", "mode": "kill", "hit": 6}]})
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO_ROOT +
+                os.pathsep + env.get("PYTHONPATH", "")})
+    (tmp_path / "seed.bin").write_bytes(SEED)
+    r = subprocess.run(
+        [sys.executable, "-m", "killerbeez_tpu.resilience.supervisor",
+         "--backoff-base", "0.05", "--backoff-cap", "0.2",
+         "--probe-cmd", "echo 8", "--chaos", spec,
+         "--chaos-launches", "2", "--", *_cli_args("out")],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=420)
+    assert r.returncode == 0, r.stderr[-3000:]
+    out = str(tmp_path / "out")
+    assert _findings(out) == control_run["findings"]
+    assert _store_md5s(out) == control_run["store"]
+    seqs = _event_seqs(out)
+    assert seqs and all(b > a for a, b in zip(seqs, seqs[1:]))
+    recs = [json.loads(l)
+            for l in open(os.path.join(out, "supervisor.jsonl"))]
+    classes = [r.get("class") for r in recs if r["event"] == "exit"]
+    # both injected fault families actually fired and were classified
+    assert DEVICE_LOST in classes and CRASH in classes
+    assert classes[-1] == CLEAN
+    assert any(r["event"] == "device_probe" for r in recs)

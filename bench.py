@@ -238,14 +238,16 @@ def bench_device_fused(target, batch, steps, seed):
 
 def bench_cli_product(target, batch, steps, seed, telemetry=None,
                       out_name="cli_product", engine="pallas_fused",
-                      trace=0):
+                      trace=0, feedback=-1):
     """Config 4d: the PRODUCT path — the ordinary Fuzzer loop (what
     `python -m killerbeez_tpu.fuzzer file jit_harness havoc` runs)
     with engine=pallas_fused, measured post-warmup.  The flagship
     bench number must be reproducible here or it's a bench artifact
     (round-2 verdict item 1).  ``telemetry`` passes through to the
     Fuzzer (None = default sink on, False = --no-stats); ``trace``
-    turns the flight-recorder span ring on (--trace)."""
+    turns the flight-recorder span ring on (--trace); ``feedback``
+    passes through to the Fuzzer (-1 = auto, 0 = off — the
+    --generations A/B pins 0 so both lanes mutate the same seed)."""
     import shutil
     import json as _json
     from killerbeez_tpu.drivers.factory import driver_factory
@@ -264,7 +266,7 @@ def bench_cli_product(target, batch, steps, seed, telemetry=None,
     out = os.path.join(REPO, "bench_out", out_name)
     shutil.rmtree(out, ignore_errors=True)
     fz = Fuzzer(drv, output_dir=out, batch_size=batch,
-                telemetry=telemetry, trace=trace)
+                telemetry=telemetry, trace=trace, feedback=feedback)
     # warmup must cover BOTH compiled paths (per-batch step + K-step
     # superbatch) AND end on a K boundary: a misaligned batch counter
     # would route the first timed batches through the per-batch path
@@ -586,6 +588,157 @@ def bench_descend(targets=None, batch=256, budget_execs=65536,
     return 0 if (ok or not gate) else 1
 
 
+BENCH_R05_GATE = 1807549.5   # BENCH_r05 headline: execs/s/chip,
+#                              fused-pallas superbatch on tlvstack_vm
+
+
+def bench_generations(target="tlvstack_vm", batch=65536, steps=32,
+                      gs=(4, 16, 64), engine="pallas_fused",
+                      gate=False):
+    """--generations A/B lane: the host-driven superbatch CLI loop vs
+    the device-resident generation loop (ops/generations.py) at
+    G in ``gs``, same target/batch/engine/exec budget.
+
+    Emits one JSON row per config plus a summary row, and writes a
+    BENCH_r06-style artifact to bench_out/BENCH_generations.json.
+    ``gate=True`` exits nonzero unless (a) the best device-resident
+    config beats the host-driven baseline measured in the same
+    session, and (b) on TPU hardware, it strictly exceeds BENCH_r05's
+    1 807 549 execs/s/chip absolute number (the ISSUE 9 acceptance
+    bar; skipped with a named reason on CPU, where the absolute
+    number is unreachable by construction and the relative A/B is
+    the honest signal).
+
+    BOTH lanes run with corpus feedback pinned OFF: that makes the
+    candidate streams bit-identical (the --generations determinism
+    contract), so the A/B measures exactly what the mode claims —
+    eliminating the per-batch host round-trip — and nothing else.
+    With feedback on the comparison confounds loop overhead with a
+    *seed-depth* difference: the device ring mutates a novelty-
+    admitted (deeper) seed almost every generation while the host
+    bandit rotates lazily, and batch wall time follows the deepest
+    lane (the engines early-exit when every lane halts), so execs/s
+    shifts for reasons that are corpus policy, not dispatch cost
+    (docs/GENERATIONS.md)."""
+    import shutil
+    import json as _json
+    import jax
+    from killerbeez_tpu.drivers.factory import driver_factory
+    from killerbeez_tpu.fuzzer.loop import Fuzzer
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    from killerbeez_tpu.models import targets_cgc
+    from killerbeez_tpu.mutators.factory import mutator_factory
+
+    seed = targets_cgc.tlvstack_vm_seed() if target == "tlvstack_vm" \
+        else targets_cgc.imgparse_vm_seed()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    rows = []
+
+    v_host, st, fz = bench_cli_product(target, batch, steps, seed,
+                                       out_name="gen_base",
+                                       engine=engine, feedback=0)
+    rows.append(emit(
+        "gen-host",
+        f"host-driven superbatch baseline ({target}, -b {batch}, "
+        f"{steps} steps, {engine}, feedback off)", v_host,
+        new_paths=st.new_paths, stage_split=stage_split_row(fz)))
+
+    def run_gen(g):
+        instr = instrumentation_factory(
+            "jit_harness", _json.dumps({
+                "target": target, "engine": engine,
+                "novelty": "throughput"}))
+        mut = mutator_factory("havoc", '{"seed": 3}', seed)
+        drv = driver_factory("file", None, instr, mut)
+        out = os.path.join(REPO, "bench_out", f"gen_{g}")
+        shutil.rmtree(out, ignore_errors=True)
+        fz = Fuzzer(drv, output_dir=out, batch_size=batch,
+                    generations=g, feedback=0)
+        # warmup covers compile + the steady dispatch shape; the
+        # timed window then runs >= 2 full-G dispatches
+        fz.run(2 * g * batch)
+        done = fz.stats.iterations
+        steps_eff = max(steps, 2 * g)
+        t0 = time.time()
+        fz.run(done + batch * steps_eff)
+        dt = time.time() - t0
+        return (fz.stats.iterations - done) / dt, fz
+
+    best = (0.0, 0)
+    for g in gs:
+        v, fz = run_gen(g)
+        reg = fz.telemetry.registry
+        rows.append(emit(
+            f"gen-G{g}",
+            f"device-resident generations G={g} ({target}, "
+            f"-b {batch}, {engine}, feedback off)", v,
+            speedup_vs_host=round(v / v_host, 3) if v_host else None,
+            new_paths=fz.stats.new_paths,
+            ring_filled=int(reg.gauges.get("gen_ring_filled", 0)),
+            findings_ring_drops=int(reg.counters.get(
+                "findings_ring_drops", 0)),
+            stage_split=stage_split_row(fz)))
+        if v > best[0]:
+            best = (v, g)
+
+    rel_ok = best[0] > v_host
+    retry = None
+    if gate and not rel_ok and not on_tpu:
+        # a short wall-clock A/B on a shared CI runner can invert on
+        # noisy-neighbor contention alone: re-measure BOTH lanes once
+        # and gate on the fresh pair.  A genuine regression fails
+        # both rounds; the retry is recorded in the artifact, never
+        # silent.
+        print("generations gate: relative A/B failed — re-measuring "
+              "both lanes once (shared-runner noise guard)",
+              file=sys.stderr)
+        v_host2, _, _ = bench_cli_product(
+            target, batch, steps, seed, out_name="gen_base_retry",
+            engine=engine, feedback=0)
+        v2, _ = run_gen(best[1])
+        retry = {"host": round(v_host2, 1), "gen": round(v2, 1),
+                 "speedup_vs_host": round(v2 / v_host2, 3)
+                 if v_host2 else None}
+        rel_ok = v2 > v_host2
+    abs_ok = best[0] > BENCH_R05_GATE if on_tpu else None
+    summary = {
+        "metric": f"execs/sec/chip on {target} (device-resident "
+                  f"generation loop, best G={best[1]}, {engine})",
+        "value": round(best[0], 1),
+        "unit": "execs/sec",
+        "host_baseline": round(v_host, 1),
+        "speedup_vs_host": round(best[0] / v_host, 3)
+        if v_host else None,
+        "gate_relative_ok": rel_ok,
+        "gate_absolute": BENCH_R05_GATE,
+        "gate_absolute_ok": abs_ok if on_tpu else
+        "skipped: CPU backend (absolute bar is a TPU number; "
+        "relative A/B gates here)",
+    }
+    if retry is not None:
+        summary["retry"] = retry
+    print(json.dumps(summary), flush=True)
+    os.makedirs(os.path.join(REPO, "bench_out"), exist_ok=True)
+    with open(os.path.join(REPO, "bench_out",
+                           "BENCH_generations.json"), "w") as f:
+        json.dump({"rows": rows, "parsed": summary}, f, indent=1)
+    if gate:
+        if not rel_ok:
+            print(f"FAIL: best device-resident config "
+                  f"({best[0]:.0f} execs/s, G={best[1]}) did not "
+                  f"beat the host-driven baseline ({v_host:.0f})",
+                  file=sys.stderr)
+            return 1
+        if on_tpu and not abs_ok:
+            print(f"FAIL: device-resident loop {best[0]:.0f} "
+                  f"execs/s/chip <= BENCH_r05 gate "
+                  f"{BENCH_R05_GATE:.0f}", file=sys.stderr)
+            return 1
+    return 0
+
+
 def bench_multichip_smoke():
     """Config 5: sharded step on a virtual 8-device CPU mesh, run in a
     subprocess (the driver env exposes one real chip; see
@@ -771,6 +924,33 @@ def main():
                              budget_execs=budget,
                              descend_budget=dbudget, gate=gate)
 
+    if "--generations" in sys.argv[1:]:
+        # device-resident generation-loop A/B mode:
+        #   python bench.py --generations [-b BATCH] [-s STEPS]
+        #       [-g 4,16,64] [engine] [--gate]
+        rest = [a for a in sys.argv[1:] if a != "--generations"]
+        gate = "--gate" in rest
+        if gate:
+            rest.remove("--gate")
+        batch, steps, gs, engine = 65536, 32, (4, 16, 64), None
+        j = 0
+        while j < len(rest):
+            if rest[j] == "-b":
+                batch = int(rest[j + 1]); j += 2
+            elif rest[j] == "-s":
+                steps = int(rest[j + 1]); j += 2
+            elif rest[j] == "-g":
+                gs = tuple(int(x) for x in rest[j + 1].split(","))
+                j += 2
+            else:
+                engine = rest[j]; j += 1
+        if engine is None:
+            import jax
+            engine = "pallas_fused" \
+                if jax.devices()[0].platform == "tpu" else "xla"
+        return bench_generations(batch=batch, steps=steps, gs=gs,
+                                 engine=engine, gate=gate)
+
     if "--trace-overhead" in sys.argv[1:]:
         # flight-recorder cost mode: optional trailing args override
         # batch/steps/engine (CPU verification uses small shapes);
@@ -841,6 +1021,15 @@ def main():
     except Exception as e:
         emit("4d", "product CLI loop unavailable", 0.0, ok=False,
              error=str(e)[:200])
+
+    try:
+        # device-resident generation loop at the flagship shape: one
+        # G=16 config in the default matrix (the full G sweep + gate
+        # runs via `python bench.py --generations --gate`)
+        bench_generations(batch=65536, steps=32, gs=(16,))
+    except Exception as e:
+        emit("4g", "device-resident generations unavailable", 0.0,
+             ok=False, error=str(e)[:200])
 
     try:
         bench_qemu_tier()

@@ -209,6 +209,37 @@ class Driver:
             self.last_input = None
         return packed, bufs, lens, compact
 
+    def supports_batch_generations(self) -> bool:
+        """True when test_batch_generations can run: a device-backed
+        instrumentation with the generation loop (jit_harness), a
+        fused-capable mutator with no focus mask installed, and a
+        single-chip batch quantum.  Re-checked per dispatch — the
+        same stand-down discipline the fused superbatch path uses."""
+        instr = self.instrumentation
+        supports = getattr(instr, "supports_generations", None)
+        return (self.supports_batch and instr.device_backed
+                and getattr(self, "batch_quantum", 1) == 1
+                and supports is not None and supports(self.mutator))
+
+    def test_batch_generations(self, n: int, g: int,
+                               pad_to: Optional[int] = None,
+                               reseed: bool = True):
+        """``g`` full fuzzing generations in one device dispatch
+        (mutate -> execute -> triage -> ring reseed all on device);
+        the host gets back only the bounded findings ring + admission
+        ledger (a lazy GenerationOutcome).  Generation j consumed
+        iterations ``it0 + j*n``; the mutator advances by g*n."""
+        its = self.mutator.peek_iterations(n)
+        with self._span("execute"):     # the whole loop is in-kernel
+            out = self.instrumentation.run_batch_generations(
+                self.mutator, its, g, pad_to=pad_to, reseed=reseed)
+        self.mutator.advance(g * n)
+        # the per-exec last-input contract doesn't apply: candidate
+        # tensors never leave the device in this mode
+        self._last_batch_tail = None
+        self.last_input = None
+        return out
+
     def cleanup(self) -> None:
         pass
 

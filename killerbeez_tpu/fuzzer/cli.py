@@ -189,6 +189,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stats-interval", type=float, default=5.0,
                    help="seconds between stats-file snapshots "
                         "(default 5)")
+    p.add_argument("-G", "--generations", type=int, nargs="?",
+                   const=16, default=0, metavar="G",
+                   help="device-resident generation loop (jit_harness "
+                        "+ a fused-capable mutator): the device runs "
+                        "up to G full mutate->execute->triage->reseed "
+                        "generations per host dispatch (default 16 "
+                        "when bare) against a device-resident virgin "
+                        "map and seed-slot ring; the host only drains "
+                        "the bounded findings ring + admission ledger."
+                        "  Auto-stands-down (warning) when --crack / "
+                        "focus masks / --mesh / a non-fused mutator "
+                        "is active; with -fb 0 the candidate stream "
+                        "is bit-identical to the host-driven loop "
+                        "(docs/GENERATIONS.md)")
     p.add_argument("-K", "--accumulate", type=int, default=0,
                    help="fused device path: accumulate K batches "
                         "per device dispatch so the host pulls one "
@@ -395,7 +409,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         trace=args.trace,
                         profile_device=args.profile_device,
                         events_max_mb=args.events_max_mb,
-                        watchdog=watchdog)
+                        watchdog=watchdog,
+                        generations=args.generations)
         if args.schedule == "rare-edge":
             _wire_rare_edge_signer(fuzzer, driver)
             _wire_static_prior(fuzzer, driver)

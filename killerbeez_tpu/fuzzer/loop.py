@@ -171,7 +171,8 @@ class Fuzzer:
                  trace=None,
                  profile_device: int = 0,
                  events_max_mb: float = 0.0,
-                 watchdog=None):
+                 watchdog=None,
+                 generations: int = 0):
         self.driver = driver
         self.output_dir = output_dir
         self.batch_size = int(batch_size)
@@ -265,6 +266,25 @@ class Fuzzer:
         #: live view of the pipeline's pending deque for the watchdog
         #: dump (set by _run_batched)
         self._pending = None
+        #: device-resident generation loop (--generations): the TPU
+        #: runs this many full mutate->execute->triage->reseed
+        #: generations per host dispatch and the host only drains the
+        #: findings ring + admission ledger (ops/generations.py);
+        #: <= 1 = host-driven loop.  Auto-stands-down (with a warning)
+        #: when the crack stage, focus masks, or a non-fused driver
+        #: is active — the same discipline as the superbatch path.
+        self.generations = int(generations)
+        self._gen_warned = False
+        #: whether the CURRENT generations run reseeds on device
+        #: (set per run): with reseeding off the device ledger is
+        #: empty, so the drain admits edge-novel ring lanes host-side
+        #: instead — the store write-through contract must hold in
+        #: both regimes
+        self._gen_reseed = True
+        #: host mirror of the device seed-slot ring (slot -> entry
+        #: md5): the admission-replay parent map, rebuilt dispatch by
+        #: dispatch from the device's ledger
+        self._ring_mirror: Dict[int, str] = {}
         # the arm whose candidates the batch being TRIAGED came from:
         # with a deep pipeline, triage lags generation, so finds must
         # credit the GENERATING arm (entry object, robust to corpus
@@ -533,9 +553,58 @@ class Fuzzer:
         except Exception as e:  # triage detail must never stop fuzzing
             WARNING_MSG("debug triage failed: %s", e)
 
+    _NO_CREDIT = object()   # credit sentinel: None credits the base seed
+
+    def _admit_arm(self, buf: bytes, digest: str, parent: str,
+                   credit=_NO_CREDIT) -> None:
+        """The ADMISSION stage of triage, split out so it is
+        device-ownable (ROADMAP item 1): mint a corpus arm for an
+        edge-novel finding — signer, store write-through, sync note,
+        scheduler admission + find credit.  Shared by host-side lane
+        triage and the --generations admission replay, which feeds
+        the device ring's decisions back through this same contract
+        so store/arms/events stay byte-identical in shape to the
+        host loop's.  Never mints a duplicate arm (resume replays and
+        ring replays re-present known digests)."""
+        reg = self.telemetry.registry
+        arm = Arm(buf, parent=parent, discovered=time.time())
+        if self._signer is not None:
+            try:
+                arm.sig = self._signer(buf)
+            except Exception as e:
+                WARNING_MSG("corpus signer failed: %s", e)
+        if self.store is not None and not os.path.exists(
+                self.store.entry_path(digest)):
+            arm.seq = self.store.next_seq()
+            with self.telemetry.timer("fs_write"):
+                self.store.put(arm.to_entry())
+        if self.sync is not None:
+            self.sync.note_entry(arm.to_entry())
+        if self.feedback and not any(
+                getattr(a, "md5", None) == digest
+                for a in self.scheduler.arms):
+            # admission evicts the oldest arm beyond the cap
+            # (rotation only — the store keeps it); the ENTRY-object
+            # credit pointers stay valid regardless
+            self.scheduler.admit(arm)
+            if credit is not self._NO_CREDIT:
+                # credit the arm whose candidates PRODUCED this find
+                # (None = the base seed; a capped-out arm's entry may
+                # already be off the list — the credit is then a
+                # harmless write to a dead object)
+                self.scheduler.credit_find(credit)
+            reg.gauge("corpus_arms", len(self.scheduler.arms))
+
     def _triage_lane(self, status: int, new_path: int, buf: bytes,
                      unique_crash: bool = False,
-                     unique_hang: bool = False) -> None:
+                     unique_hang: bool = False,
+                     admit: bool = True) -> None:
+        """VERDICT + RECORD stages of one lane's triage (counters,
+        finding files, events, dedup), then — unless ``admit`` is
+        False — the admission stage for edge-novel lanes.  The
+        generations drain passes ``admit=False``: the DEVICE already
+        made the admission decisions, and _drain_generations replays
+        its ledger through _admit_arm instead."""
         s = self.stats
         if status == FUZZ_CRASH:
             s.crashes += 1
@@ -596,35 +665,13 @@ class Fuzzer:
                         self.store.entry_path(digest))
                     and not any(getattr(a, "md5", None) == digest
                                 for a in self.scheduler.arms))
-            if (recorded or heal) and new_path == 2 and \
+            if (recorded or heal) and new_path == 2 and admit and \
                     (self.feedback or self.store is not None):
-                arm = Arm(buf,
-                          parent=getattr(self._credit_arm, "md5",
-                                         None) or "base",
-                          discovered=time.time())
-                if self._signer is not None:
-                    try:
-                        arm.sig = self._signer(buf)
-                    except Exception as e:
-                        WARNING_MSG("corpus signer failed: %s", e)
-                if self.store is not None:
-                    arm.seq = self.store.next_seq()
-                    with self.telemetry.timer("fs_write"):
-                        self.store.put(arm.to_entry())
-                if self.sync is not None:
-                    self.sync.note_entry(arm.to_entry())
-                if self.feedback:
-                    # admission evicts the oldest arm beyond the cap
-                    # (rotation only — the store keeps it); the
-                    # ENTRY-object credit pointers (_active_entry,
-                    # per-batch _credit_arm) stay valid regardless
-                    self.scheduler.admit(arm)
-                    # credit the arm whose candidates PRODUCED this
-                    # find (set per triaged batch; a capped-out arm's
-                    # entry may already be off the list — the credit
-                    # is then a harmless write to a dead object)
-                    self.scheduler.credit_find(self._credit_arm)
-                    reg.gauge("corpus_arms", len(self.scheduler.arms))
+                self._admit_arm(
+                    buf, digest,
+                    parent=getattr(self._credit_arm, "md5",
+                                   None) or "base",
+                    credit=self._credit_arm)
 
     # -- loops ----------------------------------------------------------
 
@@ -638,7 +685,10 @@ class Fuzzer:
         self.telemetry.registry.run_started()
         try:
             if self.driver.supports_batch:
-                self._run_batched(n_iterations)
+                if self.generations > 1:
+                    self._run_generations(n_iterations)
+                else:
+                    self._run_batched(n_iterations)
             else:
                 self._run_single(n_iterations)
         finally:
@@ -1205,6 +1255,202 @@ class Fuzzer:
             # interrupt (Ctrl-C on an infinite run) or a raise
             while pending:
                 self._triage_batch(*pending.popleft())
+
+    # -- device-resident generations (--generations) --------------------
+
+    def _drain_generations(self, out, room, done_through, _packed,
+                           _arm, lane) -> None:
+        """Drain one G-generation dispatch: materialize the bounded
+        findings ring + admission ledger (the ONLY device->host
+        transfer in this mode), replay each interesting lane through
+        the verdict/record triage stages, and replay the device's
+        ring-admission decisions through the admission stage — in
+        (generation, lane) order, exactly the order host-driven
+        triage would have seen them.  Ring overflow is counted
+        (``findings_ring_drops``) and warned, never silent.
+
+        With reseeding OFF the device made no admission decisions
+        (the ledger is empty), so edge-novel ring lanes admit through
+        the normal host path instead — otherwise a ``-fb 0`` campaign
+        with a corpus store would silently skip the write-through the
+        host-driven loop performs."""
+        from ..instrumentation.base import unpack_verdicts
+        tr = self.telemetry.trace
+        if tr is not None and lane is not None:
+            tr.lane = lane
+            tr.async_end("in_flight", lane)
+        timer = self.telemetry.timer
+        if self.watchdog is not None:
+            # the guarded wait below is on THIS dispatch: arm with
+            # its own generation count, not the newest dispatch's —
+            # a shrunken tail dispatch queued behind a full-G one
+            # must not clamp the full-G drain to a 1-batch deadline
+            self.watchdog.note_dispatch_scale(max(int(out.g), 1))
+        with self._wd_guard("host_transfer"), timer("host_transfer"):
+            # chaos seam INSIDE the guard: a "hang" here is what a
+            # wedged device looks like from the host
+            chaos_point("device_wait")
+            h = out.materialize()
+        reg = self.telemetry.registry
+        stored = min(int(h.fr_ptr), int(h.cap))
+        drops = int(h.fr_ptr) - stored
+        if drops > 0:
+            reg.count("findings_ring_drops", drops)
+            WARNING_MSG(
+                "generations: findings ring overflowed — %d "
+                "interesting lanes dropped this dispatch (finding "
+                "files/events under-report them; counters track the "
+                "loss; raise jit_harness gen_findings_cap)", drops)
+        statuses, new_paths, ucs, uhs = unpack_verdicts(
+            h.fr_pack[:stored])
+        replay_adm = bool(self.feedback or self.store is not None)
+        # reseeding off => the device ledger is empty by construction:
+        # edge-novel ring lanes go through host-side admission, same
+        # gates as the host-driven loop (with reseeding on the ledger
+        # replay below owns admission and ring lanes must not)
+        admit_ring = not self._gen_reseed
+        self._credit_arm = None
+        with timer("triage"):
+            ei = 0
+            adm_cap = h.adm_valid.shape[1]
+            for j in range(int(h.g)):
+                gid = int(h.gen0) + j
+                # this generation's interesting lanes first (the ring
+                # is (gen, lane)-ordered), then its admissions
+                while ei < stored and int(h.fr_gen[ei]) <= gid:
+                    buf = h.fr_bufs[ei, :int(h.fr_len[ei])].tobytes()
+                    self._triage_lane(
+                        int(statuses[ei]), int(new_paths[ei]), buf,
+                        bool(ucs[ei]), bool(uhs[ei]),
+                        admit=admit_ring)
+                    ei += 1
+                if not replay_adm or not int(h.adm_raw[j]):
+                    continue
+                parent = self._ring_mirror.get(int(h.sel[j]), "base")
+                for a in range(adm_cap):
+                    if not int(h.adm_valid[j, a]):
+                        continue
+                    buf = h.adm_bufs[j, a,
+                                     :int(h.adm_len[j, a])].tobytes()
+                    digest = md5_hex(buf)
+                    self._admit_arm(buf, digest, parent=parent)
+                    self._ring_mirror[int(h.adm_slot[j, a])] = digest
+                    self.telemetry.event(
+                        "ring_admit", md5=digest,
+                        slot=int(h.adm_slot[j, a]), gen=gid,
+                        parent=parent)
+            while ei < stored:      # defensive: trailing entries
+                buf = h.fr_bufs[ei, :int(h.fr_len[ei])].tobytes()
+                self._triage_lane(
+                    int(statuses[ei]), int(new_paths[ei]), buf,
+                    bool(ucs[ei]), bool(uhs[ei]), admit=admit_ring)
+                ei += 1
+        reg.gauge("gen_ring_filled", int(h.ring_filled.sum()))
+        DEBUG_MSG("generations dispatch done: %d iterations total",
+                  done_through)
+
+    def _run_generations(self, n_iterations: int) -> None:
+        """The device-resident dispatch mode: each device call runs up
+        to ``self.generations`` full generations (mutate -> execute ->
+        triage -> ring reseed, ops/generations.py) and the host only
+        drains findings + the admission ledger.  Double-buffered (a
+        dispatch is G batches long, so depth 2 keeps the device fed);
+        stands down to the host-driven loop — with a named warning —
+        when the crack stage is active or the driver/mutator can't
+        run the generation loop (same discipline as the superbatch
+        path).  With corpus feedback off, device reseeding is off too
+        and the candidate stream is bit-identical to the host loop."""
+        from collections import deque
+        drv = self.driver
+        mut = drv.mutator
+        g_max = max(int(self.generations), 1)
+        reseed = bool(self.feedback)
+        self._gen_reseed = reseed
+        reg = self.telemetry.registry
+        stood_down = self.cracker is not None \
+            or not drv.supports_batch_generations()
+        pending: "deque" = deque()
+        if not stood_down:
+            self._pending = pending     # watchdog-dump visibility
+            try:
+                while True:
+                    room = min(self._remaining(n_iterations),
+                               mut.remaining(),
+                               g_max * self.batch_size)
+                    if room <= 0:
+                        break
+                    if not drv.supports_batch_generations():
+                        stood_down = True   # mid-run state change
+                        break
+                    if self.profile_device and not self._prof_active:
+                        self._profile_start()
+                    n_real = min(room, self.batch_size)
+                    g_room = min(max(room // self.batch_size, 1),
+                                 g_max)
+                    # g is a STATIC jit argument: an arbitrary tail
+                    # count would recompile the whole G-generation
+                    # scan for one dispatch, so tails quantize down
+                    # to a power of two — a campaign compiles at most
+                    # log2(G) tail shapes, each reusable
+                    g_eff = g_room if g_room == g_max \
+                        else 1 << (g_room.bit_length() - 1)
+                    if self.watchdog is not None:
+                        # a G-generation dispatch legitimately waits
+                        # ~G x one batch: scale the guard deadline
+                        self.watchdog.note_dispatch_scale(g_eff)
+                    lane = None
+                    tr = self.telemetry.trace
+                    if tr is not None:
+                        lane = self._trace_lane(tr)
+                    with self._wd_guard("dispatch"):
+                        chaos_point("device_dispatch")
+                        out = drv.test_batch_generations(
+                            n_real, g_eff, pad_to=self.batch_size,
+                            reseed=reseed)
+                    self.stats.iterations += g_eff * n_real
+                    self._fb_batches += g_eff
+                    out.prefetch()
+                    if tr is not None:
+                        tr.async_begin(
+                            "in_flight", lane,
+                            args={"batch": self._batch_seq,
+                                  "n": g_eff * n_real,
+                                  "generations": g_eff})
+                    self._batch_seq += 1
+                    if self._prof_active:
+                        self.profile_device -= g_eff
+                        if self.profile_device <= 0:
+                            self._profile_stop()
+                    pending.append((out, g_eff * n_real,
+                                    self.stats.iterations, None,
+                                    None, lane))
+                    if len(pending) >= 2:   # double buffer
+                        self._drain_generations(*pending.popleft())
+                    reg.rate("execs", g_eff * n_real)
+                    reg.gauge("generations_per_dispatch", g_eff)
+                    reg.gauge("pipeline_depth", len(pending))
+                    self.telemetry.maybe_flush()
+                    self._persist_campaign()
+                    if self.sync is not None:
+                        self.sync.maybe_sync(self)
+            finally:
+                while pending:
+                    self._drain_generations(*pending.popleft())
+                if self.watchdog is not None:
+                    self.watchdog.note_dispatch_scale(1)
+        if stood_down:
+            if not self._gen_warned:
+                self._gen_warned = True
+                reason = ("the crack stage injects host-side "
+                          "candidates and focus masks"
+                          if self.cracker is not None else
+                          "the driver/mutator cannot run the device "
+                          "generation loop (needs jit_harness + a "
+                          "fused-capable mutator, no focus mask, no "
+                          "edges mode, single-chip)")
+                WARNING_MSG("--generations stood down: %s — running "
+                            "the host-driven loop", reason)
+            self._run_batched(n_iterations)
 
     def _run_single(self, n_iterations: int) -> None:
         instr = self.driver.instrumentation

@@ -232,7 +232,8 @@ class JitHarnessInstrumentation(Instrumentation):
     device_backed = True
     OPTION_SCHEMA = {"target": str, "program_file": str, "max_steps": int,
                      "novelty": str, "edges": int, "engine": str,
-                     "phase1_steps": int}
+                     "phase1_steps": int, "gen_ring_slots": int,
+                     "gen_findings_cap": int, "gen_admits": int}
     OPTION_DESCS = {
         "target": "built-in KBVM target name (test/hang/libtest/cgc_like)",
         "program_file": "path to a .npz compiled KBVM program",
@@ -249,9 +250,22 @@ class JitHarnessInstrumentation(Instrumentation):
                         "phase-1 step budget (-1 = auto: max_steps/8 "
                         "when max_steps >= 256, measured ~1.5x on "
                         "deep targets; 0 = single phase)",
+        "gen_ring_slots": "--generations: device seed-slot ring size "
+                          "(slot 0 pins the base seed; default 32, "
+                          "min 2)",
+        "gen_findings_cap": "--generations: bounded findings-ring "
+                            "rows per dispatch (overflow is counted "
+                            "as findings_ring_drops, never silent; "
+                            "0 = auto: min(16384, max(batch/8, 256)) "
+                            "— every generation pays an append of "
+                            "width min(cap, batch), so the default "
+                            "stays well below the batch shape)",
+        "gen_admits": "--generations: max ring admissions per "
+                      "generation, lane order (default 8)",
     }
     DEFAULTS = {"novelty": "exact", "edges": 0, "engine": "xla",
-                "phase1_steps": -1}
+                "phase1_steps": -1, "gen_ring_slots": 32,
+                "gen_findings_cap": 0, "gen_admits": 8}
 
     def __init__(self, options: Optional[str] = None):
         super().__init__(options)
@@ -299,6 +313,12 @@ class JitHarnessInstrumentation(Instrumentation):
         self._last_counts: Optional[np.ndarray] = None
         self._last_unique_crash = False
         self._last_unique_hang = False
+        # --generations device state: seed-slot ring (lazy-built from
+        # the mutator's buffer on the first dispatch) + the global
+        # generation counter that salts deterministic slot selection
+        self._gen_ring = None
+        self._gen_ring_key = None
+        self._gen_count = 0
 
     # -- batched --------------------------------------------------------
 
@@ -453,6 +473,103 @@ class JitHarnessInstrumentation(Instrumentation):
         self.virgin_bits, self.virgin_crash, self.virgin_tmout = vb, vc, vh
         self.total_execs += int(k) * n
         return packed, bufs, lens, (sel_idx, sel_bufs, sel_lens, counts)
+
+    # -- device-resident generations (ops/generations.py) ---------------
+
+    def supports_generations(self, mutator) -> bool:
+        """True when the G-generation device loop can drive
+        ``mutator``: it needs the fused candidate spec (havoc's
+        keyed per-lane streams) and stands down while a crack-stage
+        focus mask is installed (the device loop generates candidates
+        itself and would silently drop the mask).  Unlike the fused
+        superbatch path this is engine-agnostic — the XLA engine runs
+        the same scan (the CPU/CI surface)."""
+        return (getattr(mutator, "fused_spec", None) is not None
+                and getattr(mutator, "focus_positions", None) is None
+                and not self.options.get("edges"))
+
+    def _ensure_gen_ring(self, seed_buf, seed_len) -> None:
+        """(Re)build the device seed-slot ring: slot 0 = the base
+        seed, pinned; the rest empty until edge-novel lanes admit.
+        Rebuilt when the candidate buffer width changes (a new base
+        seed shape would make stale slots unloadable)."""
+        slots = max(int(self.options["gen_ring_slots"]), 2)
+        L = int(np.asarray(seed_buf).shape[0])
+        if self._gen_ring is not None and \
+                self._gen_ring_key == (L, slots):
+            return
+        bufs = jnp.zeros((slots, L), jnp.uint8).at[0].set(
+            jnp.asarray(seed_buf, dtype=jnp.uint8))
+        lens = jnp.zeros((slots,), jnp.int32).at[0].set(
+            jnp.int32(seed_len))
+        filled = jnp.zeros((slots,), jnp.int32).at[0].set(1)
+        z = jnp.zeros((slots,), jnp.int32)
+        self._gen_ring = (bufs, lens, filled, z, z, jnp.int32(0))
+        self._gen_ring_key = (L, slots)
+
+    def run_batch_generations(self, mutator, its, g: int,
+                              pad_to: Optional[int] = None,
+                              reseed: bool = True):
+        """Run ``g`` full generations on device in ONE dispatch
+        (ops/generations.run_generations): mutate from the device
+        seed-slot ring, execute, triage against the device-resident
+        virgin maps, reseed the ring from edge-novel lanes, and
+        return the bounded findings ring + admission ledger as a LAZY
+        GenerationOutcome.  Generation j consumes iterations
+        ``its + j*len(its)``; callers advance the mutator by
+        ``g*len(its)``.  ``reseed=False`` pins every generation to
+        slot 0 (the base seed) — the candidate stream is then
+        bit-identical to the host-driven loop's."""
+        from ..ops.generations import (
+            DEFAULT_FINDINGS_CAP, GenerationOutcome, run_generations,
+        )
+        from ..ops.vm_kernel import LANE_TILE
+        n = len(its)
+        b = max(n, pad_to or 0)
+        if self.engine in ("pallas", "pallas_fused"):
+            b += (-b) % LANE_TILE
+        self._apply_exact_gate(b)
+        seed_buf, seed_len, base_key, stack_pow2 = mutator.fused_spec()
+        self._ensure_gen_ring(seed_buf, seed_len)
+        its = np.asarray(its, dtype=np.uint32)
+        if b > n:  # duplicate lane 0's iteration: coverage no-ops
+            its = np.concatenate([its, np.repeat(its[:1], b - n)])
+        salt = int(getattr(mutator, "options", {}).get("seed", 0)) \
+            & 0xFFFFFFFF
+        adm_cap = min(max(int(self.options["gen_admits"]), 1),
+                      self._gen_ring_key[1] - 1)
+        # findings-ring rows: every generation pays a nonzero +
+        # gather + scatter of width min(cap, batch) to append into
+        # the ring, so the auto default stays WELL below the batch
+        # shape — measured on CPU at -b 2048/G=8, cap 256 runs 1.25x
+        # the host loop while cap >= 1024 loses the whole win to the
+        # append machinery.  Steady-state interesting lanes are rare
+        # (that's the premise of the mode); overflow is counted and
+        # warned, and explicit gen_findings_cap values are honored
+        cap = int(self.options["gen_findings_cap"])
+        if cap <= 0:
+            cap = min(DEFAULT_FINDINGS_CAP, max(b // 8, 256))
+        (vb, vc, vh), ring, rep = run_generations(
+            self._instrs, self._edge_table, self._u_slots,
+            self._seg_id, *self._gen_ring, base_key,
+            jnp.asarray(its), jnp.int32(n),
+            jnp.uint32(self._gen_count), jnp.uint32(salt),
+            self.virgin_bits, self.virgin_crash, self.virgin_tmout,
+            self.program.mem_size, self.program.max_steps,
+            self.program.n_edges, self.exact, stack_pow2, int(g),
+            engine=("pallas" if self.engine in ("pallas",
+                                                "pallas_fused")
+                    else "xla"),
+            phase1_steps=self.phase1_steps, dots=self._dots,
+            reseed=bool(reseed), adm_cap=adm_cap, findings_cap=cap)
+        self.virgin_bits, self.virgin_crash, self.virgin_tmout = \
+            vb, vc, vh
+        self._gen_ring = ring
+        out = GenerationOutcome(*rep, gen0=self._gen_count, g=int(g),
+                                n_real=n, cap=cap)
+        self._gen_count += int(g)
+        self.total_execs += int(g) * n
+        return out
 
     # -- single-exec shim ----------------------------------------------
 

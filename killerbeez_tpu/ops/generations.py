@@ -1,0 +1,298 @@
+"""Device-resident generation loop — G full fuzzing generations per
+host round-trip.
+
+The host-driven loop (fuzzer/loop.py) returns to the host every K
+batches even on the fused superbatch path: novelty verdicts transfer,
+findings triage, and corpus reseeding all run host-side, and
+kb-timeline (PR 5) exists precisely because those stages bubble the
+device.  This module closes the loop ON the device (ROADMAP item 1,
+the PTrix move — keep the feedback computation where the throughput
+is): one jitted program runs
+
+    seed-slot sample -> havoc mutate -> KBVM execute -> classify ->
+    novelty vs device-resident virgin maps -> findings-ring append ->
+    seed-slot ring reseed
+
+G times in a ``lax.scan``, and the host drains ONE bounded findings
+report + admission ledger per dispatch.
+
+Device-resident state threaded through the scan carry:
+
+  * the three AFL virgin maps (``virgin_bits``/``crash``/``tmout``)
+    with ``_np_has_new_bits`` semantics replicated exactly (byte-wise
+    ``virgin &= ~trace``, the 0xFF new-tuple vs new-count 1/2 ret
+    distinction, crash/hang ``simplify_trace`` maps) — the same
+    ``_triage_counts`` tail every other engine uses, parity-pinned in
+    tests/test_generations.py;
+  * a seed-slot ring: S slots x max_len bytes + lengths + per-slot
+    hit/find stats.  Slot 0 pins the base seed; edge-novel lanes
+    (ret 2) are admitted FIFO into slots 1..S-1 (deterministic
+    eviction: admission k lands in slot ``1 + k % (S-1)``), at most
+    ``adm_cap`` per generation in lane order.  Every admission is
+    recorded in a per-generation ledger the host replays, so the
+    corpus store / scheduler arms / events stay in contract with the
+    host loop;
+  * a bounded findings ring (packed verdict byte, generation index,
+    lane iteration id, mutant bytes): interesting lanes append in
+    (generation, lane) order — exactly the order host triage would
+    have seen them — and overflow is COUNTED via the monotone write
+    pointer (``findings_ring_drops``), never silent.
+
+Candidate parity: per-lane PRNG keys are ``fold_in(base_key,
+absolute_iteration)`` — the same derivation as the mutator's
+``_keys`` and the fused kernel — so with reseeding off the candidate
+stream is bit-identical to the host-driven loop and the two produce
+the same findings (the determinism gate in tests).  With reseeding
+on, generation g mutates the ring slot picked by a ``_mix32`` draw
+over the filled slots — deterministic and host-replayable, but
+intentionally different seeds than the host bandit would pick.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import FUZZ_HANG, FUZZ_NONE, FUZZ_RUNNING
+from ..models.vm import _mix32
+
+#: default seed-slot ring size (slot 0 = pinned base seed)
+DEFAULT_RING_SLOTS = 32
+#: default bounded findings-ring capacity per dispatch
+DEFAULT_FINDINGS_CAP = 16384
+#: default max ring admissions per generation (lane order)
+DEFAULT_ADM_CAP = 8
+
+
+class GenerationOutcome(NamedTuple):
+    """One G-generation dispatch's host-facing report — all LAZY
+    device arrays until ``materialize()``."""
+    # bounded findings ring (valid rows: first min(fr_ptr, cap))
+    fr_pack: Any      # uint8[F]  pack_verdicts lane byte
+    fr_gen: Any       # int32[F]  global generation index
+    fr_iter: Any      # uint32[F] absolute mutator iteration
+    fr_len: Any       # int32[F]
+    fr_bufs: Any      # uint8[F, L]
+    fr_ptr: Any       # int32 scalar: TOTAL interesting lanes seen
+    # per-generation ledger
+    sel: Any          # int32[G] ring slot each generation mutated
+    adm_raw: Any      # int32[G] edge-novel lanes (uncapped)
+    adm_valid: Any    # int32[G, A]
+    adm_slot: Any     # int32[G, A]
+    adm_iter: Any     # uint32[G, A]
+    adm_len: Any      # int32[G, A]
+    adm_bufs: Any     # uint8[G, A, L]
+    ring_filled: Any  # int32[S] final ring occupancy (gauge)
+    # dispatch metadata (host ints)
+    gen0: int = 0     # global generation index of this dispatch's gen 0
+    g: int = 0        # generations in this dispatch
+    n_real: int = 0   # real (non-padding) lanes per generation
+    cap: int = 0      # findings-ring capacity F
+
+    def prefetch(self) -> None:
+        """Start device->host copies without blocking (the loop
+        enqueues the next dispatch while these land)."""
+        for a in self:
+            fn = getattr(a, "copy_to_host_async", None)
+            if fn is not None:
+                fn()
+
+    def materialize(self) -> "GenerationOutcome":
+        """Force every field to numpy (the blocking device wait the
+        loop wraps in its watchdog guard)."""
+        return self._replace(**{
+            f: (np.asarray(v) if hasattr(v, "shape") else v)
+            for f, v in self._asdict().items()})
+
+
+def _select_slot(ring_filled, gen_id, salt):
+    """Deterministic seed-slot pick for one generation: a _mix32 draw
+    over the FILLED slots (slot 0 is always filled).  Pure uint32
+    integer mixing so the host can replay the policy bit-exactly."""
+    nf = jnp.sum(ring_filled).astype(jnp.uint32)
+    r = _mix32(gen_id.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+               ^ salt.astype(jnp.uint32))
+    k = (r % jnp.maximum(nf, 1)).astype(jnp.int32)
+    cs = jnp.cumsum(ring_filled)
+    return jnp.argmax(cs > k).astype(jnp.int32)
+
+
+def np_select_slot(filled: np.ndarray, gen_id: int, salt: int) -> int:
+    """Host replay of ``_select_slot`` (numpy, bit-exact) — the
+    deterministic-policy witness the parity tests pin."""
+    m = 0xFFFFFFFF
+    x = ((int(gen_id) * 0x9E3779B9) & m) ^ (int(salt) & m)
+    x ^= x >> 16
+    x = (x * 0x85EBCA6B) & m
+    x ^= x >> 13
+    x = (x * 0xC2B2AE35) & m
+    x ^= x >> 16
+    nf = max(int(np.sum(filled)), 1)
+    k = x % nf
+    return int(np.argmax(np.cumsum(filled) > k))
+
+
+@partial(jax.jit,
+         static_argnames=("mem_size", "max_steps", "n_edges", "exact",
+                          "stack_pow2", "g", "engine", "phase1_steps",
+                          "dots", "reseed", "adm_cap", "findings_cap",
+                          "interpret"))
+def run_generations(instrs, edge_table, u_slots, seg_id,
+                    ring_bufs, ring_lens, ring_filled, ring_hits,
+                    ring_finds, ring_ptr,
+                    base_key, its0, n_real, gen0, salt,
+                    vb, vc, vh,
+                    mem_size, max_steps, n_edges, exact, stack_pow2,
+                    g, engine="xla", phase1_steps=0,
+                    dots=("f32", "f32"), reseed=True,
+                    adm_cap=DEFAULT_ADM_CAP,
+                    findings_cap=DEFAULT_FINDINGS_CAP,
+                    interpret=False):
+    """G generations in ONE device program.  Returns (new virgin maps,
+    new ring state, GenerationOutcome fields) — see module docstring
+    for the state/replay contract.
+
+    ``its0`` uint32[B] are generation 0's absolute iteration indices
+    (padded to the batch shape with lane-0 repeats); generation j
+    executes ``its0 + j*n_real`` — monotonic mutator consumption,
+    bit-identical to k sequential host batches.  ``engine`` picks the
+    mutate+execute tier: "xla" (vmapped havoc_at + the one-hot
+    engine; the CPU/CI path) or "pallas"/"pallas_fused" (the fused
+    VMEM kernel).  ``exact``/``dots``/``phase1_steps`` thread through
+    unchanged from the jit_harness config so novelty verdicts are
+    identical to the host-driven loop's.
+    """
+    from ..instrumentation.base import pack_verdicts
+    from ..instrumentation.jit_harness import _triage_counts
+
+    b = its0.shape[0]
+    L = ring_bufs.shape[1]
+    S = ring_bufs.shape[0]
+    F = int(findings_cap)
+    A = int(adm_cap) if reseed else 1   # ledger shape floor
+    cap_g = min(F, b)
+    lanes_real = jnp.arange(b) < n_real
+
+    def one_generation(carry, j):
+        (vb, vc, vh, ring_bufs, ring_lens, ring_filled, ring_hits,
+         ring_finds, ring_ptr, fr_pack, fr_gen, fr_iter, fr_len,
+         fr_bufs, fr_ptr) = carry
+        gen_id = gen0 + j
+        if reseed:
+            sel = _select_slot(ring_filled, gen_id, salt)
+        else:
+            sel = jnp.int32(0)
+        seed_buf = ring_bufs[sel]
+        seed_len = ring_lens[sel]
+        its = its0 + j * n_real.astype(jnp.uint32)
+        keys = jax.vmap(
+            lambda i: jax.random.fold_in(base_key, i))(its)
+        if engine in ("pallas", "pallas_fused"):
+            from .vm_kernel import (
+                fuzz_batch_pallas_2phase, havoc_words_for_keys,
+            )
+            words = havoc_words_for_keys(keys, stack_pow2)
+            res, bufs, lens = fuzz_batch_pallas_2phase(
+                instrs, edge_table, seed_buf, seed_len, words,
+                mem_size, max_steps, n_edges, stack_pow2=stack_pow2,
+                phase1_steps=phase1_steps, interpret=interpret,
+                dots=dots)
+        else:
+            from .mutate_core import havoc_at
+            from ..models.vm import _run_batch_impl
+            bufs, lens = jax.vmap(
+                lambda k: havoc_at(seed_buf, seed_len, k,
+                                   stack_pow2=stack_pow2))(keys)
+            res = _run_batch_impl(instrs, edge_table, bufs, lens,
+                                  mem_size, max_steps, n_edges, False)
+        statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
+                             res.status)
+        new_paths, uc, uh, vb, vc, vh = _triage_counts(
+            res.counts, statuses, u_slots, seg_id, vb, vc, vh, exact)
+        packed = pack_verdicts(statuses, new_paths, uc, uh)
+
+        # findings ring: interesting lanes append in lane order at
+        # the carried write pointer; rows past F drop (mode="drop")
+        # but the pointer keeps counting so overflow is never silent
+        flags = ((statuses != FUZZ_NONE) | (new_paths > 0)) \
+            & lanes_real
+        raw = jnp.sum(flags).astype(jnp.int32)
+        (idx,) = jnp.nonzero(flags, size=cap_g, fill_value=0)
+        pos = fr_ptr + jnp.arange(cap_g, dtype=jnp.int32)
+        valid = (jnp.arange(cap_g) < jnp.minimum(raw, cap_g)) \
+            & (pos < F)
+        tgt = jnp.where(valid, pos, F)
+        fr_pack = fr_pack.at[tgt].set(packed[idx], mode="drop")
+        fr_gen = fr_gen.at[tgt].set(gen_id.astype(jnp.int32),
+                                    mode="drop")
+        fr_iter = fr_iter.at[tgt].set(its[idx], mode="drop")
+        fr_len = fr_len.at[tgt].set(lens[idx].astype(jnp.int32),
+                                    mode="drop")
+        fr_bufs = fr_bufs.at[tgt].set(bufs[idx].astype(jnp.uint8),
+                                      mode="drop")
+        fr_ptr = fr_ptr + raw
+
+        # per-slot stats for the GENERATING slot (before any
+        # admission overwrites it)
+        aflags = (new_paths == 2) & lanes_real
+        araw = jnp.sum(aflags).astype(jnp.int32)
+        ring_hits = ring_hits.at[sel].add(1)
+        ring_finds = ring_finds.at[sel].add(araw)
+
+        if reseed:
+            # FIFO admission of the first adm_cap edge-novel lanes
+            # into slots 1..S-1; slots are distinct (adm_cap <= S-1)
+            (aidx,) = jnp.nonzero(aflags, size=A, fill_value=0)
+            n_adm = jnp.minimum(araw, A)
+            avalid = jnp.arange(A) < n_adm
+            slots = 1 + (ring_ptr + jnp.arange(A, dtype=jnp.int32)) \
+                % (S - 1)
+            tgt_s = jnp.where(avalid, slots, S)
+            ring_bufs = ring_bufs.at[tgt_s].set(
+                bufs[aidx].astype(jnp.uint8), mode="drop")
+            ring_lens = ring_lens.at[tgt_s].set(
+                lens[aidx].astype(jnp.int32), mode="drop")
+            ring_filled = ring_filled.at[tgt_s].set(1, mode="drop")
+            ring_hits = ring_hits.at[tgt_s].set(0, mode="drop")
+            ring_finds = ring_finds.at[tgt_s].set(0, mode="drop")
+            ring_ptr = ring_ptr + n_adm
+            ledger = (avalid.astype(jnp.int32), slots * avalid,
+                      its[aidx] * avalid.astype(jnp.uint32),
+                      lens[aidx].astype(jnp.int32) * avalid,
+                      bufs[aidx].astype(jnp.uint8))
+        else:
+            zA = jnp.zeros((A,), jnp.int32)
+            ledger = (zA, zA, zA.astype(jnp.uint32), zA,
+                      jnp.zeros((A, L), jnp.uint8))
+
+        carry = (vb, vc, vh, ring_bufs, ring_lens, ring_filled,
+                 ring_hits, ring_finds, ring_ptr, fr_pack, fr_gen,
+                 fr_iter, fr_len, fr_bufs, fr_ptr)
+        return carry, (sel, araw) + ledger
+
+    carry0 = (vb, vc, vh, ring_bufs, ring_lens, ring_filled,
+              ring_hits, ring_finds, ring_ptr,
+              jnp.zeros((F,), jnp.uint8),        # fr_pack
+              jnp.zeros((F,), jnp.int32),        # fr_gen
+              jnp.zeros((F,), jnp.uint32),       # fr_iter
+              jnp.zeros((F,), jnp.int32),        # fr_len
+              jnp.zeros((F, L), jnp.uint8),      # fr_bufs
+              jnp.int32(0))                      # fr_ptr
+    carry, ys = jax.lax.scan(
+        one_generation, carry0,
+        jnp.arange(g, dtype=jnp.uint32))
+    (vb, vc, vh, ring_bufs, ring_lens, ring_filled, ring_hits,
+     ring_finds, ring_ptr, fr_pack, fr_gen, fr_iter, fr_len,
+     fr_bufs, fr_ptr) = carry
+    (sel, adm_raw, adm_valid, adm_slot, adm_iter, adm_len,
+     adm_bufs) = ys
+    return ((vb, vc, vh),
+            (ring_bufs, ring_lens, ring_filled, ring_hits,
+             ring_finds, ring_ptr),
+            (fr_pack, fr_gen, fr_iter, fr_len, fr_bufs, fr_ptr,
+             sel, adm_raw, adm_valid, adm_slot, adm_iter, adm_len,
+             adm_bufs, ring_filled))

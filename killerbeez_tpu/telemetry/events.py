@@ -58,7 +58,11 @@ EVENT_TYPES = ("new_path", "crash", "hang", "plateau",
                # resilience records (resilience/): a dispatch the
                # watchdog had to kill, and a classified device loss
                # the supervisor will re-probe for
-               "watchdog_stall", "device_lost")
+               "watchdog_stall", "device_lost",
+               # --generations: the host-side replay of one device
+               # seed-slot ring admission (the device-resident loop's
+               # analogue of scheduler_pick + admission)
+               "ring_admit")
 
 #: events a fleet worker forwards to the manager alongside heartbeats
 TERMINAL_EVENTS = ("crash", "hang", "plateau")

@@ -125,6 +125,16 @@ def render(snap: Dict[str, Any]) -> str:
         if c.get("solver_injected"):
             line += f" | {_fmt_n(c.get('solver_injected', 0))} injected"
         lines.append(line)
+    if g.get("generations_per_dispatch"):
+        line = (f"  genloop  : "
+                f"{int(g.get('generations_per_dispatch', 0))} "
+                f"generations/dispatch (device-resident)"
+                f" | ring {int(g.get('gen_ring_filled', 0))} "
+                f"slots filled")
+        if c.get("findings_ring_drops"):
+            line += (f" | {_fmt_n(c.get('findings_ring_drops', 0))} "
+                     "findings-ring drops")
+        lines.append(line)
     lines.append(
         f"  crashes  : {_fmt_n(c.get('crashes', 0))}"
         f" ({_fmt_n(c.get('unique_crashes', 0))} unique)"

@@ -214,6 +214,41 @@ def detect_bubbles(spans: List[Dict[str, Any]],
     return bubbles, threshold_us
 
 
+def generations_report(spans: List[Dict[str, Any]]
+                       ) -> Optional[Dict[str, Any]]:
+    """--generations campaign analysis: each device dispatch's
+    in-flight span carries its generation count in the span args;
+    report dispatch/generation totals, DEVICE occupancy over the
+    generation window (fraction of the window with a G-generation
+    dispatch in flight) and host-stage occupancy over the same
+    window.  ``device_bound`` is the ROADMAP item 1 acceptance call:
+    the device, not host mutate/triage, holds the critical path."""
+    disp = [s for s in spans
+            if s.get("name") == "in_flight"
+            and (s.get("args") or {}).get("generations")]
+    if not disp:
+        return None
+    w0 = min(s["t0"] for s in disp)
+    w1 = max(s["t1"] for s in disp)
+    window = max(w1 - w0, 1e-9)
+    gens = [int(s["args"]["generations"]) for s in disp]
+    dev = _union_len([(s["t0"], s["t1"]) for s in disp]) / window
+    host_iv = [(max(s["t0"], w0), min(s["t1"], w1))
+               for s in spans if s["name"] in HOST_STAGES
+               and s["t1"] > w0 and s["t0"] < w1]
+    host = _union_len(host_iv) / window
+    return {
+        "dispatches": len(disp),
+        "generations_total": sum(gens),
+        "generations_min": min(gens),
+        "generations_max": max(gens),
+        "device_occupancy": dev,
+        "host_occupancy": host,
+        "window_us": window,
+        "device_bound": bool(dev > host),
+    }
+
+
 # -- events -------------------------------------------------------------
 
 
@@ -443,6 +478,21 @@ def render(report: Dict[str, Any], lanes: List[str]) -> str:
                 f"  pipeline      : {inf['occupancy']:.1%} of the "
                 f"window with batches in flight "
                 f"({int(inf['count'])} batches)")
+    gr = report.get("generations")
+    if gr:
+        lines.append(
+            f"  generations   : {gr['dispatches']} device dispatches"
+            f" x {gr['generations_min']}"
+            + (f"-{gr['generations_max']}"
+               if gr["generations_max"] != gr["generations_min"]
+               else "")
+            + f" generations ({gr['generations_total']} total)")
+        lines.append(
+            f"                  device {gr['device_occupancy']:.1%} "
+            f"vs host {gr['host_occupancy']:.1%} occupancy over the "
+            f"generation window — "
+            + ("DEVICE-bound (host stages off the critical path)"
+               if gr["device_bound"] else "host-bound"))
     bubbles = report.get("bubbles", [])
     lines.append(
         f"  bubbles       : {len(bubbles)} detected, "
@@ -522,6 +572,9 @@ def build_report(doc: Optional[Dict[str, Any]],
             "bubble_threshold_us": thresh,
             "trace_meta": doc.get("otherData", {}),
         })
+        gr = generations_report(spans)
+        if gr:
+            report["generations"] = gr
     if events:
         report["events"] = event_summary(events)
     if events and stats:

@@ -1,0 +1,553 @@
+"""--generations device-resident loop (ops/generations.py): the TPU
+runs G full mutate -> execute -> triage -> reseed generations per host
+dispatch and the host only drains the bounded findings ring + the
+admission ledger.
+
+Pins the ISSUE 9 contracts:
+  * device/host novelty parity — the device-resident virgin-map
+    update is bit-exact with the numpy reference ``_np_has_new_bits``
+    (including the 0xFF new-tuple vs new-count 1/2 distinction and
+    the crash/tmout simplify_trace maps) across random trace batches;
+  * determinism/replay — a --generations campaign and the host-driven
+    loop given the same RNG seed produce the same findings on the toy
+    targets, and a SIGKILL mid-dispatch + --resume converges to the
+    fault-free control (the PR 8 chaos harness);
+  * the deterministic seed-slot policy is host-replayable
+    (np_select_slot == _select_slot), admissions replay into real
+    corpus arms with no duplicates, findings-ring overflow is COUNTED
+    (never silent), and the watchdog deadline scales with the
+    effective generation count (no false-positive exit 86).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from killerbeez_tpu import FUZZ_CRASH, FUZZ_HANG, FUZZ_NONE, MAP_SIZE
+from killerbeez_tpu.drivers.factory import driver_factory
+from killerbeez_tpu.fuzzer.loop import Fuzzer
+from killerbeez_tpu.instrumentation.afl import (
+    _np_classify, _np_has_new_bits,
+)
+from killerbeez_tpu.instrumentation.factory import instrumentation_factory
+from killerbeez_tpu.instrumentation.jit_harness import _triage_exact
+from killerbeez_tpu.mutators.factory import mutator_factory
+from killerbeez_tpu.ops.coverage import classify_counts, simplify_trace
+from killerbeez_tpu.ops.generations import _select_slot, np_select_slot
+from killerbeez_tpu.resilience.watchdog import DispatchWatchdog
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# device/host novelty parity (satellite: bit-exact with _np_has_new_bits)
+# ---------------------------------------------------------------------------
+
+
+def _np_simplify(trace: np.ndarray) -> np.ndarray:
+    return np.where(trace == 0, np.uint8(1), np.uint8(128))
+
+
+def _random_traces(rng, b):
+    """Sparse random hit-count maps (the real shape of AFL traces)
+    plus a couple of dense lanes and one all-zero lane."""
+    traces = np.zeros((b, MAP_SIZE), np.uint8)
+    for i in range(b - 1):
+        k = int(rng.integers(1, 300))
+        idx = rng.integers(0, MAP_SIZE, size=k)
+        traces[i, idx] = rng.integers(1, 256, size=k).astype(np.uint8)
+    traces[b - 2] = rng.integers(0, 256, size=MAP_SIZE)  # dense
+    return traces  # lane b-1 stays all-zero
+
+
+@pytest.mark.parametrize("case_seed", [0, 7, 91])
+def test_virgin_update_bit_exact_with_np_reference(case_seed):
+    """Property test: the exact-parity triage scan the generation
+    loop threads its virgin maps through must agree byte-for-byte
+    with the numpy single-exec reference — the 1-vs-2 ret distinction
+    (new count bucket vs brand-new tuple, virgin byte still 0xFF),
+    and the crash/tmout maps updated through simplify_trace only on
+    the matching status."""
+    rng = np.random.default_rng(case_seed)
+    b = 24
+    traces = _random_traces(rng, b)
+    statuses = rng.choice(
+        [FUZZ_NONE, FUZZ_CRASH, FUZZ_HANG], size=b).astype(np.int32)
+    # start from PARTIALLY-seen maps so ret==1 (new bucket on a
+    # non-0xFF byte) actually occurs: pre-fold a few traces in
+    vb = np.full(MAP_SIZE, 0xFF, np.uint8)
+    vc = np.full(MAP_SIZE, 0xFF, np.uint8)
+    vh = np.full(MAP_SIZE, 0xFF, np.uint8)
+    for t in _random_traces(rng, 4):
+        vb &= ~_np_classify(t)
+        vc &= ~_np_simplify(t)
+    # some lanes repeat an earlier lane's trace: ret must be 0 there
+    traces[5] = traces[1]
+    traces[11] = traces[2]
+
+    hvb, hvc, hvh = vb.copy(), vc.copy(), vh.copy()
+    exp_ret = np.zeros(b, np.int32)
+    exp_uc = np.zeros(b, bool)
+    exp_uh = np.zeros(b, bool)
+    for i in range(b):
+        cls = _np_classify(traces[i])
+        simp = _np_simplify(traces[i])
+        exp_ret[i], hvb = _np_has_new_bits(hvb, cls)
+        if statuses[i] == FUZZ_CRASH:
+            r, hvc = _np_has_new_bits(hvc, simp)
+            exp_uc[i] = r > 0
+        elif statuses[i] == FUZZ_HANG:
+            r, hvh = _np_has_new_bits(hvh, simp)
+            exp_uh[i] = r > 0
+
+    cls_d = classify_counts(jnp.asarray(traces))
+    simp_d = simplify_trace(jnp.asarray(traces))
+    new_paths, uc, uh, dvb, dvc, dvh = _triage_exact(
+        jnp.asarray(vb), jnp.asarray(vc), jnp.asarray(vh),
+        cls_d, simp_d, jnp.asarray(statuses))
+    assert np.array_equal(np.asarray(new_paths), exp_ret)
+    assert np.array_equal(np.asarray(uc), exp_uc)
+    assert np.array_equal(np.asarray(uh), exp_uh)
+    assert np.array_equal(np.asarray(dvb), hvb)
+    assert np.array_equal(np.asarray(dvc), hvc)
+    assert np.array_equal(np.asarray(dvh), hvh)
+    # the distinction must actually have been exercised
+    assert (exp_ret == 2).any() and (exp_ret == 1).any() \
+        and (exp_ret == 0).any()
+
+
+def test_select_slot_host_replay_parity():
+    """The deterministic seed-slot policy: the device pick and the
+    host replay (np_select_slot) agree for random ring occupancies —
+    and always land on a FILLED slot."""
+    rng = np.random.default_rng(5)
+    for _ in range(64):
+        s = int(rng.integers(2, 48))
+        filled = np.zeros(s, np.int32)
+        filled[0] = 1  # slot 0 pins the base seed
+        filled[rng.integers(0, s, size=int(rng.integers(0, s)))] = 1
+        gen_id = int(rng.integers(0, 2**32))
+        salt = int(rng.integers(0, 2**32))
+        dev = int(_select_slot(jnp.asarray(filled),
+                               jnp.uint32(gen_id), jnp.uint32(salt)))
+        host = np_select_slot(filled, gen_id, salt)
+        assert dev == host
+        assert filled[host] == 1
+
+
+# ---------------------------------------------------------------------------
+# determinism: generations campaign == host-driven loop
+# ---------------------------------------------------------------------------
+
+SEED = b"ABC@"
+
+
+def _campaign(tmp_path, name, generations, *, target="test",
+              seed=SEED, batch=64, n=1024, feedback=0, iopts=None,
+              mopts='{"seed": 7}'):
+    instr = instrumentation_factory(
+        "jit_harness", iopts or json.dumps({"target": target}))
+    mut = mutator_factory("havoc", mopts, seed)
+    drv = driver_factory("file", None, instr, mut)
+    fz = Fuzzer(drv, output_dir=str(tmp_path / name), batch_size=batch,
+                feedback=feedback, generations=generations,
+                corpus_dir=(str(tmp_path / name / "corpus")
+                            if feedback else None))
+    fz.run(n)
+    return fz, instr
+
+
+def _findings(root):
+    out = {}
+    for kind in ("crashes", "hangs", "new_paths"):
+        d = os.path.join(root, kind)
+        out[kind] = sorted(
+            f for f in (os.listdir(d) if os.path.isdir(d) else [])
+            if len(f) == 32)
+    return out
+
+
+def test_generations_campaign_matches_host_loop(tmp_path):
+    """THE determinism contract: with reseeding off (-fb 0) the
+    device generation loop consumes the exact candidate stream the
+    host-driven loop would (fold_in(base_key, absolute_iteration)),
+    so findings AND final virgin maps are identical."""
+    fh, ih = _campaign(tmp_path, "host", 0)
+    fg, ig = _campaign(tmp_path, "gen", 4)
+    assert fg.stats.iterations == fh.stats.iterations == 1024
+    assert _findings(str(tmp_path / "gen")) == \
+        _findings(str(tmp_path / "host"))
+    assert fg.stats.crashes == fh.stats.crashes
+    assert fg.stats.new_paths == fh.stats.new_paths
+    for a, b in ((ig.virgin_bits, ih.virgin_bits),
+                 (ig.virgin_crash, ih.virgin_crash),
+                 (ig.virgin_tmout, ih.virgin_tmout)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # the comparison is about something: both loops found paths
+    assert fg.stats.new_paths >= 1
+
+
+def test_generations_partial_last_dispatch(tmp_path):
+    """-n not divisible by G x batch: the loop clamps the effective
+    generation count so the exec total is exact."""
+    fg, _ = _campaign(tmp_path, "gen", 8, n=640, batch=64)
+    assert fg.stats.iterations == 640
+
+
+def test_generations_ring_admissions_replay_into_arms(tmp_path):
+    """Feedback ON: the device's ring admissions replay through the
+    host admission stage — real corpus arms (no duplicates), a
+    ring_admit event per admission, and the scheduler keeps working.
+    cgc_like under havoc admits within a few generations."""
+    seed = b"CG\x02\x04\x05\x41xx"
+    fz, _ = _campaign(
+        tmp_path, "fb", 4, target="cgc_like", seed=seed,
+        batch=256, n=4096, feedback=8, mopts='{"seed": 11}')
+    md5s = [getattr(a, "md5", None) for a in fz.scheduler.arms]
+    assert len(md5s) == len(set(md5s))          # no duplicate arms
+    evs = [json.loads(l) for l in
+           open(tmp_path / "fb" / "events.jsonl") if l.strip()]
+    admits = [e for e in evs if e["type"] == "ring_admit"]
+    assert admits, "device ring never admitted on cgc_like"
+    store_dir = tmp_path / "fb" / "corpus"
+    for e in admits:
+        # every replayed admission is a real store entry
+        assert (store_dir / e["md5"]).exists()
+        assert e["slot"] >= 1                   # slot 0 stays pinned
+    assert fz.stats.new_paths > 0
+
+
+def test_generations_fb0_store_write_through_matches_host(tmp_path):
+    """REGRESSION: -fb 0 with a corpus store configured.  The
+    host-driven loop write-throughs every edge-novel find; with
+    reseeding off the device ledger is empty, so the generations
+    drain must admit ring lanes host-side — otherwise the store
+    (and fleet sync) silently miss every find of the exact config
+    the determinism contract pins."""
+    def run(name, generations):
+        instr = instrumentation_factory(
+            "jit_harness", '{"target": "test"}')
+        mut = mutator_factory("havoc", '{"seed": 7}', SEED)
+        drv = driver_factory("file", None, instr, mut)
+        fz = Fuzzer(drv, output_dir=str(tmp_path / name),
+                    batch_size=64, feedback=0, generations=generations,
+                    corpus_dir=str(tmp_path / name / "corpus"))
+        fz.run(1024)
+        return fz
+
+    run("host", 0)
+    run("gen", 4)
+
+    def entries(name):
+        d = tmp_path / name / "corpus"
+        return sorted(f for f in os.listdir(d) if len(f) == 32)
+
+    assert entries("gen") == entries("host")
+    assert entries("gen"), "store stayed empty — nothing compared"
+
+
+def test_findings_ring_overflow_counted_never_silent(tmp_path):
+    """gen_findings_cap=2 on a findings-heavy target: the ring MUST
+    overflow, and every dropped lane lands in the
+    findings_ring_drops counter (no-silent-caps rule)."""
+    fz, _ = _campaign(
+        tmp_path, "ovf", 4,
+        iopts='{"target": "test", "gen_findings_cap": 2}',
+        batch=64, n=512)
+    reg = fz.telemetry.registry
+    drops = reg.counters.get("findings_ring_drops", 0)
+    assert drops > 0
+
+
+def test_generations_stands_down_with_crack_stage(tmp_path):
+    """The crack stage injects host-side candidates + focus masks:
+    --generations must stand down to the host-driven loop (same
+    discipline as the superbatch path) and still complete."""
+    instr = instrumentation_factory(
+        "jit_harness", '{"target": "test"}')
+    mut = mutator_factory("havoc", '{"seed": 7}', SEED)
+    drv = driver_factory("file", None, instr, mut)
+    fz = Fuzzer(drv, output_dir=str(tmp_path / "o"), batch_size=64,
+                feedback=0, generations=4)
+    class _StubCracker:                 # any non-None stands down
+        def maybe_crack(self, fz):
+            return None
+
+    fz.cracker = _StubCracker()
+    fz.run(256)
+    assert fz._gen_warned
+    assert fz.stats.iterations == 256
+
+
+def test_supports_generations_gates(tmp_path):
+    """supports_batch_generations: false for focus masks and edges
+    mode — the device loop can't honor either."""
+    instr = instrumentation_factory("jit_harness",
+                                    '{"target": "test"}')
+    mut = mutator_factory("havoc", '{"seed": 7}', SEED)
+    drv = driver_factory("file", None, instr, mut)
+    assert drv.supports_batch_generations()
+    mut.set_focus_mask([0, 1])
+    assert not drv.supports_batch_generations()
+    mut.set_focus_mask(None)
+    assert drv.supports_batch_generations()
+    instr2 = instrumentation_factory(
+        "jit_harness", '{"target": "test", "edges": 1}')
+    drv2 = driver_factory("file", None, instr2, mut)
+    assert not drv2.supports_batch_generations()
+
+
+# ---------------------------------------------------------------------------
+# watchdog scaling (satellite: no false-positive exit 86 under -G)
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_deadline_scales_with_generation_count():
+    wd = DispatchWatchdog(multiplier=4.0, min_deadline=0.05,
+                          max_deadline=100.0)
+    wd._ema_batch_s = 0.5                       # warm estimate
+    base = wd.deadline()
+    assert base == pytest.approx(2.0)
+    wd.note_dispatch_scale(16)
+    assert wd.deadline() == pytest.approx(16 * base)
+    # the ceiling scales too: a large G is not clamped back to a
+    # one-batch budget (which would false-positive by construction)
+    wd2 = DispatchWatchdog(multiplier=4.0, min_deadline=0.05,
+                           max_deadline=1.0)
+    wd2._ema_batch_s = 0.5
+    wd2.note_dispatch_scale(64)
+    assert wd2.deadline() == pytest.approx(64.0)
+    # cold start grants the (scaled) ceiling
+    wd3 = DispatchWatchdog(min_deadline=0.05, max_deadline=2.0)
+    wd3.note_dispatch_scale(8)
+    assert wd3.deadline() == pytest.approx(16.0)
+
+
+def test_watchdog_ema_stays_per_batch_across_scales():
+    """Observed guarded waits fold into the EMA divided by the armed
+    scale — a G-generation dispatch must not inflate the per-batch
+    estimate G-fold (which would blunt the watchdog for the host
+    loop after a mode switch)."""
+    wd = DispatchWatchdog(multiplier=4.0, min_deadline=0.01,
+                          max_deadline=100.0)
+    wd.note_dispatch_scale(10)
+    wd._arm("dispatch")
+    time.sleep(0.2)                 # a "10-generation" wait
+    wd._disarm()
+    # EMA saw ~0.02s/batch, not ~0.2s
+    assert 0.0 < wd._ema_batch_s < 0.1
+
+
+def test_watchdog_no_false_positive_on_scaled_dispatch():
+    """REGRESSION (satellite 1): a G-generation dispatch legitimately
+    waits ~G x one batch.  Unscaled, this guard blows its deadline
+    (monitor tick 0.25s); with note_dispatch_scale(G) it must not."""
+    fired = threading.Event()
+    wd = DispatchWatchdog(multiplier=2.0, min_deadline=0.1,
+                          max_deadline=60.0,
+                          action=fired.set)
+    wd._ema_batch_s = 0.1           # warm: one batch ~ 0.1s
+    assert wd.deadline() == pytest.approx(0.2)
+    wd.note_dispatch_scale(8)       # dispatch now covers 8 batches
+    try:
+        with wd.guard("dispatch"):  # guard starts the monitor
+            time.sleep(1.0)         # ~5x the UNSCALED deadline
+        assert not fired.is_set()
+    finally:
+        wd.stop()
+    assert wd.stalls == 0
+
+
+class _RecordingWatchdog(DispatchWatchdog):
+    """A real watchdog (huge deadlines — never fires) that records
+    every note_dispatch_scale call the loop makes."""
+
+    def __init__(self):
+        super().__init__(multiplier=1e6, min_deadline=1e6,
+                         max_deadline=1e6)
+        self.scales = []
+
+    def note_dispatch_scale(self, k):
+        self.scales.append(int(k))
+        super().note_dispatch_scale(k)
+
+
+def test_watchdog_scale_follows_drained_dispatch(tmp_path):
+    """REGRESSION: with a pipeline of pending dispatches, the drain
+    waits on the OLDEST one — its guard must arm with THAT
+    dispatch's generation count.  A shrunken tail dispatch (g_eff 1)
+    queued behind a full-G one would otherwise clamp the full-G
+    drain to a 1-batch deadline: false-positive exit 86."""
+    wd = _RecordingWatchdog()
+    instr = instrumentation_factory(
+        "jit_harness", '{"target": "test"}')
+    mut = mutator_factory("havoc", '{"seed": 7}', SEED)
+    drv = driver_factory("file", None, instr, mut)
+    fz = Fuzzer(drv, output_dir=str(tmp_path / "o"), batch_size=64,
+                feedback=0, generations=4, watchdog=wd)
+    try:
+        fz.run(320)     # one g=4 dispatch + one g=1 tail dispatch
+    finally:
+        wd.stop()
+    assert fz.stats.iterations == 320
+    # dispatch A (g=4), dispatch B (g=1), drain A re-arms at A's
+    # OWN scale 4 (the regression), drain B at 1, final reset to 1
+    assert wd.scales == [4, 1, 4, 1, 1]
+
+
+def test_generations_tail_quantizes_to_pow2(tmp_path):
+    """Tail dispatches quantize the generation count down to a power
+    of two: g is a STATIC jit argument, so an arbitrary tail G would
+    recompile the whole generation scan for one dispatch.  The exec
+    total must stay exact regardless."""
+    wd = _RecordingWatchdog()
+    instr = instrumentation_factory(
+        "jit_harness", '{"target": "test"}')
+    mut = mutator_factory("havoc", '{"seed": 7}', SEED)
+    drv = driver_factory("file", None, instr, mut)
+    fz = Fuzzer(drv, output_dir=str(tmp_path / "o"), batch_size=64,
+                feedback=0, generations=8, watchdog=wd)
+    try:
+        fz.run(64 * 11)     # 8 + (3 -> 2) + 1 generations
+    finally:
+        wd.stop()
+    assert fz.stats.iterations == 64 * 11
+    assert all(k & (k - 1) == 0 for k in wd.scales), wd.scales
+    assert wd.scales[:2] == [8, 2]
+
+
+# ---------------------------------------------------------------------------
+# kb-timeline generations report (satellite: occupancy artifact)
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_generations_report_device_bound():
+    from killerbeez_tpu.tools.timeline_tool import generations_report
+    spans = [
+        {"name": "in_flight", "t0": 0.0, "t1": 95.0,
+         "args": {"generations": 16, "batch": 0}},
+        {"name": "in_flight", "t0": 95.0, "t1": 200.0,
+         "args": {"generations": 16, "batch": 1}},
+        # host stages: a thin slice of the window
+        {"name": "triage", "t0": 96.0, "t1": 102.0, "args": {}},
+        {"name": "host_transfer", "t0": 95.0, "t1": 96.0, "args": {}},
+        # a host stage OUTSIDE the generation window must not count
+        {"name": "mutate", "t0": 300.0, "t1": 400.0, "args": {}},
+    ]
+    gr = generations_report(spans)
+    assert gr["dispatches"] == 2
+    assert gr["generations_total"] == 32
+    assert gr["generations_min"] == gr["generations_max"] == 16
+    assert gr["device_occupancy"] == pytest.approx(1.0)
+    assert gr["host_occupancy"] == pytest.approx(7.0 / 200.0)
+    assert gr["device_bound"] is True
+
+
+def test_timeline_generations_report_absent_without_mode():
+    from killerbeez_tpu.tools.timeline_tool import generations_report
+    spans = [{"name": "in_flight", "t0": 0, "t1": 1,
+              "args": {"batch": 0}}]
+    assert generations_report(spans) is None
+
+
+def test_trace_campaign_reports_device_bound(tmp_path):
+    """Acceptance artifact: a --generations campaign with --trace
+    yields a kb-timeline report whose critical path is the device
+    stage (host occupancy below the dispatch window)."""
+    from killerbeez_tpu.tools.timeline_tool import build_report
+    instr = instrumentation_factory("jit_harness",
+                                    '{"target": "cgc_like"}')
+    mut = mutator_factory("havoc", '{"seed": 11}', b"CG\x02\x04\x05Axx")
+    drv = driver_factory("file", None, instr, mut)
+    fz = Fuzzer(drv, output_dir=str(tmp_path / "o"), batch_size=256,
+                feedback=0, generations=8, trace=65536)
+    fz.run(8192)
+    doc = json.load(open(tmp_path / "o" / "trace.json"))
+    report = build_report(doc, None, None)
+    gr = report.get("generations")
+    assert gr and gr["dispatches"] >= 2
+    assert gr["generations_max"] <= 8
+    assert gr["device_bound"], (
+        "host stages on the critical path: "
+        f"device {gr['device_occupancy']:.1%} vs "
+        f"host {gr['host_occupancy']:.1%}")
+
+
+# ---------------------------------------------------------------------------
+# CLI: chaos kill mid-dispatch + --resume converges (PR 8 harness)
+# ---------------------------------------------------------------------------
+
+CLI_SEED = b"\x00" * 8
+
+
+def _cli_args(out, extra=()):
+    return ["file", "jit_harness", "havoc",
+            "-i", '{"target": "cgc_like"}',
+            "-m", '{"seed": 11}', "-fb", "0",
+            "-sf", "seed.bin", "-o", out, "-b", "256", "-n", "1024",
+            "--corpus-dir", os.path.join(out, "corpus"), *extra]
+
+
+def _run_cli(tmp_path, args, timeout=240):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO_ROOT +
+                os.pathsep + env.get("PYTHONPATH", "")})
+    (tmp_path / "seed.bin").write_bytes(CLI_SEED)
+    return subprocess.run(
+        [sys.executable, "-m", "killerbeez_tpu.fuzzer", *args],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def test_cli_generations_kill_mid_dispatch_resume_converges(tmp_path):
+    """SIGKILL while draining a G-generation dispatch, then --resume:
+    the campaign converges to the fault-free control's exact findings
+    set, and the host-driven loop with the same RNG seed agrees too
+    (the full ISSUE 9 determinism criterion, via the PR 8 chaos
+    harness)."""
+    r = _run_cli(tmp_path, _cli_args("ctl_host"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    r = _run_cli(tmp_path, _cli_args("ctl_gen", ["-G", "4"]))
+    assert r.returncode == 0, r.stderr[-2000:]
+    control = _findings(str(tmp_path / "ctl_host"))
+    assert any(control.values()), "control found nothing to compare"
+    # device-resident == host-driven, same seed
+    assert _findings(str(tmp_path / "ctl_gen")) == control
+
+    spec = json.dumps({"faults": [
+        {"point": "device_wait", "mode": "kill", "hit": 1}]})
+    r = _run_cli(tmp_path,
+                 _cli_args("out", ["-G", "4", "--chaos", spec]))
+    assert r.returncode == -signal.SIGKILL
+    r = _run_cli(tmp_path, _cli_args("out", ["-G", "4", "--resume"]))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert _findings(str(tmp_path / "out")) == control
+    # monotone event seq across the kill/resume boundary
+    seqs = [json.loads(l)["seq"]
+            for l in open(tmp_path / "out" / "events.jsonl")
+            if l.strip()]
+    assert seqs and all(b > a for a, b in zip(seqs, seqs[1:]))
+
+
+def test_cli_generations_stats_row_and_occupancy(tmp_path):
+    """kb-stats renders the genloop row from a real campaign's
+    stats snapshot (generations_per_dispatch + ring gauge)."""
+    from killerbeez_tpu.tools.stats_tui import render
+    r = _run_cli(tmp_path, _cli_args(
+        "out", ["-G", "4", "--stats-interval", "0.1"]))
+    assert r.returncode == 0, r.stderr[-2000:]
+    tail = [json.loads(l) for l in
+            open(tmp_path / "out" / "stats.jsonl") if l.strip()]
+    snap = tail[-1]
+    assert snap["gauges"].get("generations_per_dispatch") == 4
+    text = render(snap)
+    assert "generations/dispatch (device-resident)" in text

@@ -31,6 +31,11 @@ __version__ = "0.2.0"
 MAP_SIZE_POW2 = 16
 MAP_SIZE = 1 << MAP_SIZE_POW2  # AFL-compatible edge bitmap size (reference afl_progs/config.h:314-315)
 
+# the fuzzer CLI's -b/--batch-size default, shared with the
+# supervisor's mesh-degrade divisor check (a campaign that never
+# passed -b must still shrink dp against the batch it actually runs)
+DEFAULT_BATCH_SIZE = 1024
+
 # Fuzz verdicts (reference killerbeez-utils global_types.h, via SURVEY §2.11)
 FUZZ_NONE = 0
 FUZZ_HANG = 1

@@ -214,7 +214,10 @@ class Driver:
         instrumentation with the generation loop (jit_harness), a
         fused-capable mutator with no focus mask installed, and a
         single-chip batch quantum.  Re-checked per dispatch — the
-        same stand-down discipline the fused superbatch path uses."""
+        same stand-down discipline the fused superbatch path uses.
+        Mesh campaigns override BOTH methods with the sharded
+        generation scan (parallel/campaign.py), so the single-chip
+        quantum gate here never stands a --mesh campaign down."""
         instr = self.instrumentation
         supports = getattr(instr, "supports_generations", None)
         return (self.supports_batch and instr.device_backed

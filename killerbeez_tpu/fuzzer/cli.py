@@ -14,6 +14,7 @@ import os
 import sys
 from typing import List, Optional
 
+from .. import DEFAULT_BATCH_SIZE
 from ..drivers.factory import driver_factory, driver_help
 from ..instrumentation.factory import (
     instrumentation_factory, instrumentation_help,
@@ -128,7 +129,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="re-run each unique crash once under the "
                         "ptrace debug tier and save signal-level "
                         "details next to the repro (host targets)")
-    p.add_argument("-b", "--batch-size", type=int, default=1024,
+    p.add_argument("-b", "--batch-size", type=int,
+                   default=DEFAULT_BATCH_SIZE,
                    help="candidates per device step (batched backends)")
     p.add_argument("--trace", type=int, nargs="?", const=65536,
                    default=0, metavar="MAX_SPANS",
@@ -198,10 +200,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "when bare) against a device-resident virgin "
                         "map and seed-slot ring; the host only drains "
                         "the bounded findings ring + admission ledger."
-                        "  Auto-stands-down (warning) when --crack / "
-                        "focus masks / --mesh / a non-fused mutator "
-                        "is active; with -fb 0 the candidate stream "
-                        "is bit-identical to the host-driven loop "
+                        "  With --mesh the scan shards over dp with "
+                        "in-scan ICI virgin-map folds (per-shard "
+                        "rings + ledgers, gen_fold_every).  Auto-"
+                        "stands-down (warning) when --crack / focus "
+                        "masks / a non-fused mutator is active; with "
+                        "-fb 0 the candidate stream is bit-identical "
+                        "to the host-driven loop "
                         "(docs/GENERATIONS.md)")
     p.add_argument("-K", "--accumulate", type=int, default=0,
                    help="fused device path: accumulate K batches "
@@ -214,7 +219,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "over dp, coverage maps over mp, findings "
                         "land in -o exactly like single-chip; "
                         "requires jit_harness + havoc and -b "
-                        "divisible by dp")
+                        "divisible by dp; combine with -G for the "
+                        "mesh-resident generation scan")
     p.add_argument("--list", action="store_true",
                    help="list components and their options, then exit")
     return p

@@ -281,10 +281,11 @@ class Fuzzer:
         #: instead — the store write-through contract must hold in
         #: both regimes
         self._gen_reseed = True
-        #: host mirror of the device seed-slot ring (slot -> entry
-        #: md5): the admission-replay parent map, rebuilt dispatch by
-        #: dispatch from the device's ledger
-        self._ring_mirror: Dict[int, str] = {}
+        #: host mirror of the device seed-slot ring ((shard, slot) ->
+        #: entry md5; shard 0 on single-chip): the admission-replay
+        #: parent map, rebuilt dispatch by dispatch from the device's
+        #: per-shard ledgers
+        self._ring_mirror: Dict[tuple, str] = {}
         # the arm whose candidates the batch being TRIAGED came from:
         # with a deep pipeline, triage lags generation, so finds must
         # credit the GENERATING arm (entry object, robust to corpus
@@ -1265,9 +1266,18 @@ class Fuzzer:
         transfer in this mode), replay each interesting lane through
         the verdict/record triage stages, and replay the device's
         ring-admission decisions through the admission stage — in
-        (generation, lane) order, exactly the order host-driven
-        triage would have seen them.  Ring overflow is counted
-        (``findings_ring_drops``) and warned, never silent.
+        (generation, shard, lane) order, exactly the order host-driven
+        triage would have seen them (shards iterate in dp order per
+        generation, the global-lane order of the mesh loop's batch
+        triage).  Ring overflow is counted (``findings_ring_drops``)
+        and warned, never silent.
+
+        Mesh dispatches arrive as a MeshGenerationOutcome: one
+        findings ring + ledger PER dp shard, replayed through
+        per-shard ``shard(d)`` views with (shard, slot)-keyed lineage
+        mirrors — the replay is deterministic in shard order, so the
+        findings/store/arms sets are independent of drain
+        interleaving.
 
         With reseeding OFF the device made no admission decisions
         (the ledger is empty), so edge-novel ring lanes admit through
@@ -1292,8 +1302,16 @@ class Fuzzer:
             chaos_point("device_wait")
             h = out.materialize()
         reg = self.telemetry.registry
-        stored = min(int(h.fr_ptr), int(h.cap))
-        drops = int(h.fr_ptr) - stored
+        # mesh outcomes carry a leading dp axis on every field EVEN
+        # at dp=1, so the discriminator is the shard() view, never
+        # the shard count
+        shard_view = getattr(h, "shard", None)
+        n_shards = int(getattr(h, "n_shards", 1) or 1)
+        views = [shard_view(d) for d in range(n_shards)] \
+            if shard_view is not None else [h]
+        stored = [min(int(s.fr_ptr), int(s.cap)) for s in views]
+        drops = sum(int(s.fr_ptr) - st
+                    for s, st in zip(views, stored))
         if drops > 0:
             reg.count("findings_ring_drops", drops)
             WARNING_MSG(
@@ -1301,8 +1319,8 @@ class Fuzzer:
                 "interesting lanes dropped this dispatch (finding "
                 "files/events under-report them; counters track the "
                 "loss; raise jit_harness gen_findings_cap)", drops)
-        statuses, new_paths, ucs, uhs = unpack_verdicts(
-            h.fr_pack[:stored])
+        verdicts = [unpack_verdicts(s.fr_pack[:st])
+                    for s, st in zip(views, stored)]
         replay_adm = bool(self.feedback or self.store is not None)
         # reseeding off => the device ledger is empty by construction:
         # edge-novel ring lanes go through host-side admission, same
@@ -1310,42 +1328,51 @@ class Fuzzer:
         # replay below owns admission and ring lanes must not)
         admit_ring = not self._gen_reseed
         self._credit_arm = None
+
+        def replay_lane(d, ei):
+            s = views[d]
+            statuses, new_paths, ucs, uhs = verdicts[d]
+            buf = s.fr_bufs[ei, :int(s.fr_len[ei])].tobytes()
+            self._triage_lane(
+                int(statuses[ei]), int(new_paths[ei]), buf,
+                bool(ucs[ei]), bool(uhs[ei]), admit=admit_ring)
+
         with timer("triage"):
-            ei = 0
-            adm_cap = h.adm_valid.shape[1]
+            ei = [0] * len(views)
             for j in range(int(h.g)):
                 gid = int(h.gen0) + j
-                # this generation's interesting lanes first (the ring
-                # is (gen, lane)-ordered), then its admissions
-                while ei < stored and int(h.fr_gen[ei]) <= gid:
-                    buf = h.fr_bufs[ei, :int(h.fr_len[ei])].tobytes()
-                    self._triage_lane(
-                        int(statuses[ei]), int(new_paths[ei]), buf,
-                        bool(ucs[ei]), bool(uhs[ei]),
-                        admit=admit_ring)
-                    ei += 1
-                if not replay_adm or not int(h.adm_raw[j]):
-                    continue
-                parent = self._ring_mirror.get(int(h.sel[j]), "base")
-                for a in range(adm_cap):
-                    if not int(h.adm_valid[j, a]):
+                for d, s in enumerate(views):
+                    # this generation's interesting lanes first (each
+                    # ring is (gen, lane)-ordered), then the shard's
+                    # admissions
+                    while ei[d] < stored[d] and \
+                            int(s.fr_gen[ei[d]]) <= gid:
+                        replay_lane(d, ei[d])
+                        ei[d] += 1
+                    if not replay_adm or not int(s.adm_raw[j]):
                         continue
-                    buf = h.adm_bufs[j, a,
-                                     :int(h.adm_len[j, a])].tobytes()
-                    digest = md5_hex(buf)
-                    self._admit_arm(buf, digest, parent=parent)
-                    self._ring_mirror[int(h.adm_slot[j, a])] = digest
-                    self.telemetry.event(
-                        "ring_admit", md5=digest,
-                        slot=int(h.adm_slot[j, a]), gen=gid,
-                        parent=parent)
-            while ei < stored:      # defensive: trailing entries
-                buf = h.fr_bufs[ei, :int(h.fr_len[ei])].tobytes()
-                self._triage_lane(
-                    int(statuses[ei]), int(new_paths[ei]), buf,
-                    bool(ucs[ei]), bool(uhs[ei]), admit=admit_ring)
-                ei += 1
-        reg.gauge("gen_ring_filled", int(h.ring_filled.sum()))
+                    adm_cap = s.adm_valid.shape[1]
+                    parent = self._ring_mirror.get(
+                        (d, int(s.sel[j])), "base")
+                    for a in range(adm_cap):
+                        if not int(s.adm_valid[j, a]):
+                            continue
+                        buf = s.adm_bufs[
+                            j, a, :int(s.adm_len[j, a])].tobytes()
+                        digest = md5_hex(buf)
+                        self._admit_arm(buf, digest, parent=parent)
+                        self._ring_mirror[
+                            (d, int(s.adm_slot[j, a]))] = digest
+                        self.telemetry.event(
+                            "ring_admit", md5=digest,
+                            slot=int(s.adm_slot[j, a]), gen=gid,
+                            shard=d, parent=parent)
+            for d, s in enumerate(views):
+                while ei[d] < stored[d]:    # defensive: trailing rows
+                    replay_lane(d, ei[d])
+                    ei[d] += 1
+        reg.gauge("gen_ring_filled",
+                  sum(int(s.ring_filled.sum()) for s in views))
         DEBUG_MSG("generations dispatch done: %d iterations total",
                   done_through)
 
@@ -1367,6 +1394,10 @@ class Fuzzer:
         reseed = bool(self.feedback)
         self._gen_reseed = reseed
         reg = self.telemetry.registry
+        # mesh campaigns execute whole mesh batches per generation;
+        # a tail smaller than the quantum stops the run with the same
+        # warning discipline as the host-driven mesh loop
+        quantum = getattr(drv, "batch_quantum", 1)
         stood_down = self.cracker is not None \
             or not drv.supports_batch_generations()
         pending: "deque" = deque()
@@ -1378,6 +1409,13 @@ class Fuzzer:
                                mut.remaining(),
                                g_max * self.batch_size)
                     if room <= 0:
+                        break
+                    if room < quantum:
+                        WARNING_MSG(
+                            "stopping %d iterations early: the mesh "
+                            "executes whole %d-lane batches (-n "
+                            "should be a multiple of -b)", room,
+                            quantum)
                         break
                     if not drv.supports_batch_generations():
                         stood_down = True   # mid-run state change
@@ -1447,7 +1485,8 @@ class Fuzzer:
                           "the driver/mutator cannot run the device "
                           "generation loop (needs jit_harness + a "
                           "fused-capable mutator, no focus mask, no "
-                          "edges mode, single-chip)")
+                          "edges mode; --mesh campaigns run the "
+                          "sharded generation scan)")
                 WARNING_MSG("--generations stood down: %s — running "
                             "the host-driven loop", reason)
             self._run_batched(n_iterations)

@@ -233,7 +233,8 @@ class JitHarnessInstrumentation(Instrumentation):
     OPTION_SCHEMA = {"target": str, "program_file": str, "max_steps": int,
                      "novelty": str, "edges": int, "engine": str,
                      "phase1_steps": int, "gen_ring_slots": int,
-                     "gen_findings_cap": int, "gen_admits": int}
+                     "gen_findings_cap": int, "gen_admits": int,
+                     "gen_fold_every": int}
     OPTION_DESCS = {
         "target": "built-in KBVM target name (test/hang/libtest/cgc_like)",
         "program_file": "path to a .npz compiled KBVM program",
@@ -262,10 +263,20 @@ class JitHarnessInstrumentation(Instrumentation):
                             "stays well below the batch shape)",
         "gen_admits": "--generations: max ring admissions per "
                       "generation, lane order (default 8)",
+        "gen_fold_every": "--generations on --mesh: AND-fold virgin "
+                          "maps across dp every E generations INSIDE "
+                          "the scan (ICI collectives, no host "
+                          "round-trip).  0 = auto: once per dispatch "
+                          "with reseeding on (cheapest), every "
+                          "generation with reseeding off (-fb 0, the "
+                          "host-mesh parity cadence).  Between folds "
+                          "shards may re-find each other's paths — "
+                          "over-report, never under-report",
     }
     DEFAULTS = {"novelty": "exact", "edges": 0, "engine": "xla",
                 "phase1_steps": -1, "gen_ring_slots": 32,
-                "gen_findings_cap": 0, "gen_admits": 8}
+                "gen_findings_cap": 0, "gen_admits": 8,
+                "gen_fold_every": 0}
 
     def __init__(self, options: Optional[str] = None):
         super().__init__(options)
@@ -521,7 +532,7 @@ class JitHarnessInstrumentation(Instrumentation):
         slot 0 (the base seed) — the candidate stream is then
         bit-identical to the host-driven loop's."""
         from ..ops.generations import (
-            DEFAULT_FINDINGS_CAP, GenerationOutcome, run_generations,
+            GenerationOutcome, gen_ring_caps, run_generations,
         )
         from ..ops.vm_kernel import LANE_TILE
         n = len(its)
@@ -536,19 +547,12 @@ class JitHarnessInstrumentation(Instrumentation):
             its = np.concatenate([its, np.repeat(its[:1], b - n)])
         salt = int(getattr(mutator, "options", {}).get("seed", 0)) \
             & 0xFFFFFFFF
-        adm_cap = min(max(int(self.options["gen_admits"]), 1),
-                      self._gen_ring_key[1] - 1)
-        # findings-ring rows: every generation pays a nonzero +
-        # gather + scatter of width min(cap, batch) to append into
-        # the ring, so the auto default stays WELL below the batch
-        # shape — measured on CPU at -b 2048/G=8, cap 256 runs 1.25x
-        # the host loop while cap >= 1024 loses the whole win to the
-        # append machinery.  Steady-state interesting lanes are rare
-        # (that's the premise of the mode); overflow is counted and
-        # warned, and explicit gen_findings_cap values are honored
-        cap = int(self.options["gen_findings_cap"])
-        if cap <= 0:
-            cap = min(DEFAULT_FINDINGS_CAP, max(b // 8, 256))
+        # ring sizing shared with the mesh path (the measured-knee
+        # auto cap rationale lives on gen_ring_caps)
+        adm_cap, cap = gen_ring_caps(
+            self.options["gen_admits"],
+            self.options["gen_findings_cap"], b,
+            self._gen_ring_key[1])
         (vb, vc, vh), ring, rep = run_generations(
             self._instrs, self._edge_table, self._u_slots,
             self._seg_id, *self._gen_ring, base_key,

@@ -74,6 +74,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     fl.add_argument("--crash-spike-window", type=float, default=60.0,
                     help="crash_spike trailing window seconds "
                          "(default 60)")
+    fl.add_argument("--drops-window", type=float, default=120.0,
+                    help="findings_drop alert: active while the "
+                         "fleet's findings_ring_drops counter moved "
+                         "within this many seconds (--generations "
+                         "ring overflow under-reports findings; "
+                         "default 120)")
     fl.add_argument("--retire-after", type=float, default=86400.0,
                     help="seconds after a worker's last heartbeat "
                          "before its registry row + snapshot retire "
@@ -92,6 +98,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         stall_after=args.stall_after,
         crash_spike_count=args.crash_spike_count,
         crash_spike_window=args.crash_spike_window,
+        drops_window=args.drops_window,
         retire_after=args.retire_after)
     server = ManagerServer(args.host, args.port, args.db, fleet=fleet)
     if args.seed:

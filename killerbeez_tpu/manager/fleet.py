@@ -63,6 +63,11 @@ class FleetConfig:
     #: crash_spike: >= this many new unique crashes inside the window
     crash_spike_count: int = 10
     crash_spike_window: float = 60.0
+    #: findings_drop: the fleet's findings_ring_drops counter moved
+    #: within this many seconds — --generations findings rings are
+    #: overflowing and finding files/events under-report (raise
+    #: gen_findings_cap); clears once drops stop for a full window
+    drops_window: float = 120.0
     #: seconds after a worker's last heartbeat before its registry
     #: row (and heartbeat snapshot) is retired entirely — finished
     #: campaigns stop latching worker_death forever and /metrics
@@ -83,8 +88,8 @@ def classify(age: float, cfg: FleetConfig) -> str:
 #
 # A rule sees the campaign view:
 #   {"now", "statuses": {worker: status}, "counters": merged counters,
-#    "paths_changed_t", "execs_changed_t", "crash_window": deque of
-#    (t, unique_crashes), "started": bool}
+#    "paths_changed_t", "execs_changed_t", "drops_changed_t",
+#    "crash_window": deque of (t, unique_crashes), "started": bool}
 
 
 def _rule_worker_death(view: Dict[str, Any], cfg: FleetConfig
@@ -128,12 +133,31 @@ def _rule_coverage_stall(view: Dict[str, Any], cfg: FleetConfig
             round(now - view["paths_changed_t"], 1)}
 
 
+def _rule_findings_drop(view: Dict[str, Any], cfg: FleetConfig
+                        ) -> Tuple[bool, Dict[str, Any]]:
+    """``findings_ring_drops`` advanced within the window: some
+    worker's --generations findings ring is overflowing, so finding
+    files and events UNDER-REPORT what the campaign is discovering
+    (the counter is the only honest record).  Fires on recency, not
+    on the lifetime total — a long-finished overflow must not alarm
+    forever — and clears after ``drops_window`` quiet seconds."""
+    drops = int(view["counters"].get("findings_ring_drops", 0))
+    if drops <= 0:
+        return False, {}
+    recent = view["now"] - view["drops_changed_t"] < cfg.drops_window
+    return recent, {"findings_ring_drops_total": drops,
+                    "seconds_since_last_drop":
+                        round(view["now"] - view["drops_changed_t"],
+                              1)}
+
+
 #: declarative rule table: name -> predicate
 ALERT_RULES: Tuple[Tuple[str, Callable], ...] = (
     ("worker_death", _rule_worker_death),
     ("fleet_plateau", _rule_fleet_plateau),
     ("crash_spike", _rule_crash_spike),
     ("coverage_stall", _rule_coverage_stall),
+    ("findings_drop", _rule_findings_drop),
 )
 
 
@@ -237,6 +261,9 @@ class FleetMonitor(threading.Thread):
             st = self._state[campaign] = {
                 "paths": -1, "paths_changed_t": now,
                 "execs": -1, "execs_changed_t": now,
+                # drops recency starts "long quiet": a restart must
+                # not re-fire findings_drop on a stale lifetime total
+                "drops": -1, "drops_changed_t": float("-inf"),
                 "crash_window": deque(),
                 "last_series_t": 0.0,
                 "alerts": {name: {"active": False, "since": None,
@@ -265,6 +292,17 @@ class FleetMonitor(threading.Thread):
         if execs != st["execs"]:
             st["execs_changed_t"] = now
             st["execs"] = execs
+        drops = int(counters.get("findings_ring_drops", 0))
+        if drops != st["drops"]:
+            # the FIRST observation only baselines (a manager restart
+            # must not re-alarm on a lifetime total whose drops may
+            # have stopped hours ago), and only an INCREASE counts as
+            # movement — the merged total of a monotone counter can
+            # shrink when a worker restarts or retires, which is not
+            # a new drop
+            if st["drops"] >= 0 and drops > st["drops"]:
+                st["drops_changed_t"] = now
+            st["drops"] = drops
         win = st["crash_window"]
         win.append((now, int(counters.get("unique_crashes", 0))))
         while win and win[0][0] < now - cfg.crash_spike_window:
@@ -274,6 +312,7 @@ class FleetMonitor(threading.Thread):
                 "counters": counters, "paths": st["paths"],
                 "paths_changed_t": st["paths_changed_t"],
                 "execs_changed_t": st["execs_changed_t"],
+                "drops_changed_t": st["drops_changed_t"],
                 "crash_window": win, "started": execs > 0}
         for name, rule in ALERT_RULES:
             active, details = rule(view, cfg)
@@ -354,6 +393,7 @@ def worker_stats_summary(snap: Dict[str, Any]) -> Dict[str, Any]:
         "unique_hangs": int(c.get("unique_hangs", 0)),
         "corpus_seen": int(g.get("corpus_seen",
                                  g.get("corpus_size", 0))),
+        "findings_ring_drops": int(c.get("findings_ring_drops", 0)),
         "execs_per_sec": float(d.get("execs_per_sec", 0.0)),
         "execs_per_sec_ema": float(d.get("execs_per_sec_ema", 0.0)),
     }

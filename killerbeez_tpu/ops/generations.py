@@ -50,7 +50,6 @@ intentionally different seeds than the host bandit would pick.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, NamedTuple
 
 import jax
@@ -66,6 +65,44 @@ DEFAULT_RING_SLOTS = 32
 DEFAULT_FINDINGS_CAP = 16384
 #: default max ring admissions per generation (lane order)
 DEFAULT_ADM_CAP = 8
+
+
+def gen_ring_caps(gen_admits: int, gen_findings_cap: int,
+                  batch: int, slots: int) -> tuple:
+    """Shared --generations ring sizing for the single-chip dispatch
+    (jit_harness) AND the per-shard mesh dispatch (parallel/campaign,
+    against the per-chip batch): clamp the per-generation admission
+    cap to the ring's S-1 distinct admission slots, and auto-size the
+    findings ring when no explicit cap is set.  Returns
+    ``(adm_cap, findings_cap)``.
+
+    Auto-cap rationale: every generation pays a nonzero + gather +
+    scatter of width min(cap, batch) to append into the findings
+    ring, so the default stays WELL below the batch shape — measured
+    on CPU at -b 2048/G=8, cap 256 runs 1.25x the host loop while
+    cap >= 1024 loses the whole win to the append machinery.
+    Steady-state interesting lanes are rare (that's the premise of
+    the mode); overflow is counted and warned, and explicit
+    gen_findings_cap values are honored."""
+    adm_cap = min(max(int(gen_admits), 1), int(slots) - 1)
+    cap = int(gen_findings_cap)
+    if cap <= 0:
+        cap = min(DEFAULT_FINDINGS_CAP, max(int(batch) // 8, 256))
+    return adm_cap, cap
+
+
+def carry_donation_argnums(backend: str, argnums) -> tuple:
+    """Buffer-donation policy for the generation-scan carry state:
+    the ring + virgin buffers update in place instead of being copied
+    every dispatch.  Never donate arrays the outcome report exports
+    (``ring_filled``) — the loop's double buffer reads the report
+    AFTER the next dispatch has consumed the carry.  CPU backends
+    don't implement donation (jax warns per call), so the policy is
+    empty there — the tier-1/CI surface stays quiet and the TPU path
+    gets the in-place update."""
+    if backend == "cpu":
+        return ()
+    return tuple(argnums)
 
 
 class GenerationOutcome(NamedTuple):
@@ -109,6 +146,60 @@ class GenerationOutcome(NamedTuple):
             for f, v in self._asdict().items()})
 
 
+class MeshGenerationOutcome(NamedTuple):
+    """One mesh dispatch's host-facing report: the per-dp-shard twin
+    of ``GenerationOutcome``.  Every ring/ledger field carries a
+    leading ``dp`` axis (shard d's findings ring, seed-slot ring and
+    admission ledger are independent device state); the loop drains
+    shards deterministically in shard order via ``shard(d)`` views so
+    store/arms/events stay in the host-loop contract regardless of
+    drain interleaving."""
+    fr_pack: Any      # uint8[dp, F]
+    fr_gen: Any       # int32[dp, F]
+    fr_iter: Any      # uint32[dp, F]
+    fr_len: Any       # int32[dp, F]
+    fr_bufs: Any      # uint8[dp, F, L]
+    fr_ptr: Any       # int32[dp]
+    sel: Any          # int32[dp, G]
+    adm_raw: Any      # int32[dp, G]
+    adm_valid: Any    # int32[dp, G, A]
+    adm_slot: Any     # int32[dp, G, A]
+    adm_iter: Any     # uint32[dp, G, A]
+    adm_len: Any      # int32[dp, G, A]
+    adm_bufs: Any     # uint8[dp, G, A, L]
+    ring_filled: Any  # int32[dp, S]
+    gen0: int = 0
+    g: int = 0
+    n_real: int = 0   # GLOBAL lanes per generation (dp x per-chip)
+    cap: int = 0      # findings-ring capacity F PER SHARD
+    n_shards: int = 1
+
+    def prefetch(self) -> None:
+        for a in self:
+            fn = getattr(a, "copy_to_host_async", None)
+            if fn is not None:
+                fn()
+
+    def materialize(self) -> "MeshGenerationOutcome":
+        return self._replace(**{
+            f: (np.asarray(v) if hasattr(v, "shape") else v)
+            for f, v in self._asdict().items()})
+
+    def shard(self, d: int) -> GenerationOutcome:
+        """Shard ``d``'s view as a single-chip-shaped outcome (call
+        after ``materialize()``)."""
+        return GenerationOutcome(
+            fr_pack=self.fr_pack[d], fr_gen=self.fr_gen[d],
+            fr_iter=self.fr_iter[d], fr_len=self.fr_len[d],
+            fr_bufs=self.fr_bufs[d], fr_ptr=self.fr_ptr[d],
+            sel=self.sel[d], adm_raw=self.adm_raw[d],
+            adm_valid=self.adm_valid[d], adm_slot=self.adm_slot[d],
+            adm_iter=self.adm_iter[d], adm_len=self.adm_len[d],
+            adm_bufs=self.adm_bufs[d], ring_filled=self.ring_filled[d],
+            gen0=self.gen0, g=self.g,
+            n_real=self.n_real // max(self.n_shards, 1), cap=self.cap)
+
+
 def _select_slot(ring_filled, gen_id, salt):
     """Deterministic seed-slot pick for one generation: a _mix32 draw
     over the FILLED slots (slot 0 is always filled).  Pure uint32
@@ -136,12 +227,87 @@ def np_select_slot(filled: np.ndarray, gen_id: int, salt: int) -> int:
     return int(np.argmax(np.cumsum(filled) > k))
 
 
-@partial(jax.jit,
-         static_argnames=("mem_size", "max_steps", "n_edges", "exact",
-                          "stack_pow2", "g", "engine", "phase1_steps",
-                          "dots", "reseed", "adm_cap", "findings_cap",
-                          "interpret"))
-def run_generations(instrs, edge_table, u_slots, seg_id,
+def _ring_append_and_admit(flags, aflags, packed, its, bufs, lens,
+                           gen_id, sel, ring, fr, adm_cap, reseed):
+    """One generation's findings-ring append + FIFO seed-slot
+    admission + admission-ledger emission, shared by BOTH generation
+    scans (the single-chip ``lax.scan`` here and the shard_map'd mesh
+    scan in ``parallel/distributed.py``, which runs it per dp shard).
+    Host replay (``fuzzer/loop.py``) and the parity suites pin the
+    semantics: the findings pointer COUNTS overflow (rows past the
+    ring capacity drop, never silently), admissions are FIFO into
+    slots 1..S-1, and ledger rows past the admission count are masked
+    to zero.
+
+    ``ring`` / ``fr`` are the carried ``(bufs, lens, filled, hits,
+    finds, ptr)`` / ``(pack, gen, iter, len, bufs, ptr)`` tuples;
+    ``flags`` marks the interesting lanes, ``aflags`` the ring-
+    admissible ones (both already masked to real lanes by the
+    caller).  Returns ``(ring', fr', araw, ledger)``."""
+    ring_bufs, ring_lens, ring_filled, ring_hits, ring_finds, \
+        ring_ptr = ring
+    fr_pack, fr_gen, fr_iter, fr_len, fr_bufs, fr_ptr = fr
+    F = fr_pack.shape[0]
+    S, L = ring_bufs.shape
+    A = int(adm_cap)
+    cap_g = min(F, flags.shape[0])
+
+    # findings ring: interesting lanes append in lane order at the
+    # carried write pointer; rows past F drop (mode="drop") but the
+    # pointer keeps counting so overflow is never silent
+    raw = jnp.sum(flags).astype(jnp.int32)
+    (idx,) = jnp.nonzero(flags, size=cap_g, fill_value=0)
+    pos = fr_ptr + jnp.arange(cap_g, dtype=jnp.int32)
+    valid = (jnp.arange(cap_g) < jnp.minimum(raw, cap_g)) & (pos < F)
+    tgt = jnp.where(valid, pos, F)
+    fr_pack = fr_pack.at[tgt].set(packed[idx], mode="drop")
+    fr_gen = fr_gen.at[tgt].set(gen_id.astype(jnp.int32),
+                                mode="drop")
+    fr_iter = fr_iter.at[tgt].set(its[idx], mode="drop")
+    fr_len = fr_len.at[tgt].set(lens[idx].astype(jnp.int32),
+                                mode="drop")
+    fr_bufs = fr_bufs.at[tgt].set(bufs[idx].astype(jnp.uint8),
+                                  mode="drop")
+    fr_ptr = fr_ptr + raw
+
+    # per-slot stats for the GENERATING slot (before any admission
+    # overwrites it)
+    araw = jnp.sum(aflags).astype(jnp.int32)
+    ring_hits = ring_hits.at[sel].add(1)
+    ring_finds = ring_finds.at[sel].add(araw)
+
+    if reseed:
+        # FIFO admission of the first A edge-novel lanes into slots
+        # 1..S-1; slots are distinct (A <= S-1)
+        (aidx,) = jnp.nonzero(aflags, size=A, fill_value=0)
+        n_adm = jnp.minimum(araw, A)
+        avalid = jnp.arange(A) < n_adm
+        slots = 1 + (ring_ptr + jnp.arange(A, dtype=jnp.int32)) \
+            % (S - 1)
+        tgt_s = jnp.where(avalid, slots, S)
+        ring_bufs = ring_bufs.at[tgt_s].set(
+            bufs[aidx].astype(jnp.uint8), mode="drop")
+        ring_lens = ring_lens.at[tgt_s].set(
+            lens[aidx].astype(jnp.int32), mode="drop")
+        ring_filled = ring_filled.at[tgt_s].set(1, mode="drop")
+        ring_hits = ring_hits.at[tgt_s].set(0, mode="drop")
+        ring_finds = ring_finds.at[tgt_s].set(0, mode="drop")
+        ring_ptr = ring_ptr + n_adm
+        ledger = (avalid.astype(jnp.int32), slots * avalid,
+                  its[aidx] * avalid.astype(jnp.uint32),
+                  lens[aidx].astype(jnp.int32) * avalid,
+                  bufs[aidx].astype(jnp.uint8))
+    else:
+        zA = jnp.zeros((A,), jnp.int32)
+        ledger = (zA, zA, zA.astype(jnp.uint32), zA,
+                  jnp.zeros((A, L), jnp.uint8))
+    return ((ring_bufs, ring_lens, ring_filled, ring_hits,
+             ring_finds, ring_ptr),
+            (fr_pack, fr_gen, fr_iter, fr_len, fr_bufs, fr_ptr),
+            araw, ledger)
+
+
+def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
                     ring_bufs, ring_lens, ring_filled, ring_hits,
                     ring_finds, ring_ptr,
                     base_key, its0, n_real, gen0, salt,
@@ -171,10 +337,8 @@ def run_generations(instrs, edge_table, u_slots, seg_id,
 
     b = its0.shape[0]
     L = ring_bufs.shape[1]
-    S = ring_bufs.shape[0]
     F = int(findings_cap)
     A = int(adm_cap) if reseed else 1   # ledger shape floor
-    cap_g = min(F, b)
     lanes_real = jnp.arange(b) < n_real
 
     def one_generation(carry, j):
@@ -215,59 +379,18 @@ def run_generations(instrs, edge_table, u_slots, seg_id,
             res.counts, statuses, u_slots, seg_id, vb, vc, vh, exact)
         packed = pack_verdicts(statuses, new_paths, uc, uh)
 
-        # findings ring: interesting lanes append in lane order at
-        # the carried write pointer; rows past F drop (mode="drop")
-        # but the pointer keeps counting so overflow is never silent
         flags = ((statuses != FUZZ_NONE) | (new_paths > 0)) \
             & lanes_real
-        raw = jnp.sum(flags).astype(jnp.int32)
-        (idx,) = jnp.nonzero(flags, size=cap_g, fill_value=0)
-        pos = fr_ptr + jnp.arange(cap_g, dtype=jnp.int32)
-        valid = (jnp.arange(cap_g) < jnp.minimum(raw, cap_g)) \
-            & (pos < F)
-        tgt = jnp.where(valid, pos, F)
-        fr_pack = fr_pack.at[tgt].set(packed[idx], mode="drop")
-        fr_gen = fr_gen.at[tgt].set(gen_id.astype(jnp.int32),
-                                    mode="drop")
-        fr_iter = fr_iter.at[tgt].set(its[idx], mode="drop")
-        fr_len = fr_len.at[tgt].set(lens[idx].astype(jnp.int32),
-                                    mode="drop")
-        fr_bufs = fr_bufs.at[tgt].set(bufs[idx].astype(jnp.uint8),
-                                      mode="drop")
-        fr_ptr = fr_ptr + raw
-
-        # per-slot stats for the GENERATING slot (before any
-        # admission overwrites it)
         aflags = (new_paths == 2) & lanes_real
-        araw = jnp.sum(aflags).astype(jnp.int32)
-        ring_hits = ring_hits.at[sel].add(1)
-        ring_finds = ring_finds.at[sel].add(araw)
-
-        if reseed:
-            # FIFO admission of the first adm_cap edge-novel lanes
-            # into slots 1..S-1; slots are distinct (adm_cap <= S-1)
-            (aidx,) = jnp.nonzero(aflags, size=A, fill_value=0)
-            n_adm = jnp.minimum(araw, A)
-            avalid = jnp.arange(A) < n_adm
-            slots = 1 + (ring_ptr + jnp.arange(A, dtype=jnp.int32)) \
-                % (S - 1)
-            tgt_s = jnp.where(avalid, slots, S)
-            ring_bufs = ring_bufs.at[tgt_s].set(
-                bufs[aidx].astype(jnp.uint8), mode="drop")
-            ring_lens = ring_lens.at[tgt_s].set(
-                lens[aidx].astype(jnp.int32), mode="drop")
-            ring_filled = ring_filled.at[tgt_s].set(1, mode="drop")
-            ring_hits = ring_hits.at[tgt_s].set(0, mode="drop")
-            ring_finds = ring_finds.at[tgt_s].set(0, mode="drop")
-            ring_ptr = ring_ptr + n_adm
-            ledger = (avalid.astype(jnp.int32), slots * avalid,
-                      its[aidx] * avalid.astype(jnp.uint32),
-                      lens[aidx].astype(jnp.int32) * avalid,
-                      bufs[aidx].astype(jnp.uint8))
-        else:
-            zA = jnp.zeros((A,), jnp.int32)
-            ledger = (zA, zA, zA.astype(jnp.uint32), zA,
-                      jnp.zeros((A, L), jnp.uint8))
+        ((ring_bufs, ring_lens, ring_filled, ring_hits, ring_finds,
+          ring_ptr),
+         (fr_pack, fr_gen, fr_iter, fr_len, fr_bufs, fr_ptr),
+         araw, ledger) = _ring_append_and_admit(
+            flags, aflags, packed, its, bufs, lens, gen_id, sel,
+            (ring_bufs, ring_lens, ring_filled, ring_hits,
+             ring_finds, ring_ptr),
+            (fr_pack, fr_gen, fr_iter, fr_len, fr_bufs, fr_ptr),
+            A, reseed)
 
         carry = (vb, vc, vh, ring_bufs, ring_lens, ring_filled,
                  ring_hits, ring_finds, ring_ptr, fr_pack, fr_gen,
@@ -296,3 +419,31 @@ def run_generations(instrs, edge_table, u_slots, seg_id,
             (fr_pack, fr_gen, fr_iter, fr_len, fr_bufs, fr_ptr,
              sel, adm_raw, adm_valid, adm_slot, adm_iter, adm_len,
              adm_bufs, ring_filled))
+
+
+#: positional args of _run_generations_impl that are pure carry state
+#: (consumed each dispatch, safe to update in place): ring_bufs(4),
+#: ring_lens(5), ring_hits(7), ring_finds(8), vb(15), vc(16), vh(17).
+#: ring_filled(6)/ring_ptr(9) are exported in the outcome report and
+#: must survive the next dispatch — never donated.
+_CARRY_ARGNUMS = (4, 5, 7, 8, 15, 16, 17)
+
+_RUN_GENERATIONS_JIT = None
+
+
+def run_generations(*args, **kwargs):
+    """Jitted entry point for the single-chip generation scan, built
+    lazily so the donation policy can consult the active backend (see
+    ``carry_donation_argnums``: donated carry on accelerators, plain
+    copies on CPU)."""
+    global _RUN_GENERATIONS_JIT
+    if _RUN_GENERATIONS_JIT is None:
+        _RUN_GENERATIONS_JIT = jax.jit(
+            _run_generations_impl,
+            static_argnames=("mem_size", "max_steps", "n_edges",
+                             "exact", "stack_pow2", "g", "engine",
+                             "phase1_steps", "dots", "reseed",
+                             "adm_cap", "findings_cap", "interpret"),
+            donate_argnums=carry_donation_argnums(
+                jax.default_backend(), _CARRY_ARGNUMS))
+    return _RUN_GENERATIONS_JIT(*args, **kwargs)

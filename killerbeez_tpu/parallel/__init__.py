@@ -10,9 +10,12 @@ direct psum; De Morgan over a 64KB array is one cheap gather).
 
 from .campaign import ShardedCampaignDriver, parse_mesh_spec
 from .distributed import (
-    ShardedFuzzState, make_mesh, make_sharded_fuzz_step, sharded_state_init,
+    ShardedFuzzState, ShardedGenRing, make_mesh,
+    make_sharded_fuzz_step, make_sharded_generations,
+    sharded_gen_ring_init, sharded_state_init,
 )
 
 __all__ = ["make_mesh", "make_sharded_fuzz_step", "sharded_state_init",
-           "ShardedFuzzState", "ShardedCampaignDriver",
-           "parse_mesh_spec"]
+           "make_sharded_generations", "sharded_gen_ring_init",
+           "ShardedGenRing", "ShardedFuzzState",
+           "ShardedCampaignDriver", "parse_mesh_spec"]

@@ -28,11 +28,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..drivers.base import BatchOutcome, Driver
 from ..instrumentation.base import BatchResult, CompactReport
+from ..ops.generations import MeshGenerationOutcome, gen_ring_caps
 from ..telemetry import merge, merge_two
 from ..utils.logging import INFO_MSG
 from .distributed import (
     ShardedFuzzState, make_mesh, make_sharded_fuzz_step,
-    shard_stat_snapshots,
+    make_sharded_generations, shard_stat_snapshots,
+    sharded_gen_ring_init,
 )
 
 
@@ -111,6 +113,15 @@ class ShardedCampaignDriver(Driver):
         #: so per-epoch folds compose into the campaign total)
         self.fleet_stats: dict = {}
         self._host_step = 0   # mirrors state.step without device syncs
+        #: mesh-resident generation loop state (--generations on
+        #: --mesh): the dispatch builder + per-dp-shard seed rings,
+        #: built lazily on the first generations dispatch
+        self._gen_dispatch = None
+        self._gen_ring = None
+        self._gen_ring_key = None
+        self._gen_count = 0
+        self._gen_cap = 0
+        self._interpret = interpret
         INFO_MSG("sharded campaign: mesh dp=%d mp=%d, %d lanes/chip, "
                  "engine=%s", n_dp, n_mp, self.batch_per_device, engine)
 
@@ -221,6 +232,102 @@ class ShardedCampaignDriver(Driver):
         mut.advance(k * n)
         self._sync_after(bufs[k - 1], lens[k - 1], n, k * n)
         return packed, bufs, lens, compact
+
+    # -- mesh-resident generations (--generations on --mesh) ------------
+
+    def supports_batch_generations(self) -> bool:
+        """Mesh campaigns run the generation scan under shard_map
+        (distributed.make_sharded_generations): delegate to the
+        instrumentation's own gate (fused candidate spec, no
+        crack-stage focus mask, no edges mode) — the same conditions
+        the single-chip loop checks, minus the single-chip quantum,
+        and with no risk of drifting from them."""
+        supports = getattr(self.instrumentation,
+                           "supports_generations", None)
+        return supports is not None and supports(self.mutator)
+
+    def _ensure_gen_dispatch(self):
+        """(Re)build the mesh generation dispatch + per-shard rings.
+        Rebuilt when the candidate buffer width changes (a new base
+        seed shape would make stale ring slots unloadable)."""
+        mut = self.mutator
+        instr = self.instrumentation
+        seed_buf, seed_len, _key, stack_pow2 = mut.fused_spec()
+        L = int(mut.max_length)
+        slots = max(int(instr.options.get("gen_ring_slots", 32)), 2)
+        key = (L, slots)
+        if self._gen_ring is not None and self._gen_ring_key == key:
+            return
+        bpd = self.batch_per_device
+        # ring sizing PER SHARD, against the per-chip batch — shared
+        # with the single-chip path (gen_ring_caps has the measured
+        # auto-cap rationale)
+        adm_cap, cap = gen_ring_caps(
+            instr.options.get("gen_admits", 8),
+            instr.options.get("gen_findings_cap", 0), bpd, slots)
+        self._gen_cap = cap
+        salt = int(self.mutator.options.get("seed", 0)) & 0xFFFFFFFF
+        self._gen_dispatch = make_sharded_generations(
+            instr.program, self.mesh, bpd, max_len=L,
+            stack_pow2=int(stack_pow2),
+            engine=instr.engine, interpret=self._interpret,
+            seed=int(self.mutator.options.get("seed", 0)),
+            salt=salt, adm_cap=adm_cap, findings_cap=cap)
+        self._gen_ring = sharded_gen_ring_init(
+            self.mesh, seed_buf, int(seed_len), slots, L)
+        self._gen_ring_key = key
+
+    def test_batch_generations(self, n: int, g: int,
+                               pad_to: Optional[int] = None,
+                               reseed: bool = True):
+        """``g`` full mesh generations in one device dispatch: each
+        dp shard mutates from its own seed-slot ring, executes,
+        triages against the (periodically dp-folded) virgin maps and
+        reseeds on device; the host gets back one lazy
+        MeshGenerationOutcome (per-shard findings rings + admission
+        ledgers).  Generation j consumed counter ``it0 + j*n``; the
+        mutator advances by g*n."""
+        self._check_full_batch(n)
+        mut = self.mutator
+        self._ensure_gen_dispatch()
+        instr = self.instrumentation
+        its = mut.peek_iterations(n)
+        base_it = int(its[0])   # same 64-bit counter contract as
+        # test_batch; generation j inside the scan adds j*n on device
+        fold_every = int(instr.options.get("gen_fold_every", 0))
+        with self._span("execute"):     # the whole loop is in-kernel
+            self.state, self._gen_ring, rep = self._gen_dispatch(
+                self.state, self._gen_ring, base_it, self._gen_count,
+                int(g), reseed=bool(reseed), fold_every=fold_every)
+        out = MeshGenerationOutcome(
+            *rep, ring_filled=self._gen_ring.filled,
+            gen0=self._gen_count, g=int(g), n_real=n, cap=self._gen_cap,
+            n_shards=self.mesh.shape["dp"])
+        self._gen_count += int(g)
+        mut.advance(int(g) * n)
+        self._sync_after_generations(int(g), int(g) * n)
+        return out
+
+    def _sync_after_generations(self, g: int, execs: int) -> None:
+        """Generations-mode twin of _sync_after: expose the folded
+        maps through the instrumentation, fold the per-shard fleet
+        snapshots, and stamp per-shard generation instants on the
+        flight recorder (kb-timeline's per-shard occupancy rows).
+        Candidate tensors never leave the device in this mode, so
+        there is no last-input tail."""
+        self._sync_after(None, None, 0, execs)
+        self._last_batch_tail = None
+        self.last_input = None
+        timer = self.stage_timer
+        tr = getattr(timer, "tracer", None) if timer is not None \
+            else None
+        if tr is not None:
+            for i in range(self.mesh.shape["dp"]):
+                tr.instant(
+                    "shard_generations",
+                    lane=tr.lane_id(f"shard-{i}"),
+                    args={"shard": i, "generations": g,
+                          "step": self._host_step})
 
     def test_input(self, buf: bytes) -> int:
         """Single-input repro path: run through the instrumentation's

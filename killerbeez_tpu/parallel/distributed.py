@@ -47,9 +47,14 @@ except ImportError:                     # jax 0.4.x: experimental home,
                                out_specs=out_specs,
                                check_rep=check_vma)
 
-from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_RUNNING, MAP_SIZE
+from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_NONE, FUZZ_RUNNING, MAP_SIZE
 from ..instrumentation.base import pack_verdicts
+from ..ops.generations import (
+    DEFAULT_ADM_CAP, DEFAULT_FINDINGS_CAP, _ring_append_and_admit,
+    _select_slot, carry_donation_argnums,
+)
 from ..models.vm import Program, _run_batch_impl
+from ..utils.logging import WARNING_MSG
 from ..ops.coverage import classify_counts, simplify_trace
 from ..ops.mutate_core import havoc_at
 from ..ops.sparse_coverage import (
@@ -145,126 +150,149 @@ def _gather_and_fold(v_local, axis):
                           dimensions=(0,))
 
 
-def make_sharded_fuzz_step(program: Program, mesh: Mesh,
-                           batch_per_device: int, max_len: int,
-                           stack_pow2: int = 4, engine: str = "xla",
-                           interpret: bool = False, seed: int = 0,
-                           compact_cap: int = 1024):
-    """Build the jitted multi-chip fuzz step.
+def _counter_halves(base_it):
+    """Split ``base_it`` into uint32 halves host-side (a Python int
+    keeps all 64 bits; a device scalar from an older caller becomes
+    [it, 0]) so jitted bodies never convert a >=2^32 Python int to
+    uint32 — NumPy 2.x raises OverflowError there, and older NumPy
+    wraps silently, replaying earlier (counter, lane) PRNG pairs."""
+    if isinstance(base_it, (int, np.integer)):
+        it = int(base_it)
+        return jnp.asarray(
+            [it & 0xFFFFFFFF, (it >> 32) & 0xFFFFFFFF],
+            dtype=jnp.uint32)
+    arr = jnp.asarray(base_it)
+    if arr.ndim == 0:
+        return jnp.stack([arr.astype(jnp.uint32),
+                          jnp.zeros((), jnp.uint32)])
+    return arr.astype(jnp.uint32)
 
-    Returns ``step(state, seed_buf, seed_len, base_it) ->
-    (state', statuses[B], new_paths[B], uc[B], uh[B], exit_codes[B],
-    candidates[B, L], lengths[B], compact)`` where B =
-    batch_per_device * n_dp, candidates dp-sharded, virgin maps
-    mp-sharded, and ``compact`` = (idx, bufs, lens, counts) is the
-    per-shard interesting-lane report. ``base_it`` is the counter the
-    per-lane PRNG keys fold in; the CLI campaign passes the absolute
-    mutator iteration (monotonically consumed) as a Python int, so
-    resumed runs can never replay an earlier run's (counter, lane)
-    key pair.  All 64 bits are folded (as two uint32 halves), so the
-    guarantee survives past 2^32 total execs.
 
-    ``engine``: "xla" (batched one-hot engine), "pallas" (VMEM VM
-    kernel under shard_map), or "pallas_fused" (mutation fused into
-    the kernel).  ``interpret`` routes pallas through interpret mode
-    (CPU-mesh tests).  ``seed`` is the campaign PRNG root.
+class _ShardKernels:
+    """Per-shard building blocks shared by the per-batch fuzz step
+    and the mesh-resident generation scan: global-lane PRNG keys,
+    the engine-switched mutate+execute tier, and the mp-sharded
+    coverage/novelty/virgin-clear triage (everything up to — but NOT
+    including — the dp AND-fold, which each caller schedules on its
+    own cadence: per batch for the step, every E generations for the
+    generation scan)."""
 
-    The step also returns a per-dp-shard compaction of interesting
-    lanes (idx/bufs/lens blocks of ``compact_cap`` rows per shard +
-    per-shard counts) so campaign triage reads a small report
-    instead of the full candidate tensor.
-    """
-    n_dp = mesh.shape["dp"]
-    n_mp = mesh.shape["mp"]
-    if program.map_size % n_mp:
-        raise ValueError("mp must divide the program's map size")
-    if engine not in ("xla", "pallas", "pallas_fused"):
-        raise ValueError(f"unknown engine {engine!r}")
-    # a shard can never report more interesting lanes than it runs —
-    # a bigger cap would make the "compact" report LARGER than the
-    # full tensor for small shards
-    compact_cap = min(compact_cap, batch_per_device)
-    slice_size = program.map_size // n_mp
-    instrs = jnp.asarray(program.instrs)
-    edge_table = jnp.asarray(program.edge_table)
-    from ..ops.vm_kernel import dot_modes
-    dots = dot_modes(program.instrs, program.n_edges)
-    u_loc_np, eidx_np, outside_np = _shard_static_maps(program, n_mp)
-    u_loc_all = jnp.asarray(u_loc_np)
-    eidx_all = jnp.asarray(eidx_np)
-    outside_all = jnp.asarray(outside_np)
-    u_max = u_loc_np.shape[1]
+    def __init__(self, program: Program, mesh: Mesh,
+                 batch_per_device: int, max_len: int,
+                 stack_pow2: int = 4, engine: str = "xla",
+                 interpret: bool = False, seed: int = 0):
+        n_mp = mesh.shape["mp"]
+        if program.map_size % n_mp:
+            raise ValueError("mp must divide the program's map size")
+        if engine not in ("xla", "pallas", "pallas_fused"):
+            raise ValueError(f"unknown engine {engine!r}")
+        self.program = program
+        self.mesh = mesh
+        self.batch_per_device = int(batch_per_device)
+        self.max_len = int(max_len)
+        self.stack_pow2 = int(stack_pow2)
+        self.engine = engine
+        self.interpret = bool(interpret)
+        self.seed = int(seed)
+        self.slice_size = program.map_size // n_mp
+        self.instrs = jnp.asarray(program.instrs)
+        self.edge_table = jnp.asarray(program.edge_table)
+        from ..ops.vm_kernel import dot_modes
+        self.dots = dot_modes(program.instrs, program.n_edges)
+        u_loc_np, eidx_np, outside_np = _shard_static_maps(program,
+                                                           n_mp)
+        self.u_loc_all = jnp.asarray(u_loc_np)
+        self.eidx_all = jnp.asarray(eidx_np)
+        self.outside_all = jnp.asarray(outside_np)
+        self.u_max = u_loc_np.shape[1]
 
-    def _exec_pallas(bufs, lens):
-        """Local-batch pallas execution (padded to the lane tile
-        with dup-lane-0 coverage no-ops, sliced back)."""
-        from ..ops.vm_kernel import run_batch_pallas_padded
-        return run_batch_pallas_padded(
-            instrs, edge_table, bufs, lens, program.mem_size,
-            program.max_steps, program.n_edges, interpret=interpret,
-            dots=dots)
+    # -- PRNG: per-GLOBAL-lane keys (mesh-shape independent) ---------
 
-    def local_step(vb, vc, vh, seed_buf, seed_len, base_it):
-        # ---- which shard am I ----
+    def lane_keys(self, lo, hi):
+        """Keys for this dp shard's lanes at 64-bit counter [lo, hi];
+        also returns the lanes' global iteration ids (uint32)."""
         dp_i = jax.lax.axis_index("dp")
-        mp_i = jax.lax.axis_index("mp")
-        u_loc = u_loc_all[mp_i]          # [U_max] my virgin offsets
-        eidx = eidx_all[mp_i]            # [E] edge -> my u-column
-        outside = outside_all[mp_i]      # [slice] class-1 constant
-
-        # ---- mutate: per-global-lane keys (mesh-shape independent) ----
-        lane = (dp_i.astype(jnp.uint32) * batch_per_device
-                + jnp.arange(batch_per_device, dtype=jnp.uint32))
-        base = jax.random.key(seed)
-        # base_it is the absolute mutator iteration split into two
-        # uint32 halves [lo, hi]; folding BOTH halves keeps (counter,
-        # lane) key pairs unique past 2^32 total execs (under an hour
-        # at benched multi-chip rates — a single-fold uint32 counter
-        # would wrap and replay earlier mutants).
-        folded = jax.random.fold_in(
-            jax.random.fold_in(base, base_it[0]), base_it[1])
+        lane = (dp_i.astype(jnp.uint32) * self.batch_per_device
+                + jnp.arange(self.batch_per_device, dtype=jnp.uint32))
+        base = jax.random.key(self.seed)
+        # folding BOTH halves keeps (counter, lane) key pairs unique
+        # past 2^32 total execs (under an hour at benched multi-chip
+        # rates — a single-fold uint32 counter would wrap and replay
+        # earlier mutants)
+        folded = jax.random.fold_in(jax.random.fold_in(base, lo), hi)
         keys = jax.vmap(lambda l: jax.random.fold_in(folded, l))(lane)
-        if engine == "pallas_fused":
+        return keys, lo + lane
+
+    # -- mutate + execute (engine switch) ----------------------------
+
+    def _exec_pallas(self, bufs, lens):
+        """Local-batch pallas execution (padded to the lane tile with
+        dup-lane-0 coverage no-ops, sliced back)."""
+        from ..ops.vm_kernel import run_batch_pallas_padded
+        p = self.program
+        return run_batch_pallas_padded(
+            self.instrs, self.edge_table, bufs, lens, p.mem_size,
+            p.max_steps, p.n_edges, interpret=self.interpret,
+            dots=self.dots)
+
+    def mutate_exec(self, keys, seed_buf, seed_len):
+        """havoc-mutate this shard's lanes from ``seed_buf`` and
+        execute them; returns (VMResult, bufs, lens)."""
+        p = self.program
+        bpd = self.batch_per_device
+        if self.engine == "pallas_fused":
             # mutation AND execution in one kernel per dp shard
             from ..ops.vm_kernel import (
                 LANE_TILE, fuzz_batch_pallas, havoc_words_for_keys,
             )
-            pad = (-batch_per_device) % LANE_TILE
+            pad = (-bpd) % LANE_TILE
             if pad:
                 keys_p = jnp.concatenate(
                     [keys, jnp.repeat(keys[:1], pad, axis=0)], axis=0)
             else:
                 keys_p = keys
-            words = havoc_words_for_keys(keys_p, stack_pow2)
+            words = havoc_words_for_keys(keys_p, self.stack_pow2)
             sb = seed_buf
-            if sb.shape[-1] < max_len:
-                sb = jnp.pad(sb, (0, max_len - sb.shape[-1]))
+            if sb.shape[-1] < self.max_len:
+                sb = jnp.pad(sb, (0, self.max_len - sb.shape[-1]))
             res, bufs, lens = fuzz_batch_pallas(
-                instrs, edge_table, sb, seed_len, words,
-                program.mem_size, program.max_steps, program.n_edges,
-                stack_pow2=stack_pow2, interpret=interpret, dots=dots)
+                self.instrs, self.edge_table, sb, seed_len, words,
+                p.mem_size, p.max_steps, p.n_edges,
+                stack_pow2=self.stack_pow2, interpret=self.interpret,
+                dots=self.dots)
             if pad:
                 from ..ops.vm_kernel import _slice_vmresult
-                res = _slice_vmresult(res, batch_per_device)
-                bufs = bufs[:batch_per_device]
-                lens = lens[:batch_per_device]
+                res = _slice_vmresult(res, bpd)
+                bufs = bufs[:bpd]
+                lens = lens[:bpd]
+            return res, bufs, lens
+        bufs, lens = jax.vmap(
+            lambda k: havoc_at(seed_buf, seed_len, k,
+                               stack_pow2=self.stack_pow2))(keys)
+        if self.engine == "pallas":
+            res = self._exec_pallas(bufs, lens)
         else:
-            bufs, lens = jax.vmap(
-                lambda k: havoc_at(seed_buf, seed_len, k,
-                                   stack_pow2=stack_pow2))(keys)
-            if engine == "pallas":
-                res = _exec_pallas(bufs, lens)
-            else:
-                res = _run_batch_impl(instrs, edge_table, bufs, lens,
-                                      program.mem_size,
-                                      program.max_steps,
-                                      program.n_edges, False)
-        statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
-                             res.status)
+            res = _run_batch_impl(self.instrs, self.edge_table, bufs,
+                                  lens, p.mem_size, p.max_steps,
+                                  p.n_edges, False)
+        return res, bufs, lens
+
+    # -- mp-sharded triage (everything up to the dp fold) ------------
+
+    def triage_local(self, vb, vc, vh, counts, statuses):
+        """Coverage over this shard's u-slots, novelty vs the local
+        virgin slices (pmax over mp), per-dp-shard in-batch dedup,
+        and the local virgin clears.  Returns (rets, uc, uh, vb2,
+        vc2, vh2) — the caller owns WHEN the dp AND-fold runs."""
+        mp_i = jax.lax.axis_index("mp")
+        u_loc = self.u_loc_all[mp_i]     # [U_max] my virgin offsets
+        eidx = self.eidx_all[mp_i]       # [E] edge -> my u-column
+        outside = self.outside_all[mp_i]  # [slice] class-1 constant
+        slice_size = self.slice_size
 
         # ---- coverage over MY u-slots (the per-shard share of the
         # static universe — no dense slice is ever materialized) ----
-        by = counts_by_slot(res.counts, eidx, u_max + 1)[:, :u_max]
+        by = counts_by_slot(counts, eidx, self.u_max + 1)[:, :self.u_max]
         cls = classify_counts(by)                    # [B, U_max]
         simp = simplify_trace(by)
 
@@ -325,6 +353,61 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
                     jnp.where(jnp.any(crash), outside, zero_out))
         vh2 = clear(vh, fold_new(simp, hang),
                     jnp.where(jnp.any(hang), outside, zero_out))
+        return rets, uc, uh, vb2, vc2, vh2
+
+
+def make_sharded_fuzz_step(program: Program, mesh: Mesh,
+                           batch_per_device: int, max_len: int,
+                           stack_pow2: int = 4, engine: str = "xla",
+                           interpret: bool = False, seed: int = 0,
+                           compact_cap: int = 1024):
+    """Build the jitted multi-chip fuzz step.
+
+    Returns ``step(state, seed_buf, seed_len, base_it) ->
+    (state', statuses[B], new_paths[B], uc[B], uh[B], exit_codes[B],
+    candidates[B, L], lengths[B], compact)`` where B =
+    batch_per_device * n_dp, candidates dp-sharded, virgin maps
+    mp-sharded, and ``compact`` = (idx, bufs, lens, counts) is the
+    per-shard interesting-lane report. ``base_it`` is the counter the
+    per-lane PRNG keys fold in; the CLI campaign passes the absolute
+    mutator iteration (monotonically consumed) as a Python int, so
+    resumed runs can never replay an earlier run's (counter, lane)
+    key pair.  All 64 bits are folded (as two uint32 halves), so the
+    guarantee survives past 2^32 total execs.
+
+    ``engine``: "xla" (batched one-hot engine), "pallas" (VMEM VM
+    kernel under shard_map), or "pallas_fused" (mutation fused into
+    the kernel).  ``interpret`` routes pallas through interpret mode
+    (CPU-mesh tests).  ``seed`` is the campaign PRNG root.
+
+    The step also returns a per-dp-shard compaction of interesting
+    lanes (idx/bufs/lens blocks of ``compact_cap`` rows per shard +
+    per-shard counts) so campaign triage reads a small report
+    instead of the full candidate tensor.
+    """
+    n_dp = mesh.shape["dp"]
+    n_mp = mesh.shape["mp"]
+    # a shard can never report more interesting lanes than it runs —
+    # a bigger cap would make the "compact" report LARGER than the
+    # full tensor for small shards
+    compact_cap = min(compact_cap, batch_per_device)
+    kern = _ShardKernels(program, mesh, batch_per_device, max_len,
+                         stack_pow2=stack_pow2, engine=engine,
+                         interpret=interpret, seed=seed)
+
+    def local_step(vb, vc, vh, seed_buf, seed_len, base_it):
+        dp_i = jax.lax.axis_index("dp")
+
+        # ---- mutate + execute: per-global-lane keys at the 64-bit
+        # counter [lo, hi] (mesh-shape independent) ----
+        keys, _its = kern.lane_keys(base_it[0], base_it[1])
+        res, bufs, lens = kern.mutate_exec(keys, seed_buf, seed_len)
+        statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
+                             res.status)
+
+        # ---- mp-sharded triage: coverage, novelty, dedup, clears ----
+        rets, uc, uh, vb2, vc2, vh2 = kern.triage_local(
+            vb, vc, vh, res.counts, statuses)
 
         # ---- union across dp (the per-step "merger") ----
         vb2 = _gather_and_fold(vb2, "dp")
@@ -413,26 +496,12 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         return (new_state, statuses, rets, uc, uh, exit_codes, bufs,
                 lens, (sel_idx, sel_bufs, sel_lens, counts))
 
-    def _halves(base_it):
-        """Split ``base_it`` into uint32 halves host-side (a Python
-        int keeps all 64 bits; a device scalar from an older caller
-        becomes [it, 0]) so the jitted body never converts a >=2^32
-        Python int to uint32 — NumPy 2.x raises OverflowError there,
-        and older NumPy wraps silently, replaying earlier
-        (counter, lane) PRNG pairs."""
-        if isinstance(base_it, (int, np.integer)):
-            it = int(base_it)
-            return jnp.asarray(
-                [it & 0xFFFFFFFF, (it >> 32) & 0xFFFFFFFF],
-                dtype=jnp.uint32)
-        arr = jnp.asarray(base_it)
-        if arr.ndim == 0:
-            return jnp.stack([arr.astype(jnp.uint32),
-                              jnp.zeros((), jnp.uint32)])
-        return arr.astype(jnp.uint32)
+    # the module-level _counter_halves owns the 64-bit base_it split
+    _halves = _counter_halves
 
     def step(state: ShardedFuzzState, seed_buf, seed_len, base_it):
-        """Public step (see _halves for the base_it contract)."""
+        """Public step (see _counter_halves for the base_it
+        contract)."""
         return _step_jit(state, seed_buf, seed_len, _halves(base_it))
 
     def _validate(state: ShardedFuzzState, seed_buf):
@@ -471,3 +540,275 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
 
     step.multi = step_multi
     return step
+
+
+# -- mesh-resident generations (ops/generations.py x shard_map) ---------
+
+
+class ShardedGenRing(NamedTuple):
+    """Per-dp-shard device seed-slot rings for the mesh generation
+    scan: each dp shard owns S slots x max_len bytes plus lengths,
+    occupancy and per-slot hit/find stats (leading ``dp`` axis,
+    sharded P("dp"))."""
+    bufs: jax.Array      # uint8[dp, S, L]
+    lens: jax.Array      # int32[dp, S]
+    filled: jax.Array    # int32[dp, S]
+    hits: jax.Array      # int32[dp, S]
+    finds: jax.Array     # int32[dp, S]
+    ptr: jax.Array       # int32[dp] monotone admission counter
+
+
+def sharded_gen_ring_init(mesh: Mesh, seed_buf, seed_len: int,
+                          slots: int, max_len: int) -> ShardedGenRing:
+    """Fresh per-shard rings: slot 0 of EVERY dp shard pins the base
+    seed; the rest stay empty until edge-novel lanes admit."""
+    n_dp = mesh.shape["dp"]
+    slots = max(int(slots), 2)
+    raw = np.asarray(seed_buf, dtype=np.uint8).reshape(-1)[:max_len]
+    bufs = np.zeros((n_dp, slots, max_len), np.uint8)
+    bufs[:, 0, :raw.shape[0]] = raw
+    lens = np.zeros((n_dp, slots), np.int32)
+    lens[:, 0] = int(seed_len)
+    filled = np.zeros((n_dp, slots), np.int32)
+    filled[:, 0] = 1
+    spec = NamedSharding(mesh, P("dp"))
+
+    def put(a):
+        return jax.device_put(jnp.asarray(a), spec)
+
+    return ShardedGenRing(
+        bufs=put(bufs), lens=put(lens), filled=put(filled),
+        hits=put(np.zeros((n_dp, slots), np.int32)),
+        finds=put(np.zeros((n_dp, slots), np.int32)),
+        ptr=put(np.zeros((n_dp,), np.int32)))
+
+
+def make_sharded_generations(program: Program, mesh: Mesh,
+                             batch_per_device: int, max_len: int,
+                             stack_pow2: int = 4, engine: str = "xla",
+                             interpret: bool = False, seed: int = 0,
+                             salt: int = 0,
+                             adm_cap: int = DEFAULT_ADM_CAP,
+                             findings_cap: int = DEFAULT_FINDINGS_CAP):
+    """Build the mesh-resident generation dispatch: the single-chip
+    generation scan (ops/generations.py) lifted into a ``shard_map``
+    over the (dp, mp) mesh.
+
+    Each dp shard carries its OWN device-resident state through the
+    scan carry — virgin-map slices (mp-sharded like the per-batch
+    step), a seed-slot ring, and a bounded findings ring — and every
+    ``fold_every`` generations the scan AND-folds the virgin maps
+    across dp via ICI collectives (``_gather_and_fold``, the merger
+    semantics the per-batch step already implements), so shards stop
+    re-finding each other's paths without any host round-trip.  The
+    final chunk always folds, so the returned state is dp-replicated
+    exactly like the per-batch step's.
+
+    Candidate parity: per-lane keys use the SAME derivation as the
+    host-driven mesh loop (fold_in(fold_in(base, lo), hi) then the
+    global lane id — ``_ShardKernels.lane_keys``), and generation j
+    consumes counter ``base_it + j*(dp*batch_per_device)``; with
+    reseeding off and ``fold_every=1`` the mesh generation scan is
+    bit-identical to the host-driven mesh loop (findings, folded
+    virgin maps) — the dp>1 twin of the PR 9 single-chip parity
+    contract.  With ``fold_every > 1`` shards may re-find each
+    other's paths BETWEEN folds: persistence-style over-report,
+    never under-report, and the folded virgin maps still end
+    identical (same doctrine as the per-dp-shard dedup).
+
+    Per-shard slot selection salts the pick with the dp index
+    (``salt ^ dp_i``) so shards explore different ring slots; the
+    per-generation pick lands in the ledger, so host replay never
+    re-derives it.
+
+    Returns ``dispatch(state, ring, base_it, gen0, g, reseed,
+    fold_every) -> (state', ring', rep)`` where ``rep`` is the
+    13-tuple of MeshGenerationOutcome ring/ledger fields (leading dp
+    axis).  The jit donates the carry state (ring + virgin buffers
+    update in place, see ops.generations.carry_donation_argnums);
+    ``ring.filled`` and ``ring.ptr`` are exempt because the outcome
+    report exports them after the next dispatch is already in
+    flight.
+    """
+    n_dp = mesh.shape["dp"]
+    b = int(batch_per_device)
+    kern = _ShardKernels(program, mesh, b, max_len,
+                         stack_pow2=stack_pow2, engine=engine,
+                         interpret=interpret, seed=seed)
+    F = int(findings_cap)
+    A = max(int(adm_cap), 1)
+    salt_u32 = jnp.uint32(int(salt) & 0xFFFFFFFF)
+
+    def gen_body(g: int, reseed: bool, fold_every: int):
+        n_chunks = g // fold_every
+        A_eff = A if reseed else 1
+
+        def body(vb, vc, vh, rbufs, rlens, rfilled, rhits, rfinds,
+                 rptr, base_it, gen0, salt):
+            dp_i = jax.lax.axis_index("dp")
+            # P("dp") blocks arrive with a leading axis of 1
+            rbufs, rlens, rfilled, rhits, rfinds, rptr = (
+                rbufs[0], rlens[0], rfilled[0], rhits[0], rfinds[0],
+                rptr[0])
+            L = rbufs.shape[1]
+            # per-shard slot-policy salt (host-replayable: salt ^ d)
+            salt_d = salt ^ dp_i.astype(jnp.uint32)
+
+            def one_generation(carry, j):
+                (vb, vc, vh, rbufs, rlens, rfilled, rhits, rfinds,
+                 rptr, fr_pack, fr_gen, fr_iter, fr_len, fr_bufs,
+                 fr_ptr) = carry
+                gen_id = gen0 + j
+                if reseed:
+                    sel = _select_slot(rfilled, gen_id, salt_d)
+                else:
+                    sel = jnp.int32(0)
+                seed_buf = rbufs[sel]
+                seed_len = rlens[sel]
+                # 64-bit counter for this generation: the global
+                # batch advances dp*b per generation, with the lo->hi
+                # carry so campaigns past 2^32 execs never replay
+                off = j * jnp.uint32(n_dp * b)
+                lo = base_it[0] + off
+                hi = base_it[1] + (lo < base_it[0]).astype(jnp.uint32)
+                keys, its = kern.lane_keys(lo, hi)
+                res, bufs, lens = kern.mutate_exec(keys, seed_buf,
+                                                   seed_len)
+                statuses = jnp.where(res.status == FUZZ_RUNNING,
+                                     FUZZ_HANG, res.status)
+                rets, uc, uh, vb, vc, vh = kern.triage_local(
+                    vb, vc, vh, res.counts, statuses)
+                packed = pack_verdicts(statuses, rets, uc, uh)
+
+                # findings-ring append + FIFO admission + ledger:
+                # the EXACT single-chip semantics (shared helper —
+                # loop.py's replay and the parity suites pin both
+                # scans to it)
+                flags = (statuses != FUZZ_NONE) | (rets > 0)
+                aflags = rets == 2
+                ((rbufs, rlens, rfilled, rhits, rfinds, rptr),
+                 (fr_pack, fr_gen, fr_iter, fr_len, fr_bufs,
+                  fr_ptr),
+                 araw, ledger) = _ring_append_and_admit(
+                    flags, aflags, packed, its, bufs, lens, gen_id,
+                    sel,
+                    (rbufs, rlens, rfilled, rhits, rfinds, rptr),
+                    (fr_pack, fr_gen, fr_iter, fr_len, fr_bufs,
+                     fr_ptr),
+                    A_eff, reseed)
+
+                carry = (vb, vc, vh, rbufs, rlens, rfilled, rhits,
+                         rfinds, rptr, fr_pack, fr_gen, fr_iter,
+                         fr_len, fr_bufs, fr_ptr)
+                return carry, (sel, araw) + ledger
+
+            def chunk(carry, c):
+                j0 = c * jnp.uint32(fold_every)
+                carry, ys = jax.lax.scan(
+                    one_generation, carry,
+                    j0 + jnp.arange(fold_every, dtype=jnp.uint32))
+                (vb, vc, vh, *rest) = carry
+                # the in-scan "merger": AND-fold virgin maps across
+                # dp so shards stop re-finding each other's paths —
+                # no host round-trip, same fold as the per-batch step
+                vb = _gather_and_fold(vb, "dp")
+                vc = _gather_and_fold(vc, "dp")
+                vh = _gather_and_fold(vh, "dp")
+                return (vb, vc, vh) + tuple(rest), ys
+
+            carry0 = (vb, vc, vh, rbufs, rlens, rfilled, rhits,
+                      rfinds, rptr,
+                      jnp.zeros((F,), jnp.uint8),       # fr_pack
+                      jnp.zeros((F,), jnp.int32),       # fr_gen
+                      jnp.zeros((F,), jnp.uint32),      # fr_iter
+                      jnp.zeros((F,), jnp.int32),       # fr_len
+                      jnp.zeros((F, L), jnp.uint8),     # fr_bufs
+                      jnp.int32(0))                     # fr_ptr
+            carry, ys = jax.lax.scan(
+                chunk, carry0, jnp.arange(n_chunks, dtype=jnp.uint32))
+            (vb, vc, vh, rbufs, rlens, rfilled, rhits, rfinds, rptr,
+             fr_pack, fr_gen, fr_iter, fr_len, fr_bufs,
+             fr_ptr) = carry
+            # [n_chunks, fold_every, ...] -> [g, ...] ledger rows
+            ys = jax.tree_util.tree_map(
+                lambda a: a.reshape((g,) + a.shape[2:]), ys)
+            (sel, adm_raw, adm_valid, adm_slot, adm_iter, adm_len,
+             adm_bufs) = ys
+
+            def exp(a):     # restore the leading dp-block axis
+                return a[None]
+
+            return (vb, vc, vh,
+                    exp(rbufs), exp(rlens), exp(rfilled), exp(rhits),
+                    exp(rfinds), exp(rptr),
+                    exp(fr_pack), exp(fr_gen), exp(fr_iter),
+                    exp(fr_len), exp(fr_bufs), exp(fr_ptr),
+                    exp(sel), exp(adm_raw), exp(adm_valid),
+                    exp(adm_slot), exp(adm_iter), exp(adm_len),
+                    exp(adm_bufs))
+
+        return body
+
+    _cache: dict = {}
+
+    def _jit(g: int, reseed: bool, fold_every: int):
+        key = (g, reseed, fold_every)
+        fn = _cache.get(key)
+        if fn is None:
+            dp_specs = (P("dp"),) * 6
+            fn = jax.jit(
+                shard_map(
+                    gen_body(g, reseed, fold_every), mesh=mesh,
+                    in_specs=(P("mp"), P("mp"), P("mp"),
+                              *dp_specs, P(), P(), P()),
+                    out_specs=((P("mp"), P("mp"), P("mp"))
+                               + (P("dp"),) * 19),
+                    check_vma=False),
+                # donate the carry: vb/vc/vh + ring bufs/lens/hits/
+                # finds update in place; ring filled(5)/ptr(8) are
+                # exported in the outcome report, never donated
+                donate_argnums=carry_donation_argnums(
+                    jax.default_backend(), (0, 1, 2, 3, 4, 6, 7)))
+            _cache[key] = fn
+        return fn
+
+    _fold_warned: set = set()
+
+    def dispatch(state: ShardedFuzzState, ring: ShardedGenRing,
+                 base_it, gen0: int, g: int, reseed: bool = True,
+                 fold_every: int = 0):
+        """Run ``g`` mesh generations in ONE device program.
+        ``fold_every`` <= 0 means auto: once per dispatch with
+        reseeding on (cheapest), every generation with reseeding off
+        (the host-mesh-loop parity cadence).  A non-dividing E is
+        decremented to the nearest divisor of ``g`` (warned, once per
+        (E, g) pair) — a dispatch always ends on a fold, so the
+        returned maps are dp-replicated."""
+        g = int(g)
+        fold = int(fold_every)
+        if fold <= 0:
+            fold = g if reseed else 1
+        fold = max(1, min(fold, g))
+        while g % fold:
+            fold -= 1
+        if fold != int(fold_every) and int(fold_every) > 0 \
+                and (int(fold_every), g) not in _fold_warned:
+            _fold_warned.add((int(fold_every), g))
+            WARNING_MSG(
+                "gen_fold_every %d does not divide this dispatch's "
+                "%d generations: folding every %d instead (a "
+                "dispatch must end on a fold so the virgin maps "
+                "return dp-replicated)", int(fold_every), g, fold)
+        outs = _jit(g, bool(reseed), fold)(
+            state.virgin_bits, state.virgin_crash, state.virgin_tmout,
+            ring.bufs, ring.lens, ring.filled, ring.hits, ring.finds,
+            ring.ptr, _counter_halves(base_it), jnp.uint32(int(gen0)),
+            salt_u32)
+        (vb, vc, vh, rbufs, rlens, rfilled, rhits, rfinds, rptr,
+         *rep) = outs
+        new_state = ShardedFuzzState(vb, vc, vh, state.step + g)
+        new_ring = ShardedGenRing(rbufs, rlens, rfilled, rhits,
+                                  rfinds, rptr)
+        return new_state, new_ring, tuple(rep)
+
+    return dispatch

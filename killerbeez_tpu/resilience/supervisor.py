@@ -66,6 +66,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import (
     DEVICE_LOST_EXIT_CODE, WATCHDOG_EXIT_CODE, is_device_loss,
 )
+from .. import DEFAULT_BATCH_SIZE
 from ..utils.logging import INFO_MSG, WARNING_MSG, setup_logging
 
 #: exit classes
@@ -100,21 +101,26 @@ def _arg_value(argv: List[str], *names: str,
     return default
 
 
-def shrink_mesh(mesh: str, devices: int) -> Optional[str]:
-    """Degrade a ``dp,mp`` mesh to fit ``devices`` chips by halving
+def shrink_mesh(mesh: str, devices: int,
+                batch: int = 0) -> Optional[str]:
+    """Degrade a ``dp,mp`` mesh to fit ``devices`` chips by shrinking
     dp (candidate sharding degrades gracefully; mp is the coverage
-    model partition and is not renegotiable here).  Returns the new
-    mesh string, the same one when it already fits, or None when even
-    dp=1 does not fit."""
+    model partition and is not renegotiable here).  When ``batch`` is
+    known, the new dp must also DIVIDE it — the sharded campaign
+    driver rejects ``-b % dp != 0`` at startup, so a dp that merely
+    fits the chips would turn one device loss into a restart crash
+    loop.  Returns the new mesh string, the same one when it already
+    fits, or None when no dp >= 1 satisfies both constraints."""
     try:
         dp, mp = (int(x) for x in mesh.split(","))
     except ValueError:
         return None
-    while dp > 1 and dp * mp > devices:
-        dp //= 2
-    if dp * mp > devices:
-        return None
-    return f"{dp},{mp}"
+    limit = devices // mp if mp else 0
+    for cand in range(min(dp, limit), 0, -1):
+        if batch > 0 and batch % cand:
+            continue
+        return f"{cand},{mp}"
+    return None
 
 
 class Supervisor:
@@ -279,7 +285,18 @@ class Supervisor:
             if n > 0:
                 mesh = _arg_value(self.argv, "--mesh")
                 if mesh:
-                    smaller = shrink_mesh(mesh, n)
+                    # the shrunken dp must divide the campaign batch
+                    # (the driver rejects -b % dp at startup); the
+                    # rest of the argv — including -G/--generations —
+                    # is preserved verbatim, only the --mesh value is
+                    # rewritten in place
+                    try:
+                        batch = int(_arg_value(
+                            self.argv, "-b", "--batch-size",
+                            default=str(DEFAULT_BATCH_SIZE)) or 0)
+                    except ValueError:
+                        batch = 0
+                    smaller = shrink_mesh(mesh, n, batch=batch)
                     if smaller and smaller != mesh:
                         # dp=4 -> dp=2: keep fuzzing on the chips
                         # that still answer instead of crash-looping

@@ -214,7 +214,8 @@ def detect_bubbles(spans: List[Dict[str, Any]],
     return bubbles, threshold_us
 
 
-def generations_report(spans: List[Dict[str, Any]]
+def generations_report(spans: List[Dict[str, Any]],
+                       instants: Optional[List[Dict[str, Any]]] = None
                        ) -> Optional[Dict[str, Any]]:
     """--generations campaign analysis: each device dispatch's
     in-flight span carries its generation count in the span args;
@@ -222,7 +223,19 @@ def generations_report(spans: List[Dict[str, Any]]
     generation window (fraction of the window with a G-generation
     dispatch in flight) and host-stage occupancy over the same
     window.  ``device_bound`` is the ROADMAP item 1 acceptance call:
-    the device, not host mutate/triage, holds the critical path."""
+    the device, not host mutate/triage, holds the critical path.
+
+    Mesh campaigns (--generations on --mesh) additionally stamp one
+    ``shard_generations`` instant per dp shard per dispatch; those
+    fold into a ``shards`` section — per-shard dispatch/generation
+    totals plus each shard's generation occupancy over the window.
+    The dispatch is ONE mesh program (shards advance in lockstep, so
+    the instants are stamped host-side for every shard together):
+    the rows certify that each shard spent the window inside
+    G-generation dispatches at mesh scale, they are not a per-shard
+    divergence detector — a slow or wedged shard stalls the whole
+    program and shows up as mesh-wide occupancy loss or a watchdog
+    stall, never as one diverging row."""
     disp = [s for s in spans
             if s.get("name") == "in_flight"
             and (s.get("args") or {}).get("generations")]
@@ -237,7 +250,7 @@ def generations_report(spans: List[Dict[str, Any]]
                for s in spans if s["name"] in HOST_STAGES
                and s["t1"] > w0 and s["t0"] < w1]
     host = _union_len(host_iv) / window
-    return {
+    report = {
         "dispatches": len(disp),
         "generations_total": sum(gens),
         "generations_min": min(gens),
@@ -247,6 +260,40 @@ def generations_report(spans: List[Dict[str, Any]]
         "window_us": window,
         "device_bound": bool(dev > host),
     }
+    shard_marks = [ev for ev in (instants or [])
+                   if ev.get("name") == "shard_generations"
+                   and (ev.get("args") or {}).get("shard")
+                   is not None]
+    if shard_marks:
+        # dispatch intervals sorted once; a shard's occupancy is the
+        # union of the dispatch windows it stamped a mark inside
+        ivals = sorted((s["t0"], s["t1"]) for s in disp)
+        shards: Dict[str, Dict[str, Any]] = {}
+        for ev in shard_marks:
+            a = ev["args"]
+            d = shards.setdefault(str(int(a["shard"])), {
+                "dispatches": 0, "generations_total": 0,
+                "_ivals": []})
+            d["dispatches"] += 1
+            d["generations_total"] += int(a.get("generations", 0))
+            ts = float(ev["ts"])
+            # the campaign stamps shard instants at dispatch time,
+            # just BEFORE the loop opens the dispatch's in_flight
+            # window (and while the previous window is still open
+            # under the double buffer): attribute the mark to the
+            # window whose OPEN is nearest — "first still-open
+            # window" would hand every mark to the previous dispatch
+            # and drop the final window from every shard's union
+            hit = min(ivals, key=lambda iv: abs(iv[0] - ts)) \
+                if ivals else None
+            if hit is not None:
+                d["_ivals"].append(hit)
+        for d in shards.values():
+            d["occupancy"] = _union_len(d.pop("_ivals")) / window
+        report["shards"] = dict(sorted(shards.items(),
+                                       key=lambda kv: int(kv[0])))
+        report["n_shards"] = len(shards)
+    return report
 
 
 # -- events -------------------------------------------------------------
@@ -493,6 +540,11 @@ def render(report: Dict[str, Any], lanes: List[str]) -> str:
             f"generation window — "
             + ("DEVICE-bound (host stages off the critical path)"
                if gr["device_bound"] else "host-bound"))
+        for sid, sd in (gr.get("shards") or {}).items():
+            lines.append(
+                f"    shard-{sid:<4} {sd['dispatches']} dispatches, "
+                f"{sd['generations_total']} generations, "
+                f"{sd['occupancy']:.1%} occupancy")
     bubbles = report.get("bubbles", [])
     lines.append(
         f"  bubbles       : {len(bubbles)} detected, "
@@ -572,7 +624,7 @@ def build_report(doc: Optional[Dict[str, Any]],
             "bubble_threshold_us": thresh,
             "trace_meta": doc.get("otherData", {}),
         })
-        gr = generations_report(spans)
+        gr = generations_report(spans, instants_from_chrome(doc))
         if gr:
             report["generations"] = gr
     if events:

@@ -56,13 +56,14 @@ def _post(server, path, payload):
         return json.loads(resp.read())
 
 
-def _snap(execs, paths=0, uc=0, crashes=None, t=None):
+def _snap(execs, paths=0, uc=0, crashes=None, t=None, drops=0):
     return {"t": time.time() if t is None else t, "start_time": 0.0,
             "elapsed": 10.0,
             "counters": {"execs": execs, "new_paths": paths,
                          "crashes": (uc if crashes is None
                                      else crashes),
-                         "unique_crashes": uc},
+                         "unique_crashes": uc,
+                         "findings_ring_drops": drops},
             "gauges": {"corpus_seen": paths},
             "rates": {"execs": {"rate": 100.0, "weight": 1.0}},
             "derived": {"execs_per_sec": 10.0,
@@ -415,6 +416,72 @@ def test_alert_rules_plateau_spike_stall():
     spike = [a for a in mon.alerts("c")
              if a["alert"] == "crash_spike"][0]
     assert not spike["active"]
+
+
+def test_alert_rule_findings_drop_edges():
+    """findings_ring_drops is counted but was never alerted: the
+    findings_drop rule fires when the fleet's counter MOVES, stays
+    active while drops keep landing, and clears after a quiet
+    drops_window — and a manager restart seeing a stale lifetime
+    total only baselines (no re-alarm on drops that stopped hours
+    ago)."""
+    db, mon = _mk_monitor(drops_window=20.0, series_interval=1e9)
+    now = 1000.0
+    db.note_fleet_worker("c", "w1", now=now)
+
+    def beat(execs, drops, t):
+        db.note_fleet_worker("c", "w1", now=t)
+        db.upsert_campaign_stats("c", "w1",
+                                 _snap(execs, 1, t=t, drops=drops))
+
+    # first observation carries a nonzero lifetime total: baseline
+    # only — the drops may predate this monitor's lifetime
+    beat(100, 5, now)
+    mon.tick(now=now)
+    assert not [a for a in mon.alerts("c")
+                if a["alert"] == "findings_drop" and a["active"]]
+    # the counter MOVES: rising edge, one active=True event
+    beat(200, 9, now + 5.0)
+    mon.tick(now=now + 5.0)
+    drop = [a for a in mon.alerts("c")
+            if a["alert"] == "findings_drop"][0]
+    assert drop["active"]
+    assert drop["details"]["findings_ring_drops_total"] == 9
+    # still active inside the window, no movement
+    beat(300, 9, now + 15.0)
+    mon.tick(now=now + 15.0)
+    assert [a for a in mon.alerts("c")
+            if a["alert"] == "findings_drop"][0]["active"]
+    # a quiet drops_window clears it, with a clearing event
+    beat(400, 9, now + 26.0)
+    mon.tick(now=now + 26.0)
+    assert not [a for a in mon.alerts("c")
+                if a["alert"] == "findings_drop"][0]["active"]
+    # /api/fleet body + /metrics exposition both carry the rule
+    # (checked BEFORE the decrease beat below overwrites the worker
+    # snapshot — the stat summary reports the CURRENT heartbeat)
+    from killerbeez_tpu.manager.fleet import fleet_view
+    body = fleet_view(db, mon.cfg, "c", monitor=mon, now=now + 26.0)
+    assert "findings_drop" in {a["alert"] for a in body["alerts"]}
+    assert body["workers"]["w1"]["stats"][
+        "findings_ring_drops"] == 9
+    text = render_fleet_metrics(db, mon.cfg, mon, now=now + 26.0)
+    fams = parse_openmetrics(text)
+    assert "findings_drop" in {
+        lab["alert"] for _, lab, _ in
+        fams["kbz_alert_active"]["samples"]}
+    # a DECREASE of the merged total (a worker restarted/retired and
+    # its monotone counter reset) is not a new drop: no re-fire
+    beat(500, 4, now + 27.0)
+    mon.tick(now=now + 27.0)
+    assert not [a for a in mon.alerts("c")
+                if a["alert"] == "findings_drop"][0]["active"]
+    evs = [json.loads(r["payload"])
+           for r in db._rows("SELECT payload FROM campaign_events "
+                             "WHERE campaign='c'")]
+    fires = [e for e in evs if e["type"] == "alert"
+             and e.get("alert") == "findings_drop"]
+    assert [e.get("active") for e in fires] == [True, False]
 
 
 def test_manager_events_monotone_seq_and_dedup():

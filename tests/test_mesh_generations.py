@@ -1,0 +1,286 @@
+"""Mesh-resident generations (ISSUE 10): the device generation scan
+(ops/generations.py) lifted into a shard_map over the (dp, mp) mesh —
+per-dp-shard virgin maps, seed-slot rings and findings rings, with
+in-scan ICI AND-folds on the gen_fold_every cadence.
+
+Pins the ISSUE 10 contracts on the virtual 8-device CPU mesh:
+  * dp>1 parity — with feedback off the mesh-generations candidate
+    stream is bit-identical to the host-driven mesh loop (findings,
+    folded virgin maps AND corpus-store write-through), the mesh twin
+    of the PR 9 single-chip parity gate, and a sparser fold cadence
+    over-reports but never under-reports (folded maps identical);
+  * --generations no longer stands down under --mesh;
+  * generation-tail edge cases — pow2 quantization of G when -n does
+    not fill G*b (exec totals stay exact, watchdog scales per
+    dispatch), findings-ring wrap exactly at capacity (cap == raw is
+    lossless, cap == raw-1 drops exactly the excess into the
+    findings_ring_drops counter — never silent);
+  * ledger-replay determinism at dp>1 — identical runs produce
+    identical findings and identical shard-ordered ring_admit
+    streams, independent of drain interleaving;
+  * kb-timeline reports per-shard generation occupancy for a dp>1
+    campaign (the ROADMAP item 1 acceptance artifact at mesh scale).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from killerbeez_tpu.fuzzer.loop import Fuzzer
+from killerbeez_tpu.instrumentation.factory import instrumentation_factory
+from killerbeez_tpu.mutators.factory import mutator_factory
+from killerbeez_tpu.parallel import ShardedCampaignDriver
+
+SEED = b"CG\x02\x04\x05\x41xx"
+MESH = "4,2"
+B = 64                                  # 16 lanes/chip on dp=4
+
+
+def _findings(root):
+    out = {}
+    for kind in ("crashes", "hangs", "new_paths"):
+        d = os.path.join(root, kind)
+        out[kind] = sorted(
+            f for f in (os.listdir(d) if os.path.isdir(d) else [])
+            if len(f) == 32)
+    return out
+
+
+def _mesh_driver(iopts=None, mopts='{"seed": 11}', batch=B):
+    instr = instrumentation_factory(
+        "jit_harness", iopts or '{"target": "cgc_like"}')
+    mut = mutator_factory("havoc", mopts, SEED)
+    return ShardedCampaignDriver(MESH, instr, mut,
+                                 batch_size=batch), instr
+
+
+# ---------------------------------------------------------------------------
+# dp>1 parity: mesh-generations == host-driven mesh loop (fb off)
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_generations_matches_host_mesh_loop(tmp_path):
+    """THE ISSUE 10 parity contract, end to end through the CLI:
+    with feedback off the dp>1 mesh-generations candidate stream is
+    bit-identical to the host-driven mesh loop — findings, folded
+    virgin maps, AND the corpus-store write-through — and the mode
+    no longer warns a mesh stand-down."""
+    from killerbeez_tpu.fuzzer.cli import main as cli_main
+
+    seed_file = tmp_path / "seed"
+    seed_file.write_bytes(SEED)
+
+    def run(name, extra):
+        out = tmp_path / name
+        st = tmp_path / f"{name}.json"
+        rc = cli_main([
+            "file", "jit_harness", "havoc", "--mesh", MESH,
+            "-i", '{"target": "cgc_like"}', "-m", '{"seed": 11}',
+            "-sf", str(seed_file), "-o", str(out),
+            "-b", str(B), "-n", str(8 * B), "-fb", "0",
+            "--corpus-dir", str(out / "corpus"),
+            "-isd", str(st), *extra])
+        assert rc == 0
+        store = sorted(f for f in os.listdir(out / "corpus")
+                       if len(f) == 32)
+        return _findings(str(out)), json.loads(st.read_text()), store
+
+    fh, sh, ch = run("host", [])
+    fg, sg, cg = run("gen", ["-G", "4"])
+    assert sh["total_execs"] == sg["total_execs"] == 8 * B
+    assert any(fh.values()), "control found nothing to compare"
+    assert fg == fh
+    assert cg == ch and ch, "store write-through diverged"
+    for k in ("virgin_bits", "virgin_crash", "virgin_tmout"):
+        assert sg[k] == sh[k], f"{k} diverged"
+
+
+def test_mesh_fold_cadence_over_reports_never_under_reports():
+    """gen_fold_every trades ICI fold traffic against duplicate
+    re-finds, never against findings: between folds shards may
+    re-find each other's paths (fold_every g >= fold_every 1 lanes,
+    and every fold-1 finding is in the fold-g rings), and the FOLDED
+    virgin maps end byte-identical regardless of cadence — the same
+    doctrine the per-batch step's per-dp-shard dedup pins."""
+    outs = {}
+    for fe in (1, 4):
+        drv, instr = _mesh_driver(
+            iopts=json.dumps({"target": "cgc_like",
+                              "gen_fold_every": fe}))
+        assert drv.supports_batch_generations()
+        h = drv.test_batch_generations(B, 4, reseed=False)
+        outs[fe] = (h.materialize(),
+                    np.asarray(drv.state.virgin_bits),
+                    np.asarray(drv.state.virgin_crash))
+
+    def ring_bufs(h):
+        out = set()
+        for d in range(h.n_shards):
+            s = h.shard(d)
+            for i in range(min(int(s.fr_ptr), int(s.cap))):
+                out.add(bytes(s.fr_bufs[i, :int(s.fr_len[i])]))
+        return out
+
+    a, b = outs[1], outs[4]
+    assert int(b[0].fr_ptr.sum()) >= int(a[0].fr_ptr.sum())
+    assert ring_bufs(a[0]) <= ring_bufs(b[0])   # never under-report
+    np.testing.assert_array_equal(a[1], b[1])   # folded maps agree
+    np.testing.assert_array_equal(a[2], b[2])
+    # and the returned maps are dp-replicated (a dispatch always
+    # ends on a fold): per-shard novelty already merged
+    assert a[0].n_shards == 4
+
+
+# ---------------------------------------------------------------------------
+# generation-tail edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_tail_quantizes_to_pow2_and_execs_exact(tmp_path):
+    """-n not filling G*b at mesh scale: tail dispatches quantize G
+    down to a power of two (g is a STATIC jit argument — an
+    arbitrary tail would recompile the whole sharded scan), the exec
+    total stays exact, the watchdog arms per-dispatch scales, and
+    the mode never stood down."""
+    from tests.test_generations import _RecordingWatchdog
+
+    wd = _RecordingWatchdog()
+    drv, _ = _mesh_driver()
+    fz = Fuzzer(drv, output_dir=str(tmp_path / "o"), batch_size=B,
+                feedback=0, generations=8, watchdog=wd)
+    try:
+        fz.run(B * 11)      # 8 + (3 -> 2) + 1 generations
+    finally:
+        wd.stop()
+    assert fz.stats.iterations == B * 11
+    assert not fz._gen_warned, "mesh stood --generations down"
+    assert all(k & (k - 1) == 0 for k in wd.scales), wd.scales
+    assert wd.scales[:2] == [8, 2]
+
+
+def test_mesh_findings_ring_wrap_exactly_at_capacity(tmp_path):
+    """Findings-ring wrap at the exact boundary, per shard: cap ==
+    the busiest shard's raw interesting count is lossless (ring
+    exactly full, zero drops); cap == raw-1 drops EXACTLY the excess
+    and lands it in the findings_ring_drops counter — overflow is
+    counted, never silent."""
+    # probe the deterministic raw per-shard counts (reseed off)
+    drv, _ = _mesh_driver()
+    h = drv.test_batch_generations(B, 4, reseed=False).materialize()
+    raw = [int(p) for p in h.fr_ptr]
+    top = max(raw)
+    assert top >= 2, "cgc_like found too little to exercise the ring"
+
+    def run_with_cap(name, cap):
+        drv, _ = _mesh_driver(
+            iopts=json.dumps({"target": "cgc_like",
+                              "gen_findings_cap": cap}))
+        fz = Fuzzer(drv, output_dir=str(tmp_path / name),
+                    batch_size=B, feedback=0, generations=4)
+        fz.run(4 * B)       # exactly one dispatch
+        return fz
+
+    fz = run_with_cap("exact", top)
+    assert fz.telemetry.registry.counters.get(
+        "findings_ring_drops", 0) == 0
+    fz = run_with_cap("minus1", top - 1)
+    want = sum(r - min(r, top - 1) for r in raw)
+    assert fz.telemetry.registry.counters.get(
+        "findings_ring_drops", 0) == want
+    # the drop under-reports findings relative to the lossless run
+    assert len(_findings(str(tmp_path / "minus1"))["new_paths"]) \
+        <= len(_findings(str(tmp_path / "exact"))["new_paths"])
+
+
+# ---------------------------------------------------------------------------
+# ledger replay at dp>1 (feedback on)
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_ledger_replay_deterministic_per_shard(tmp_path):
+    """Feedback ON at dp>1: device ring admissions replay through
+    per-shard (shard, slot)-keyed mirrors in shard order, so two
+    identical campaigns produce the same findings set AND the same
+    shard-ordered ring_admit stream — the replay is independent of
+    drain interleaving.  Every admission lands as a real corpus-store
+    entry and arms stay duplicate-free."""
+    def run(name):
+        drv, _ = _mesh_driver(batch=256)
+        fz = Fuzzer(drv, output_dir=str(tmp_path / name),
+                    batch_size=256, feedback=8, generations=4,
+                    corpus_dir=str(tmp_path / name / "corpus"))
+        fz.run(2048)
+        evs = [json.loads(l) for l in
+               open(tmp_path / name / "events.jsonl") if l.strip()]
+        admits = [(e["shard"], e["slot"], e["gen"], e["md5"],
+                   e["parent"])
+                  for e in evs if e["type"] == "ring_admit"]
+        return fz, admits
+
+    fz1, admits1 = run("a")
+    fz2, admits2 = run("b")
+    assert admits1, "device rings never admitted on cgc_like"
+    assert admits1 == admits2
+    assert _findings(str(tmp_path / "a")) == \
+        _findings(str(tmp_path / "b"))
+    assert {s for s, *_ in admits1} == {0, 1, 2, 3}, \
+        "not every dp shard admitted"
+    for _, slot, _, md5, _ in admits1:
+        assert slot >= 1                    # slot 0 stays pinned
+        assert (tmp_path / "a" / "corpus" / md5).exists()
+    md5s = [getattr(a, "md5", None) for a in fz1.scheduler.arms]
+    assert len(md5s) == len(set(md5s))
+
+
+def test_dp1_mesh_generations_drains_through_shard_view(tmp_path):
+    """REGRESSION: a dp=1 mesh outcome still carries the leading dp
+    axis on every ring/ledger field — the drain must go through the
+    shard(0) view, not treat it as a single-chip outcome (which
+    indexed the dp axis and crashed on the first interesting
+    lane)."""
+    instr = instrumentation_factory("jit_harness",
+                                    '{"target": "cgc_like"}')
+    mut = mutator_factory("havoc", '{"seed": 11}', SEED)
+    drv = ShardedCampaignDriver("1,2", instr, mut, batch_size=B)
+    fz = Fuzzer(drv, output_dir=str(tmp_path / "o"), batch_size=B,
+                feedback=8, generations=4,
+                corpus_dir=str(tmp_path / "o" / "corpus"))
+    fz.run(8 * B)
+    assert fz.stats.iterations == 8 * B
+    assert not fz._gen_warned
+    assert fz.stats.new_paths > 0, "nothing drained — vacuous"
+
+
+# ---------------------------------------------------------------------------
+# kb-timeline: per-shard occupancy (acceptance artifact at mesh scale)
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_reports_per_shard_generation_occupancy(tmp_path):
+    """A dp>1 --generations --trace campaign yields a kb-timeline
+    generations section with one row per dp shard (dispatch and
+    generation totals + occupancy over the generation window) and a
+    device-bound verdict — ROADMAP item 1's acceptance artifact, now
+    at mesh scale."""
+    from killerbeez_tpu.tools.timeline_tool import build_report
+
+    drv, _ = _mesh_driver(batch=256)
+    fz = Fuzzer(drv, output_dir=str(tmp_path / "o"), batch_size=256,
+                feedback=0, generations=4, trace=65536)
+    fz.run(4096)
+    doc = json.load(open(tmp_path / "o" / "trace.json"))
+    report = build_report(doc, None, None)
+    gr = report.get("generations")
+    assert gr and gr["dispatches"] >= 2
+    assert gr["n_shards"] == 4
+    assert set(gr["shards"]) == {"0", "1", "2", "3"}
+    for sd in gr["shards"].values():
+        assert sd["dispatches"] == gr["dispatches"]
+        assert sd["generations_total"] == gr["generations_total"]
+        assert sd["occupancy"] > 0.5
+    assert gr["device_bound"], (
+        f"host stages on the critical path: device "
+        f"{gr['device_occupancy']:.1%} vs host "
+        f"{gr['host_occupancy']:.1%}")

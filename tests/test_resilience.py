@@ -738,6 +738,35 @@ def test_supervisor_device_loss_probe_and_mesh_degrade(tmp_path):
                for r in recs)
 
 
+def test_supervisor_mesh_degrade_preserves_generations_argv(tmp_path):
+    """Satellite (ISSUE 10): a mesh dp-shrink after device loss must
+    rebuild the child argv with -G/--generations (and every other
+    flag) intact, and pick a dp that still DIVIDES the batch — the
+    sharded driver rejects -b % dp at startup, so a merely-fitting
+    dp would crash-loop the restart."""
+    argv = ["-o", str(tmp_path / "out"), "--mesh", "6,1",
+            "-b", "96", "-G", "8", "-fb", "0"]
+    sup = Supervisor(argv, child_cmd=_stub_child(tmp_path, [87, 0]),
+                     probe_cmd="echo 4", backoff_base=0.01,
+                     backoff_cap=0.02)
+    assert sup.run() == 0
+    launches = _launches(tmp_path)
+    rebuilt = launches[1]
+    i = rebuilt.index("--mesh")
+    # 6 chips -> 4 alive: dp=4 fits but 96 % 4 == 0 too; the pick
+    # must divide the batch (96 % 4 == 0 -> "4,1")
+    assert rebuilt[i + 1] == "4,1"
+    assert rebuilt[rebuilt.index("-G") + 1] == "8"   # preserved
+    assert rebuilt[rebuilt.index("-b") + 1] == "96"
+    assert "--resume" in rebuilt
+    # a divisor-hostile chip count skips the non-divisor: 5 alive
+    # with -b 96 must land dp=4 (96 % 5 != 0), not dp=5
+    assert shrink_mesh("6,1", 5, batch=96) == "4,1"
+    assert shrink_mesh("6,1", 5) == "5,1"       # batch unknown: fit
+    assert shrink_mesh("4,2", 4, batch=64) == "2,2"
+    assert shrink_mesh("4,2", 1, batch=64) is None
+
+
 def test_supervisor_native_fallback_when_no_device_returns(tmp_path):
     fallback = f"stdin return_code havoc -o {tmp_path / 'out'}"
     sup = Supervisor(["-o", str(tmp_path / "out")],

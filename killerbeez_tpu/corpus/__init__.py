@@ -20,10 +20,20 @@ findings.  This package makes the corpus first-class:
     edge-novel entries to ``/api/corpus/<campaign>`` and periodically
     pull peers' entries into their local store (coverage-hash dedup,
     heartbeat-style retry/backoff).
+  * ``gossip.py``   — peer-to-peer corpus gossip: every worker serves
+    its own entries behind the same cursor API (``GossipSidecar``)
+    and pulls a random fanout of peers each round, with the manager
+    demoted to peer directory + anti-entropy backstop — a dead hub
+    no longer stops corpus flow.
+  * ``quarantine.py`` — the poisoned-entry gate on every synced-in
+    row: schema/size validation, ``cov_hash`` recompute, disk
+    quarantine and decorrelated-backoff peer bans.
 """
 
 from __future__ import annotations
 
+from .gossip import GossipSidecar, GossipSync
+from .quarantine import EntryValidator, PeerBans, QuarantineStore
 from .schedule import (
     Arm, BanditScheduler, RareEdgeScheduler, RoundRobinScheduler,
     SCHEDULERS, Scheduler, make_scheduler,
@@ -33,6 +43,8 @@ from .sync import CorpusSync
 
 __all__ = [
     "Arm", "BanditScheduler", "CorpusEntry", "CorpusStore",
-    "CorpusSync", "RareEdgeScheduler", "RoundRobinScheduler",
-    "SCHEDULERS", "Scheduler", "make_scheduler",
+    "CorpusSync", "EntryValidator", "GossipSidecar", "GossipSync",
+    "PeerBans", "QuarantineStore", "RareEdgeScheduler",
+    "RoundRobinScheduler", "SCHEDULERS", "Scheduler",
+    "make_scheduler",
 ]

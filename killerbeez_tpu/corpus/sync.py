@@ -22,6 +22,15 @@ is not hit by the whole fleet in interval-lockstep, and the
 ``sync_consecutive_failures`` gauge tells kb-fleet's stall alert
 "partitioned" apart from "plateaued".  Everything degrades to
 warnings — corpus sync must never stall or kill the fuzzing loop.
+
+Every PULLED row passes the poisoned-entry quarantine
+(``quarantine.EntryValidator``) before admission: schema and size
+caps, ``cov_hash`` recomputed and compared, optional re-execution.
+Failures land in ``<corpus>/quarantine/`` and the
+``sync_quarantined`` counter — a corrupt manager row (or, on the
+gossip path, a lying peer) can never crash a worker or poison its
+rotation.  ``gossip.GossipSync`` extends this client with the
+peer-to-peer exchange tier.
 """
 
 from __future__ import annotations
@@ -30,9 +39,10 @@ import base64
 import contextlib
 import random
 import time
-from typing import Any, Dict, List, Optional, Set
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ..utils.logging import DEBUG_MSG, WARNING_MSG
+from .quarantine import EntryValidator, QuarantineStore
 from .schedule import Arm
 from .store import CorpusEntry
 
@@ -44,10 +54,23 @@ class CorpusSync:
     def __init__(self, manager_url: str, campaign: str,
                  worker: str = "anon", interval_s: float = 30.0,
                  attempts: int = 1, backoff_cap: Optional[float] = None,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 validator: Optional[EntryValidator] = None):
         self.url = f"{manager_url.rstrip('/')}/api/corpus/{campaign}"
+        self.manager_url = manager_url.rstrip("/")
         self.campaign = str(campaign)
         self.worker = worker
+        #: poisoned-entry gate on every pulled row (default on;
+        #: ``validator=False`` disables — raw-transport tests only)
+        if validator is None:
+            validator = EntryValidator()
+        self.validator = validator or None
+        #: rejected rows from the LAST pull: [(buf|None, reason,
+        #: peer|None)] — the sync round writes them to quarantine +
+        #: counters and strikes the offending peer
+        self._quarantined_round: List[
+            Tuple[Optional[bytes], str, Optional[str]]] = []
+        self.quarantined_n = 0
         self.interval_s = float(interval_s)
         self.attempts = int(attempts)
         self._last_sync = 0.0
@@ -76,6 +99,10 @@ class CorpusSync:
         """The loop hands every admitted entry here at triage time;
         the next sync round pushes it.  O(1) — no store rescans."""
         self._pending.append(entry)
+
+    def close(self) -> None:
+        """Release any transport resources (the gossip subclass shuts
+        its sidecar down here); the manager-only client holds none."""
 
     # -- transport (heartbeat discipline) -------------------------------
 
@@ -108,6 +135,13 @@ class CorpusSync:
                 "meta": entry.meta_dict(),
             })
         except urllib.error.HTTPError as e:
+            if getattr(e, "code", None) == 503:
+                # write-degraded manager: "try again later", NOT a
+                # rejection — dropping the entry here would lose it
+                # from sync forever once the manager recovers
+                WARNING_MSG("corpus push to %s deferred (manager "
+                            "degraded): %s", self.url, e)
+                return None
             WARNING_MSG("corpus push rejected by %s (%s): dropping "
                         "entry %s from sync", self.url, e, entry.md5)
             self._pushed.add(entry.cov_hash)    # never retried
@@ -123,10 +157,60 @@ class CorpusSync:
 
     # -- pull -----------------------------------------------------------
 
+    def _entries_from_rows(self, rows: Any,
+                           peer: Optional[str] = None
+                           ) -> List[CorpusEntry]:
+        """Exchange rows -> validated, locally-unseen entries.  Rows
+        the validator rejects go to ``_quarantined_round`` (the sync
+        round writes them to the quarantine store, bumps counters and
+        strikes the peer) instead of ever reaching admission."""
+        out: List[CorpusEntry] = []
+        if not isinstance(rows, list):
+            self._quarantined_round.append(
+                (None, "schema:entries-not-a-list", peer))
+            return out
+        for row in rows:
+            cov = row.get("cov_hash", "") \
+                if isinstance(row, dict) else ""
+            if cov and cov in self._pushed:
+                continue                 # already have this frontier
+            if self.validator is not None:
+                entry, reason = self.validator.validate(row)
+                if entry is None:
+                    buf = None
+                    if isinstance(row, dict) and \
+                            isinstance(row.get("content_b64"), str):
+                        try:
+                            buf = base64.b64decode(
+                                row["content_b64"][: (8 << 20)])
+                        except Exception:
+                            buf = None
+                    self._quarantined_round.append((buf, reason, peer))
+                    continue
+            else:
+                try:
+                    buf = base64.b64decode(row["content_b64"])
+                except (KeyError, TypeError, ValueError):
+                    continue
+                meta = dict(row.get("meta") or {})
+                meta.setdefault("md5", row.get("md5"))
+                entry = CorpusEntry.from_meta(buf, meta)
+            entry.source = "sync"
+            # NOT added to _pushed here: only an ADMITTED foreign
+            # entry is excluded from pushing (_admit_entries).  An
+            # entry we authored ourselves can gossip back to us
+            # before we ever reached the manager (hub down, peers
+            # re-serving what they learned) — marking it known here
+            # would mean NOBODY ever pushes it and the recovered
+            # manager misses it forever.
+            out.append(entry)
+        return out
+
     def pull(self) -> Optional[List[CorpusEntry]]:
         """GET peers' entries newer than the cursor; returns the new
-        (locally unseen, not self-authored) ones — None on transport
-        failure (the round counts as failed and backs off)."""
+        (locally unseen, not self-authored, validator-clean) ones —
+        None on transport failure (the round counts as failed and
+        backs off)."""
         from urllib.parse import quote
         try:
             resp = self._request(
@@ -138,22 +222,12 @@ class CorpusSync:
             return None
         if not resp:
             return []
-        self._cursor = max(self._cursor, int(resp.get("latest", 0)))
-        out: List[CorpusEntry] = []
-        for row in resp.get("entries", []):
-            cov = row.get("cov_hash", "")
-            if cov in self._pushed:
-                continue                 # already have this frontier
-            self._pushed.add(cov)        # don't push it back either
-            try:
-                buf = base64.b64decode(row["content_b64"])
-            except (KeyError, ValueError):
-                continue
-            meta = row.get("meta") or {}
-            meta.setdefault("md5", row.get("md5"))
-            meta["source"] = "sync"
-            out.append(CorpusEntry.from_meta(buf, meta))
-        return out
+        try:
+            self._cursor = max(self._cursor,
+                               int(resp.get("latest", 0)))
+        except (TypeError, ValueError):
+            pass                         # hostile latest: keep cursor
+        return self._entries_from_rows(resp.get("entries", []))
 
     # -- loop hook ------------------------------------------------------
 
@@ -223,21 +297,11 @@ class CorpusSync:
             if got is None:
                 failed = True
                 got = []
-            for e in got:
-                if e.md5 in fuzzer._seen["new_paths"]:
-                    continue        # already local (e.g. post-resume)
-                pulled += 1
-                self.pulled_n += 1
-                if fuzzer.store is not None:
-                    e.seq = fuzzer.store.next_seq()
-                    fuzzer.store.put(e)
-                # a pulled entry is a known path now: don't re-record
-                # it as a local finding if this worker reproduces it
-                fuzzer._seen["new_paths"].add(e.md5)
-                if fuzzer.feedback:
-                    fuzzer.scheduler.admit(Arm.from_entry(e))
-                DEBUG_MSG("corpus sync: pulled %s from %s", e.md5,
-                          e.parent or "peer")
+            pulled = self._admit_entries(fuzzer, got)
+        # the gossip tier (peer fanout pulls) rides the same round;
+        # its transport failures back off PEERS, never the round
+        self._peer_round(fuzzer, reg)
+        self._flush_quarantine(fuzzer, reg)
         # per-round deltas: restored cumulative counters (--resume)
         # keep counting up instead of snapping to process-local totals
         if sent:
@@ -265,3 +329,52 @@ class CorpusSync:
             "sync_round", pushed=int(sent), pulled=int(pulled),
             transport_failed=bool(failed))
         return True
+
+    # -- shared admission / quarantine plumbing -------------------------
+
+    def _admit_entries(self, fuzzer, entries: List[CorpusEntry]) -> int:
+        """Fold validated pulled entries into the local store,
+        dedup set and rotation; returns how many were new here."""
+        admitted = 0
+        for e in entries:
+            if e.md5 in fuzzer._seen["new_paths"]:
+                continue            # already local (e.g. post-resume)
+            self._pushed.add(e.cov_hash)    # foreign: never push back
+            admitted += 1
+            self.pulled_n += 1
+            if fuzzer.store is not None:
+                e.seq = fuzzer.store.next_seq()
+                fuzzer.store.put(e)
+            # a pulled entry is a known path now: don't re-record
+            # it as a local finding if this worker reproduces it
+            fuzzer._seen["new_paths"].add(e.md5)
+            if fuzzer.feedback:
+                fuzzer.scheduler.admit(Arm.from_entry(e))
+            DEBUG_MSG("corpus sync: pulled %s from %s", e.md5,
+                      e.parent or "peer")
+        return admitted
+
+    def _peer_round(self, fuzzer, reg) -> None:
+        """Gossip hook — the manager-only client has no peers."""
+
+    def _flush_quarantine(self, fuzzer, reg) -> None:
+        """Write the round's rejected rows to the quarantine store
+        (when a durable corpus exists) and count them; subclasses
+        strike the offending peers here too."""
+        if not self._quarantined_round:
+            return
+        batch = self._quarantined_round
+        self._quarantined_round = []
+        qstore = (QuarantineStore(fuzzer.store.root)
+                  if fuzzer.store is not None else None)
+        for buf, reason, peer in batch:
+            self.quarantined_n += 1
+            who = peer or "manager"
+            WARNING_MSG("corpus sync: quarantined entry from %s "
+                        "(%s)", who, reason)
+            if qstore is not None and buf:
+                qstore.put(buf, reason, peer=who)
+        reg.count("sync_quarantined", len(batch))
+        fuzzer.telemetry.event(
+            "sync_quarantine", n=len(batch),
+            reasons=sorted({r for _, r, _ in batch}))

@@ -96,6 +96,35 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sync-interval", type=float, default=30.0,
                    help="seconds between corpus sync rounds "
                         "(default 30)")
+    p.add_argument("--gossip", type=int, nargs="?", const=0,
+                   default=None, metavar="PORT",
+                   help="peer-to-peer corpus gossip (requires "
+                        "--sync-manager): serve this worker's corpus "
+                        "on PORT (0 = ephemeral, the bare default) "
+                        "and pull a random fanout of peers each sync "
+                        "round, with the manager demoted to peer "
+                        "directory + anti-entropy backstop — a dead "
+                        "or partitioned manager no longer stops "
+                        "corpus flow.  Synced-in entries are "
+                        "validated (schema/size/cov_hash) and "
+                        "quarantined to <corpus>/quarantine/ on "
+                        "failure; peers crossing the poison "
+                        "threshold are banned with decorrelated "
+                        "backoff (docs/MANAGER.md)")
+    p.add_argument("--gossip-fanout", type=int, default=2,
+                   metavar="N",
+                   help="peers pulled per gossip round (default 2)")
+    p.add_argument("--gossip-host", default="127.0.0.1",
+                   metavar="ADDR",
+                   help="address the gossip sidecar binds (default "
+                        "127.0.0.1 = loopback-only; multi-host "
+                        "fleets need 0.0.0.0 or the NIC address, "
+                        "usually with --gossip-advertise)")
+    p.add_argument("--gossip-advertise", metavar="URL",
+                   help="URL peers should reach this worker's "
+                        "sidecar at (default: its bind address; set "
+                        "when NAT or 0.0.0.0 binds make that "
+                        "unreachable/ambiguous)")
     p.add_argument("--crack", type=int, nargs="?", const=16, default=0,
                    metavar="N",
                    help="plateau crack stage (KBVM device targets): "
@@ -382,16 +411,32 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.resume and not corpus_dir:
             corpus_dir = os.path.join(args.output, "corpus")
         sync = None
+        if args.gossip is not None and not args.sync_manager:
+            print("error: --gossip needs --sync-manager (the peer "
+                  "directory lives there)", file=sys.stderr)
+            return 2
         if args.sync_manager:
             if not args.sync_campaign:
                 print("error: --sync-manager needs --sync-campaign",
                       file=sys.stderr)
                 return 2
-            from ..corpus.sync import CorpusSync
-            sync = CorpusSync(args.sync_manager, args.sync_campaign,
-                              worker=(args.sync_worker
-                                      or f"worker-{os.getpid()}"),
-                              interval_s=args.sync_interval)
+            worker_name = args.sync_worker or f"worker-{os.getpid()}"
+            if args.gossip is not None:
+                from ..corpus.gossip import GossipSync
+                sync = GossipSync(args.sync_manager,
+                                  args.sync_campaign,
+                                  worker=worker_name,
+                                  interval_s=args.sync_interval,
+                                  fanout=args.gossip_fanout,
+                                  listen_host=args.gossip_host,
+                                  listen_port=args.gossip,
+                                  advertise=args.gossip_advertise)
+            else:
+                from ..corpus.sync import CorpusSync
+                sync = CorpusSync(args.sync_manager,
+                                  args.sync_campaign,
+                                  worker=worker_name,
+                                  interval_s=args.sync_interval)
 
         watchdog = None
         if args.watchdog > 0:
@@ -470,6 +515,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.mutator_state_dump:
             write_buffer_to_file(args.mutator_state_dump,
                                  mutator.get_state().encode())
+        if sync is not None:
+            sync.close()        # gossip sidecar stops serving
         driver.cleanup()
         instrumentation.cleanup()
         mutator.cleanup()

@@ -37,7 +37,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8650)
     p.add_argument("--db", default=":memory:",
-                   help="sqlite path (default in-memory)")
+                   help="sqlite path (default in-memory); file-backed "
+                        "DBs run WAL + busy-timeout with locked-write "
+                        "retry")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="write-ahead admission journal path (default "
+                        "<db>.journal for file-backed DBs, off for "
+                        "in-memory): corpus/event POSTs are "
+                        "journaled+fsynced before the DB write and "
+                        "replayed on restart, so a manager SIGKILL "
+                        "loses zero ACKed admissions and a failed DB "
+                        "write degrades to journal-backed read-only "
+                        "mode instead of 500ing the fleet")
     p.add_argument("--seed", action="store_true",
                    help="insert demo rows before serving")
     fl = p.add_argument_group(
@@ -100,7 +111,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         crash_spike_window=args.crash_spike_window,
         drops_window=args.drops_window,
         retire_after=args.retire_after)
-    server = ManagerServer(args.host, args.port, args.db, fleet=fleet)
+    server = ManagerServer(args.host, args.port, args.db, fleet=fleet,
+                           journal_path=args.journal)
     if args.seed:
         seed_demo_rows(server)
     try:

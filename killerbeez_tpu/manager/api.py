@@ -32,6 +32,20 @@ plus the work-queue routes that replace BOINC's scheduler
     POST /api/events/<campaign>      {worker, events} -> {stored}
     GET  /api/events/<campaign>?since=<id>
                                      -> {events, latest}
+    POST /api/peers/<campaign>       {worker, endpoint} -> {peers}
+                                     (gossip registration + directory
+                                      in one round trip)
+    GET  /api/peers/<campaign>[?exclude=] -> {peers}
+    GET  /api/health                 -> {ok, degraded, journal}
+
+Durability: admission POSTs (corpus, events) append to the
+write-ahead journal BEFORE the DB write and the journal replays on
+restart, so a manager SIGKILL between ACK and commit loses nothing.
+A DB write failure (ENOSPC, lock convoy) flips the manager into
+READ-ONLY DEGRADED mode: cursor GETs keep serving, journal-backed
+admission POSTs still ACK (``journaled: true``), and everything else
+returns 503 with ``degraded: true`` instead of tearing down the
+fleet's sync rounds.  The first successful write clears the latch.
 
 plus the fleet observatory (manager/fleet.py):
 
@@ -57,19 +71,21 @@ from urllib.parse import parse_qs, urlparse
 from ..telemetry import merge
 from ..telemetry.openmetrics import CONTENT_TYPE as _OM_CTYPE
 from ..tools.minimize import greedy_edge_cover
-from ..utils.logging import INFO_MSG
-from .db import ManagerDB
+from ..utils.logging import INFO_MSG, WARNING_MSG
+from .db import ManagerDB, ManagerWriteError
 from .fleet import (
     FleetConfig, FleetMonitor, fleet_index, fleet_view,
-    render_fleet_metrics,
+    peer_directory, render_fleet_metrics,
 )
 from .fuzzer_cmd import format_cmdline
+from .journal import AdmissionJournal
 
 
 class _Handler(BaseHTTPRequestHandler):
     db: ManagerDB  # set by ManagerServer
     fleet_config: FleetConfig
     monitor: Optional[FleetMonitor] = None
+    journal: Optional[AdmissionJournal] = None
 
     # -- plumbing -------------------------------------------------------
 
@@ -110,12 +126,41 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(404, {"error": f"no route {method} {path}"})
         except (ValueError, KeyError, json.JSONDecodeError) as e:
             self._json(400, {"error": str(e)})
+        except ManagerWriteError as e:
+            # read-only degraded mode: a failed DB write must not
+            # tear the connection down as an opaque 500 — the worker
+            # backs off on a clean, classified signal instead
+            self._json(503, {"error": f"manager write-degraded: {e}",
+                             "degraded": True})
 
     def do_GET(self):
         self._route("GET")
 
     def do_POST(self):
         self._route("POST")
+
+    def _recover_journal(self) -> None:
+        """DB writes are succeeding again: replay any journal-only
+        backlog ACKed during the degraded window NOW, not at some
+        future restart — cursor GETs must start serving those rows
+        as soon as the disk lets them land.  Gated on the one-shot
+        degraded->healthy transition (``consume_recovery``), NOT on
+        the raw uncommitted counter: that counter is transiently
+        nonzero whenever any concurrent POST sits between its append
+        and its DB write, and gating on it would re-replay the whole
+        journal inline in handler threads under perfectly healthy
+        load.  The same (lock-holding, idempotent) replay doubles as
+        compaction when the file outgrows its cap."""
+        j = self.journal
+        if j is None or self.db.degraded:
+            return
+        if not (self.db.consume_recovery() or j.needs_compact()):
+            return
+        try:
+            j.replay(self.db)
+        except Exception as e:
+            WARNING_MSG("in-process journal recovery failed "
+                        "(kept for restart): %s", e)
 
     # -- handlers -------------------------------------------------------
 
@@ -240,6 +285,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self.db.add_manager_event(campaign, "worker_returned",
                                           worker=worker,
                                           previous=prev)
+            # heartbeats are the fleet's steady write pulse: the
+            # first one to land after a degraded window drains the
+            # journal backlog even if no admission POST follows
+            self._recover_journal()
             self._json(201, {"ok": True})
             return
         rows = self.db.get_campaign_stats(campaign)
@@ -256,13 +305,37 @@ class _Handler(BaseHTTPRequestHandler):
         (deduped by coverage hash — two workers hitting the same
         frontier store one row; the duplicate POST gets
         ``new: false``), GET returns entries newer than the caller's
-        cursor so workers pull only each other's fresh findings."""
+        cursor so workers pull only each other's fresh findings.
+
+        The POST journals BEFORE the DB write (a SIGKILL between the
+        201 and the commit replays on restart), and a failed DB write
+        still ACKs off the journal alone (``journaled: true``) — the
+        ACK is the promise the fleet's reject rule depends on, so it
+        must be backed by SOMETHING durable or refused outright."""
         if self.command == "POST":
             b = self._body()
             content = base64.b64decode(b["content_b64"])
-            rid, new = self.db.add_corpus_entry(
-                campaign, b["cov_hash"], b.get("md5", ""),
-                b.get("worker", "anon"), content, b.get("meta"))
+            worker = b.get("worker", "anon")
+            journaled = (self.journal is not None and
+                         self.journal.append_corpus(
+                             campaign, b["cov_hash"],
+                             b.get("md5", ""), worker, content,
+                             b.get("meta")))
+            try:
+                rid, new = self.db.add_corpus_entry(
+                    campaign, b["cov_hash"], b.get("md5", ""),
+                    worker, content, b.get("meta"))
+            except ManagerWriteError as e:
+                if not journaled:
+                    raise               # nothing durable: honest 503
+                WARNING_MSG("corpus POST held in journal only "
+                            "(degraded): %s", e)
+                self._json(201, {"id": None, "new": True,
+                                 "journaled": True, "degraded": True})
+                return
+            if journaled:
+                self.journal.note_committed()
+            self._recover_journal()
             self._json(201 if new else 200, {"id": rid, "new": new})
             return
         since = int(query.get("since", ["0"])[0])
@@ -290,9 +363,25 @@ class _Handler(BaseHTTPRequestHandler):
         server-id cursor, mirroring ``/api/corpus`` semantics."""
         if self.command == "POST":
             b = self._body()
-            n = self.db.add_campaign_events(
-                campaign, b.get("worker", "anon"),
-                b.get("events") or [])
+            worker = b.get("worker", "anon")
+            events = b.get("events") or []
+            journaled = (self.journal is not None and
+                         self.journal.append_events(campaign, worker,
+                                                    events))
+            try:
+                n = self.db.add_campaign_events(campaign, worker,
+                                                events)
+            except ManagerWriteError as e:
+                if not journaled:
+                    raise
+                WARNING_MSG("events POST held in journal only "
+                            "(degraded): %s", e)
+                self._json(201, {"stored": len(events),
+                                 "journaled": True, "degraded": True})
+                return
+            if journaled:
+                self.journal.note_committed()
+            self._recover_journal()
             self._json(201, {"stored": n})
             return
         since = int(query.get("since", ["0"])[0])
@@ -303,6 +392,56 @@ class _Handler(BaseHTTPRequestHandler):
             "campaign": campaign,
             "latest": latest,
             "events": rows,
+        })
+
+    def h_peers(self, query, campaign):
+        """Gossip peer directory: POST registers this worker's
+        sidecar endpoint (into the SAME health registry heartbeats
+        feed — a peer is live exactly when its worker is) and returns
+        the current directory in the same response, so one round trip
+        both advertises and discovers.  GET serves the directory
+        read-only.  Dead workers drop out of the directory the same
+        way they drop out of /api/fleet."""
+        exclude = None
+        if self.command == "POST":
+            b = self._body()
+            worker = str(b.get("worker", "anon"))
+            endpoint = b.get("endpoint")
+            if not isinstance(endpoint, str) or \
+                    not endpoint.startswith("http") or \
+                    len(endpoint) > 512:
+                self._json(400, {"error": "bad gossip endpoint"})
+                return
+            exclude = worker
+            try:
+                self.db.note_fleet_worker(campaign, worker,
+                                          meta={"gossip": endpoint})
+            except ManagerWriteError as e:
+                # registration is best-effort: a write-degraded
+                # manager still serves the directory it has — the
+                # phone book must outlive the pen
+                WARNING_MSG("peer registration write failed "
+                            "(degraded): %s", e)
+        else:
+            exclude = query.get("exclude", [None])[0]
+        self._json(201 if self.command == "POST" else 200, {
+            "campaign": campaign,
+            "degraded": self.db.degraded,
+            "peers": peer_directory(self.db, self.fleet_config,
+                                    campaign, exclude=exclude),
+        })
+
+    def h_health(self, query):
+        """Liveness + degraded-mode probe (kb-fleet, load balancers,
+        the fleet-sim harness)."""
+        j = self.journal
+        self._json(200, {
+            "ok": True,
+            "degraded": self.db.degraded,
+            "write_failures": self.db.write_failures,
+            "journal": ({"appended": j.appended_n,
+                         "uncommitted": j.uncommitted}
+                        if j is not None else None),
         })
 
     # -- fleet observatory ---------------------------------------------
@@ -397,6 +536,9 @@ _ROUTES: Tuple = (
                                 "POST": _Handler.h_corpus}),
     (r"/api/events/([\w.-]+)", {"GET": _Handler.h_events,
                                 "POST": _Handler.h_events}),
+    (r"/api/peers/([\w.-]+)", {"GET": _Handler.h_peers,
+                               "POST": _Handler.h_peers}),
+    (r"/api/health", {"GET": _Handler.h_health}),
     (r"/api/fleet", {"GET": _Handler.h_fleet_index}),
     (r"/api/fleet/([\w.-]+)", {"GET": _Handler.h_fleet}),
     (r"/api/fleet/([\w.-]+)/series", {"GET": _Handler.h_fleet_series}),
@@ -413,9 +555,21 @@ class ManagerServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8650,
                  db_path: str = ":memory:",
-                 fleet: Optional[FleetConfig] = None):
+                 fleet: Optional[FleetConfig] = None,
+                 journal_path: Optional[str] = None):
         self.db = ManagerDB(db_path)
         self.fleet_config = fleet or FleetConfig()
+        # write-ahead admission journal: defaults on for file-backed
+        # DBs (<db>.journal — durability should not be opt-in), off
+        # for in-memory managers unless a path is given; REPLAYED
+        # into the DB before the first request so a SIGKILL'd
+        # manager restarts with every ACKed POST present
+        if journal_path is None and db_path != ":memory:":
+            journal_path = db_path + ".journal"
+        self.journal: Optional[AdmissionJournal] = None
+        if journal_path:
+            self.journal = AdmissionJournal(journal_path)
+            self.journal.replay(self.db)
         #: the observatory evaluator; its thread only starts with the
         #: server (monitor_interval <= 0 keeps it manual-tick-only —
         #: tests drive tick() deterministically)
@@ -423,10 +577,12 @@ class ManagerServer:
         handler = type("BoundHandler", (_Handler,),
                        {"db": self.db,
                         "fleet_config": self.fleet_config,
-                        "monitor": self.monitor})
+                        "monitor": self.monitor,
+                        "journal": self.journal})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        self._serving = False
 
     def _start_monitor(self) -> None:
         if self.fleet_config.monitor_interval > 0 \
@@ -435,6 +591,7 @@ class ManagerServer:
 
     def start(self) -> None:
         self._start_monitor()
+        self._serving = True
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
@@ -442,13 +599,20 @@ class ManagerServer:
 
     def serve_forever(self) -> None:
         self._start_monitor()
+        self._serving = True
         INFO_MSG("manager listening on :%d", self.port)
         self.httpd.serve_forever()
 
     def stop(self) -> None:
         self.monitor.stop()
-        self.httpd.shutdown()
+        if self._serving:
+            # shutdown() on a server whose serve_forever never ran
+            # blocks forever (stdlib event handshake) — a constructed-
+            # but-never-started server just closes its socket
+            self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        if self.journal is not None:
+            self.journal.close()
         self.db.close()

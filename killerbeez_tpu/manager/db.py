@@ -5,6 +5,18 @@ Config, job_inputs, instrumentation_state, tracer_info — SURVEY §2.8).
 sqlite stands in for MySQL/Postgres exactly as in the reference's test
 config (python/manager/app/config.py:2-3). The connection is
 per-thread (the REST tier serves from a thread pool).
+
+Durability posture (the degraded-mode manager): file-backed
+connections run in WAL mode with a busy timeout, every write retries
+``database is locked`` with bounded backoff (a concurrent heartbeat
+burst must not 500 a corpus POST — the worker would drop that entry
+from the round forever under PR 2's reject rule), and a write that
+STILL fails (ENOSPC, lock convoy beyond the budget) raises a typed
+:class:`ManagerWriteError` and latches ``self.degraded`` — the REST
+tier then keeps serving cursor GETs read-only instead of 500ing the
+fleet, with the admission journal (``journal.py``) holding the ACKed
+rows until writes recover.  The first successful write clears the
+latch.
 """
 
 from __future__ import annotations
@@ -15,7 +27,15 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..resilience.chaos import chaos_point
 from ..telemetry.events import SCHEMA_VERSION
+from ..utils.logging import WARNING_MSG
+
+
+class ManagerWriteError(Exception):
+    """A DB mutation failed after the retry budget — the manager is
+    write-degraded (reads keep serving; the API tier decides whether
+    the journal can still honor the POST)."""
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS targets (
@@ -139,12 +159,33 @@ CREATE TABLE IF NOT EXISTS corpus_entries (
 class ManagerDB:
     """Thread-safe sqlite wrapper; rows in/out as plain dicts."""
 
+    #: ``database is locked`` retry budget: attempts and base backoff
+    #: (exponential: 10ms, 20ms, 40ms, 80ms, 160ms — bounded, so a
+    #: true lock convoy still surfaces as ManagerWriteError instead
+    #: of wedging the request thread)
+    LOCK_RETRIES = 5
+    LOCK_BACKOFF_S = 0.01
+    #: sqlite busy handler budget (ms) — the first line of defense
+    #: against cross-process writers before our retry loop engages
+    BUSY_TIMEOUT_MS = 2000
+
     def __init__(self, path: str = ":memory:"):
         self.path = path
         self._local = threading.local()
         # in-memory DBs are per-connection; share one with a lock
         self._shared: Optional[sqlite3.Connection] = None
         self._lock = threading.Lock()
+        #: write-degraded latch: set when a mutation exhausts the
+        #: retry budget, cleared by the next successful write; the
+        #: API tier serves read-only (plus journal-backed admission
+        #: ACKs) while it is up
+        self.degraded = False
+        self.write_failures = 0
+        #: one-shot recovery signal: set when the degraded latch
+        #: CLEARS (a write succeeded after a failing window) — the
+        #: API tier consumes it to replay the journal backlog
+        #: exactly once per recovery, never on the healthy hot path
+        self.recovery_pending = False
         if path == ":memory:":
             self._shared = sqlite3.connect(":memory:",
                                            check_same_thread=False)
@@ -173,14 +214,94 @@ class ManagerDB:
         if conn is None:
             conn = sqlite3.connect(self.path)
             conn.row_factory = sqlite3.Row
+            # durability pragmas (file-backed only): WAL lets cursor
+            # GETs read while a write commits, NORMAL sync is safe
+            # under WAL (a power cut loses at most the last commit,
+            # which the admission journal replays), and the busy
+            # handler absorbs cross-process lock contention before
+            # our own retry loop has to
+            try:
+                conn.execute("PRAGMA journal_mode=WAL")
+                conn.execute("PRAGMA synchronous=NORMAL")
+                conn.execute(
+                    f"PRAGMA busy_timeout={self.BUSY_TIMEOUT_MS}")
+            except sqlite3.Error as e:
+                WARNING_MSG("sqlite pragma setup failed: %s", e)
             self._local.conn = conn
         return conn
+
+    def _write(self, conn: sqlite3.Connection, sql: str,
+               params: tuple = ()) -> sqlite3.Cursor:
+        """One mutation through the degraded-mode seam: chaos point,
+        bounded ``database is locked`` retry, ManagerWriteError +
+        degraded latch on exhaustion, latch cleared on success.
+        Caller holds ``self._lock`` and commits."""
+        last: Optional[Exception] = None
+        try:
+            # chaos seam: the manager write path — enospc/raise here
+            # is how tests drive the manager into (and out of)
+            # degraded mode; the url context is the statement head
+            # ("INSERT INTO corpus_entries"), so ``match`` can scope
+            # a fault to one table's writes
+            chaos_point("manager_db_write",
+                        url=" ".join(sql.split()[:3]))
+        except Exception as e:          # injected fault = failed write
+            last = e
+        for attempt in range(self.LOCK_RETRIES if last is None else 0):
+            try:
+                cur = conn.execute(sql, params)
+                if self.degraded:
+                    self.degraded = False
+                    self.recovery_pending = True
+                return cur
+            except sqlite3.OperationalError as e:
+                msg = str(e).lower()
+                if "locked" not in msg and "busy" not in msg:
+                    last = e
+                    break
+                last = e
+                time.sleep(self.LOCK_BACKOFF_S * (2 ** attempt))
+            except (sqlite3.Error, OSError) as e:
+                last = e
+                break
+        self.degraded = True
+        self.write_failures += 1
+        try:
+            conn.rollback()     # never leave an open write txn on a
+        except sqlite3.Error:   # shared connection
+            pass
+        raise ManagerWriteError(str(last))
+
+    def consume_recovery(self) -> bool:
+        """One-shot: True exactly once after a degraded->healthy
+        transition (the caller then replays the journal backlog)."""
+        if self.recovery_pending and not self.degraded:
+            self.recovery_pending = False
+            return True
+        return False
+
+    def _commit(self, conn: sqlite3.Connection) -> None:
+        """Commit through the degraded seam: under WAL a disk-full
+        or busy failure can surface at COMMIT time (appending the
+        -wal file), not at execute — it must latch degraded and
+        raise the typed error just like a failed execute, or the
+        fleet sees raw 500s instead of the journal-backed 503."""
+        try:
+            conn.commit()
+        except (sqlite3.Error, OSError) as e:
+            self.degraded = True
+            self.write_failures += 1
+            try:
+                conn.rollback()
+            except sqlite3.Error:
+                pass
+            raise ManagerWriteError(str(e))
 
     def _exec(self, sql: str, params: tuple = ()) -> sqlite3.Cursor:
         with self._lock:
             conn = self._conn()
-            cur = conn.execute(sql, params)
-            conn.commit()
+            cur = self._write(conn, sql, params)
+            self._commit(conn)
             return cur
 
     def _rows(self, sql: str, params: tuple = ()) -> List[Dict[str, Any]]:
@@ -276,11 +397,12 @@ class ManagerDB:
                 "ORDER BY id LIMIT 1").fetchone()
             if row is None:
                 return None
-            conn.execute(
+            self._write(
+                conn,
                 "UPDATE jobs SET status='claimed', assigned_to=?, "
                 "claimed=? WHERE id=?",
                 (worker, time.time(), row["id"]))
-            conn.commit()
+            self._commit(conn)
             job = conn.execute("SELECT * FROM jobs WHERE id=?",
                                (row["id"],)).fetchone()
             return dict(job)
@@ -385,10 +507,23 @@ class ManagerDB:
         with self._lock:
             conn = self._conn()
             row = conn.execute(
-                "SELECT status FROM fleet_workers WHERE campaign=? "
-                "AND worker=?", (str(campaign), worker)).fetchone()
+                "SELECT status, meta FROM fleet_workers WHERE "
+                "campaign=? AND worker=?",
+                (str(campaign), worker)).fetchone()
             prev = row["status"] if row is not None else None
-            conn.execute(
+            # meta MERGES per key instead of replacing wholesale: the
+            # gossip tier registers {"gossip": endpoint} through
+            # /api/peers while heartbeats register {pid, host} — the
+            # later writer must not clobber the other's keys
+            if meta is not None and row is not None and row["meta"]:
+                try:
+                    old = json.loads(row["meta"])
+                    if isinstance(old, dict):
+                        meta = {**old, **meta}
+                except ValueError:
+                    pass
+            self._write(
+                conn,
                 "INSERT INTO fleet_workers (campaign, worker, "
                 "first_seen, last_seen, beats, status, meta) "
                 "VALUES (?,?,?,?,1,'healthy',?) "
@@ -398,7 +533,7 @@ class ManagerDB:
                 "meta=COALESCE(excluded.meta, meta)",
                 (str(campaign), worker, now, now,
                  json.dumps(meta) if meta is not None else None))
-            conn.commit()
+            self._commit(conn)
         return prev
 
     def get_fleet_workers(self, campaign: Optional[str] = None
@@ -449,18 +584,20 @@ class ManagerDB:
         forever; fleet_series keeps the campaign's history."""
         with self._lock:
             conn = self._conn()
-            cur = conn.execute(
+            cur = self._write(
+                conn,
                 "DELETE FROM fleet_workers WHERE last_seen < ?",
                 (float(cutoff),))
             # snapshots follow the registry: a worker with no
             # registry row left has retired (any live worker's next
             # heartbeat re-registers it immediately)
-            conn.execute(
+            self._write(
+                conn,
                 "DELETE FROM campaign_stats WHERE NOT EXISTS "
                 "(SELECT 1 FROM fleet_workers fw WHERE "
                 "fw.campaign=campaign_stats.campaign AND "
                 "fw.worker=campaign_stats.worker)")
-            conn.commit()
+            self._commit(conn)
             return cur.rowcount
 
     def fleet_campaigns(self) -> List[str]:
@@ -541,7 +678,8 @@ class ManagerDB:
                     seq, t = int(e["seq"]), float(e.get("t", 0.0))
                 except (TypeError, ValueError):
                     continue             # malformed record: skip
-                cur = conn.execute(
+                cur = self._write(
+                    conn,
                     "INSERT INTO campaign_events (campaign, worker, "
                     "seq, t, type, payload, created) "
                     "VALUES (?,?,?,?,?,?,?) "
@@ -551,7 +689,7 @@ class ManagerDB:
                      str(e.get("type", "")), json.dumps(e),
                      time.time()))
                 stored += cur.rowcount
-            conn.commit()
+            self._commit(conn)
         return stored
 
     #: pseudo-worker name for manager-origin records (health
@@ -577,14 +715,15 @@ class ManagerDB:
             rec: Dict[str, Any] = {"v": SCHEMA_VERSION, "seq": seq,
                                    "t": now, "type": str(etype)}
             rec.update(fields)
-            conn.execute(
+            self._write(
+                conn,
                 "INSERT INTO campaign_events (campaign, worker, seq, "
                 "t, type, payload, created) VALUES (?,?,?,?,?,?,?) "
                 "ON CONFLICT(campaign, worker, seq, t) DO NOTHING",
                 (str(campaign), self.MANAGER_WORKER, seq, float(now),
                  str(etype), json.dumps(rec, default=str),
                  time.time()))
-            conn.commit()
+            self._commit(conn)
         return rec
 
     def get_campaign_events(self, campaign: str, since_id: int = 0
@@ -622,14 +761,15 @@ class ManagerDB:
         Returns (row id, stored_as_new)."""
         with self._lock:
             conn = self._conn()
-            cur = conn.execute(
+            cur = self._write(
+                conn,
                 "INSERT INTO corpus_entries (campaign, cov_hash, md5, "
                 "worker, content, meta, created) VALUES (?,?,?,?,?,?,?) "
                 "ON CONFLICT(campaign, cov_hash) DO NOTHING",
                 (str(campaign), cov_hash, md5, worker, content,
                  json.dumps(meta) if meta is not None else None,
                  time.time()))
-            conn.commit()
+            self._commit(conn)
             if cur.rowcount:
                 return cur.lastrowid, True
             row = conn.execute(

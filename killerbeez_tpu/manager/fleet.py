@@ -394,9 +394,47 @@ def worker_stats_summary(snap: Dict[str, Any]) -> Dict[str, Any]:
         "corpus_seen": int(g.get("corpus_seen",
                                  g.get("corpus_size", 0))),
         "findings_ring_drops": int(c.get("findings_ring_drops", 0)),
+        # partition-tolerance row: quarantined synced-in entries,
+        # gossip flow and the worker's current/lifetime peer bans —
+        # the fleet-chaos CI lane asserts on these via kb-fleet --json
+        "sync_quarantined": int(c.get("sync_quarantined", 0)),
+        "gossip_entries_in": int(c.get("gossip_entries_in", 0)),
+        "gossip_entries_out": int(c.get("gossip_entries_out", 0)),
+        "peers_banned": int(c.get("peers_banned", 0)),
+        "peers_banned_active": int(g.get("peers_banned_active", 0)),
         "execs_per_sec": float(d.get("execs_per_sec", 0.0)),
         "execs_per_sec_ema": float(d.get("execs_per_sec_ema", 0.0)),
     }
+
+
+def peer_directory(db, cfg: FleetConfig, campaign: str,
+                   exclude: Optional[str] = None,
+                   now: Optional[float] = None
+                   ) -> List[Dict[str, Any]]:
+    """``/api/peers/<campaign>``: every NON-DEAD worker that has
+    registered a gossip endpoint.  Liveness rides the same health
+    registry as /api/fleet — the directory and the observatory can
+    never disagree about who is alive.  EXCEPT while the manager is
+    write-degraded: heartbeat writes are failing, so last_seen is
+    frozen fleet-wide and the liveness classification is stale — the
+    directory then serves every registered endpoint rather than
+    falsely reading the whole fleet dead."""
+    now = time.time() if now is None else now
+    frozen = bool(getattr(db, "degraded", False))
+    out: List[Dict[str, Any]] = []
+    for row in db.get_fleet_workers(campaign):
+        meta = row.get("meta")
+        endpoint = meta.get("gossip") if isinstance(meta, dict) \
+            else None
+        if not endpoint or row["worker"] == exclude:
+            continue
+        status = classify(max(0.0, now - row["last_seen"]), cfg)
+        if status == DEAD and not frozen:
+            continue
+        out.append({"worker": row["worker"], "endpoint": endpoint,
+                    "status": status,
+                    "last_seen": row["last_seen"]})
+    return out
 
 
 def fleet_view(db, cfg: FleetConfig, campaign: str,
@@ -472,7 +510,8 @@ def fleet_index(db, cfg: FleetConfig,
             counts[classify(max(0.0, now - row["last_seen"]),
                             cfg)] += 1
         out[campaign] = {"n_workers": len(rows), **counts}
-    return {"t": now, "campaigns": out}
+    return {"t": now, "campaigns": out,
+            "degraded": bool(getattr(db, "degraded", False))}
 
 
 def render_fleet_metrics(db, cfg: FleetConfig,
@@ -486,6 +525,10 @@ def render_fleet_metrics(db, cfg: FleetConfig,
     ``kbz_alert_active`` per alert rule."""
     now = time.time() if now is None else now
     fams = new_families()
+    add_gauge(fams, "kbz_manager_degraded",
+              1.0 if getattr(db, "degraded", False) else 0.0,
+              help_text="1 = DB writes failing; manager serving "
+                        "read-only off the admission journal")
     by_campaign = _workers_by_campaign(db)
     for campaign in db.fleet_campaigns():
         labels_c = {"campaign": campaign}

@@ -356,7 +356,8 @@ def assimilate(manager_url: str, job: Dict[str, Any],
 def run_job(manager_url: str, job: Dict[str, Any],
             in_process: bool = False, worker_name: str = "anon",
             heartbeat_s: float = 5.0,
-            corpus_sync_s: float = 10.0) -> str:
+            corpus_sync_s: float = 10.0,
+            gossip: bool = False) -> str:
     """Execute one claimed job; returns 'done' or 'failed'.  While
     the fuzzer runs, a heartbeat thread tails its stats.jsonl and
     POSTs progress snapshots to the manager (campaign key = job id),
@@ -375,6 +376,11 @@ def run_job(manager_url: str, job: Dict[str, Any],
                      "--sync-campaign", str(job["id"]),
                      "--sync-worker", worker_name,
                      "--sync-interval", str(corpus_sync_s)]
+            if gossip:
+                # peer-to-peer corpus gossip (ephemeral sidecar
+                # port; the fuzzer registers it with the manager's
+                # peer directory) — docs/MANAGER.md
+                argv += ["--gossip", "0"]
         hb = Heartbeat(manager_url, str(job["id"]), worker_name,
                        out_dir, interval=heartbeat_s)
         hb.start()
@@ -398,7 +404,8 @@ def run_job(manager_url: str, job: Dict[str, Any],
 
 def work_loop(manager_url: str, worker_name: str, once: bool = False,
               poll_s: float = 2.0, in_process: bool = False,
-              corpus_sync_s: float = 10.0) -> int:
+              corpus_sync_s: float = 10.0,
+              gossip: bool = False) -> int:
     """Claim-run-report until the queue drains (once) or forever."""
     done = 0
     while True:
@@ -412,7 +419,8 @@ def work_loop(manager_url: str, worker_name: str, once: bool = False,
         try:
             status = run_job(manager_url, job, in_process=in_process,
                              worker_name=worker_name,
-                             corpus_sync_s=corpus_sync_s)
+                             corpus_sync_s=corpus_sync_s,
+                             gossip=gossip)
         except Exception as e:  # job must not wedge the worker
             WARNING_MSG("job %s failed: %s", job.get("id"), e)
             status = "failed"
@@ -435,6 +443,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="seconds between fleet corpus-sync rounds "
                         "through /api/corpus/<job id> (0 disables; "
                         "default 10)")
+    p.add_argument("--gossip", action="store_true",
+                   help="run each job with peer-to-peer corpus "
+                        "gossip (--gossip on the fuzzer: sidecar + "
+                        "fanout pulls via the manager's peer "
+                        "directory; corpus flow survives a dead or "
+                        "partitioned manager — docs/MANAGER.md)")
     p.add_argument("-l", "--logging-options")
     args = p.parse_args(argv)
     setup_logging(args.logging_options)
@@ -445,7 +459,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     configure_from_env()
     n = work_loop(args.manager_url, args.name, once=args.once,
                   in_process=args.in_process,
-                  corpus_sync_s=args.corpus_sync)
+                  corpus_sync_s=args.corpus_sync,
+                  gossip=args.gossip)
     INFO_MSG("worker finished: %d jobs", n)
     return 0
 

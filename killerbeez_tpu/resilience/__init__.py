@@ -24,6 +24,13 @@ Four pieces:
                         backoff, re-probing JAX devices on device
                         loss and degrading (mesh shrink, native-tier
                         fallback) when chips stay dead.
+  * ``fleetsim.py``   — the fleet-tier chaos rig: tens-to-100
+                        in-process gossiping workers (real stores,
+                        real sidecars, real exchange clients) driven
+                        round by round under manager SIGKILLs,
+                        scoped partitions and poisoned peers; the
+                        fleet-chaos CI lane gates its convergence
+                        invariant.
 
 Exit-code contract between the loop and the supervisor (chosen clear
 of the CLI's 0/1/2 usage codes and shells' 126+ conventions):

@@ -50,6 +50,19 @@ Modes (what firing does):
   * ``http500`` — raise ``urllib.error.HTTPError(500)``: the manager
                   saw the request and failed.
   * ``timeout`` — raise ``urllib.error.URLError``: network partition.
+  * ``partition`` — like ``timeout``, but SCOPED: the fault carries a
+                  ``match`` substring and only severs requests whose
+                  URL (the ``url=`` context at the chaos point)
+                  contains it, so a spec can cut one worker off from
+                  the manager while its peer gossip keeps flowing, or
+                  sever exactly one peer edge out of a mesh.  A
+                  fleet-sim harness typically installs it with
+                  ``every: 1`` (total blackout of the matched
+                  endpoint) and clears it by reconfiguring.
+
+``match`` may also scope any other mode: a fault with ``match`` set
+only counts and fires on hits whose ``url`` context contains the
+substring (hit counting stays deterministic given the URL sequence).
 
 Registered chaos points (grep for ``chaos_point(`` to verify):
 
@@ -58,7 +71,10 @@ Registered chaos points (grep for ``chaos_point(`` to verify):
   ``persist`` (corpus store ``_atomic_write``: entries, sidecars,
   checkpoint, campaign/solver state), ``fs_write`` (finding files),
   ``event_append`` (events.jsonl), ``manager_rpc`` (every worker /
-  sync / heartbeat HTTP request).
+  sync / heartbeat / peer-gossip HTTP request), ``gossip_serve``
+  (gossip sidecar, before serving each inbound peer request),
+  ``manager_db_write`` (manager, before every DB mutation — the
+  degraded-mode seam).
 """
 
 from __future__ import annotations
@@ -83,12 +99,12 @@ class XlaRuntimeError(RuntimeError):
 
 
 MODES = ("raise", "hang", "enospc", "torn", "kill", "http500",
-         "timeout")
+         "timeout", "partition")
 
 
 class _Fault:
     __slots__ = ("point", "mode", "hit", "every", "prob", "seconds",
-                 "fired")
+                 "match", "seen", "fired")
 
     def __init__(self, spec: Dict[str, Any]):
         self.point = str(spec["point"])
@@ -102,7 +118,20 @@ class _Fault:
         if self.hit is None and self.every is None and self.prob is None:
             self.hit = 1
         self.seconds = float(spec.get("seconds", 3600.0))
+        #: endpoint scoping: only hits whose ``url`` context contains
+        #: this substring count toward (and fire) this fault — how a
+        #: ``partition`` severs one named peer/manager endpoint while
+        #: the rest of the fleet's traffic flows
+        self.match = spec.get("match")
+        if self.match is not None:
+            self.match = str(self.match)
+        self.seen = 0        # per-fault hit count (match-scoped only)
         self.fired = 0
+
+    def matches(self, ctx: Dict[str, Any]) -> bool:
+        if self.match is None:
+            return True
+        return self.match in str(ctx.get("url", ""))
 
     def should_fire(self, n: int, rng: random.Random) -> bool:
         if self.hit is not None:
@@ -129,10 +158,20 @@ class ChaosEngine:
     def hit(self, point: str, **ctx) -> None:
         with self._lock:
             n = self.hits[point] = self.hits.get(point, 0) + 1
-            due = [f for f in self.faults if f.point == point
-                   and f.should_fire(n, self.rng)]
-            for f in due:
-                f.fired += 1
+            due = []
+            for f in self.faults:
+                if f.point != point or not f.matches(ctx):
+                    continue
+                # match-scoped faults count their own hits (the point
+                # counter mixes every endpoint's traffic; a scoped
+                # fault's trigger must be deterministic given only the
+                # MATCHED request sequence)
+                if f.match is not None:
+                    f.seen += 1
+                if f.should_fire(f.seen if f.match is not None else n,
+                                 self.rng):
+                    f.fired += 1
+                    due.append(f)
         for f in due:
             self._fire(f, point, n, ctx)
 
@@ -172,6 +211,11 @@ class ChaosEngine:
             import urllib.error
             raise urllib.error.URLError(
                 f"chaos: injected network partition ({point})")
+        if f.mode == "partition":
+            import urllib.error
+            raise urllib.error.URLError(
+                f"chaos: partitioned from "
+                f"{ctx.get('url', point)} ({point})")
 
     def state(self) -> Dict[str, Any]:
         return {"hits": dict(self.hits),

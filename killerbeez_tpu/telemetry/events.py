@@ -62,7 +62,12 @@ EVENT_TYPES = ("new_path", "crash", "hang", "plateau",
                # --generations: the host-side replay of one device
                # seed-slot ring admission (the device-resident loop's
                # analogue of scheduler_pick + admission)
-               "ring_admit")
+               "ring_admit",
+               # partition-tolerant fleet (corpus/gossip.py +
+               # quarantine.py): one peer-exchange round, a batch of
+               # rejected synced-in entries, and a peer crossing the
+               # poison threshold into a timed ban
+               "gossip_round", "sync_quarantine", "peer_banned")
 
 #: events a fleet worker forwards to the manager alongside heartbeats
 TERMINAL_EVENTS = ("crash", "hang", "plateau")

@@ -83,6 +83,14 @@ def render_fleet(body: Dict[str, Any], url: str) -> str:
             f" | {_fmt_n(c.get('crashes', 0))} crashes "
             f"({_fmt_n(c.get('unique_crashes', 0))} uniq)"
             f" | {_fmt_n(c.get('hangs', 0))} hangs")
+    if c.get("gossip_rounds") or c.get("sync_quarantined") \
+            or c.get("peers_banned"):
+        lines.append(
+            f"  gossip  : "
+            f"{_fmt_n(c.get('gossip_entries_in', 0))} in / "
+            f"{_fmt_n(c.get('gossip_entries_out', 0))} out"
+            f" | {_fmt_n(c.get('sync_quarantined', 0))} quarantined"
+            f" | {_fmt_n(c.get('peers_banned', 0))} peer bans")
     active = [a for a in body.get("alerts", []) if a.get("active")]
     if active:
         now = body.get("t", time.time())

@@ -115,6 +115,21 @@ def render(snap: Dict[str, Any]) -> str:
                      f" in / {_fmt_n(c.get('corpus_synced_out', 0))}"
                      " out")
         lines.append(line)
+    if c.get("gossip_rounds") or c.get("sync_quarantined") \
+            or c.get("peers_banned"):
+        line = (f"  gossip   : "
+                f"{_fmt_n(c.get('gossip_entries_in', 0))} in / "
+                f"{_fmt_n(c.get('gossip_entries_out', 0))} out"
+                f" | {int(g.get('gossip_peers', 0))} peers"
+                f" | {_fmt_n(c.get('gossip_rounds', 0))} rounds")
+        if c.get("sync_quarantined"):
+            line += (f" | {_fmt_n(c.get('sync_quarantined', 0))} "
+                     "quarantined")
+        if c.get("peers_banned") or g.get("peers_banned_active"):
+            line += (f" | {int(g.get('peers_banned_active', 0))} "
+                     f"banned ({_fmt_n(c.get('peers_banned', 0))} "
+                     "lifetime)")
+        lines.append(line)
     if c.get("solver_attempts") or g.get("solver_frontier"):
         line = (f"  solver   : "
                 f"{_fmt_n(c.get('solver_solved', 0))} solved"

@@ -588,6 +588,141 @@ def bench_descend(targets=None, batch=256, budget_execs=65536,
     return 0 if (ok or not gate) else 1
 
 
+def bench_stateful(targets=None, batch=512, execs=16384, gate=False):
+    """--stateful A/B lane: single-shot fuzzing vs sequence fuzzing
+    on the stateful target families (models/targets_stateful.py).
+
+    Both lanes run jit_harness + havoc from the SAME framed seed
+    bytes for the same exec budget; the single-shot lane executes
+    each candidate as one stateless buffer (the pre-session-tier
+    semantics), the sequence lane as a framed session with state x
+    edge novelty.  The metric that matters is DEEP-STATE EDGES
+    CRACKED: edges into blocks that are provably unreachable by any
+    single message —
+
+      * dataflow proof: dead under single-shot constant propagation
+        (``deep_state_blocks``; r7 and memory are 0 at every
+        dispatch, so the state guards fold shut), and
+      * solver confirmation: ``solve_edge`` exhaustively refutes
+        every candidate path with zero satisfiable paths (status
+        unsat, or the bounded-input-model ``unknown`` with
+        paths_tried == 0 — the solver's honest spelling of "refuted
+        within the model").
+
+    ``--gate``: the sequence lane must crack >= 1 deep-state edge on
+    EVERY family while the single-shot lane cracks 0 (it cannot, by
+    the proof above — a nonzero count here means the proof or the
+    tier is broken).  Deep-edge coverage is read from collision-free
+    AFL slots (slots a deep edge shares with a shallow edge are
+    excluded from the count).  Artifact: bench_out/
+    BENCH_stateful.json."""
+    import json as _json
+    import shutil
+    import numpy as np
+    from killerbeez_tpu.analysis.solver import solve_edge, unknown_kind
+    from killerbeez_tpu.drivers.factory import driver_factory
+    from killerbeez_tpu.fuzzer.loop import Fuzzer
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    from killerbeez_tpu.models import targets_stateful as ts
+    from killerbeez_tpu.models.targets import get_target
+    from killerbeez_tpu.mutators.factory import mutator_factory
+
+    rows = []
+    ok = True
+    for target in (targets or ts.stateful_target_names()):
+        prog = get_target(target)
+        seed = ts.framed_seed(target)
+        deep_blocks = ts.deep_state_blocks(prog)
+        deep_edges = ts.deep_state_edges(prog)
+        ef = np.asarray(prog.edge_from)
+        et = np.asarray(prog.edge_to)
+        slots = np.asarray(prog.edge_slot)
+        deep_set = set(deep_edges)
+        shallow_slots = {int(slots[e]) for e in range(len(et))
+                         if e not in deep_set}
+        deep_slots = sorted({int(slots[e]) for e in deep_edges}
+                            - shallow_slots)
+
+        # the static certificate: every deep edge refuted single-shot
+        refuted = 0
+        for e in deep_edges:
+            r = solve_edge(prog, (int(ef[e]), int(et[e])))
+            if r.status == "unsat" or (
+                    r.status == "unknown" and r.paths_tried == 0
+                    and unknown_kind(r.reason) == "model"):
+                refuted += 1
+        proof_ok = refuted == len(deep_edges) and len(deep_slots) > 0
+        rows.append(emit(
+            "stateful-proof",
+            f"{target}: {len(deep_blocks)} deep blocks / "
+            f"{len(deep_edges)} deep edges provably single-shot-"
+            f"unreachable (constprop-dead + solver-refuted)",
+            refuted, unit="edges_refuted", target=target,
+            deep_edges=len(deep_edges),
+            deep_slots=len(deep_slots), proof_ok=proof_ok))
+        if not proof_ok:
+            ok = False
+
+        def run_lane(stateful):
+            iopts = {"target": target, "novelty": "throughput"}
+            if stateful:
+                iopts["stateful"] = 1
+            instr = instrumentation_factory("jit_harness",
+                                            _json.dumps(iopts))
+            mut = mutator_factory("havoc", '{"seed": 7}', seed)
+            drv = driver_factory("file", None, instr, mut)
+            out = os.path.join(REPO, "bench_out",
+                               f"stateful_{target}_"
+                               f"{'seq' if stateful else 'single'}")
+            shutil.rmtree(out, ignore_errors=True)
+            fz = Fuzzer(drv, output_dir=out, batch_size=batch,
+                        write_findings=False, feedback=8)
+            t0 = time.time()
+            stats = fz.run(execs)
+            dt = max(time.time() - t0, 1e-9)
+            vb = np.asarray(instr.virgin_bits)
+            deep_hit = sum(1 for s in deep_slots if vb[s] != 0xFF)
+            extra = {}
+            st = instr.state_coverage_stats()
+            if st is not None:
+                extra = {"state_pairs": st[0], "states_seen": st[1]}
+            return (stats, stats.iterations / dt, deep_hit, extra)
+
+        sA, rateA, deepA, _ = run_lane(False)
+        rows.append(emit(
+            "stateful-single",
+            f"single-shot fuzzing on {target} (framed seed as one "
+            f"stateless buffer, -b {batch}, {execs} execs)", rateA,
+            target=target, deep_edges_hit=deepA,
+            new_paths=sA.new_paths, crashes=sA.crashes))
+        sB, rateB, deepB, extraB = run_lane(True)
+        rows.append(emit(
+            "stateful-seq",
+            f"sequence fuzzing on {target} (session tier, state x "
+            f"edge novelty, -b {batch}, {execs} execs)", rateB,
+            target=target, deep_edges_hit=deepB,
+            new_paths=sB.new_paths, crashes=sB.crashes, **extraB))
+        if deepA != 0:
+            print(f"FAIL: {target} single-shot lane hit {deepA} "
+                  f"deep-state edges — the unreachability proof is "
+                  f"broken", file=sys.stderr)
+            ok = False
+        if deepB < 1:
+            print(f"FAIL: {target} sequence lane cracked "
+                  f"{deepB} deep-state edges (need >= 1)",
+                  file=sys.stderr)
+            ok = False
+    os.makedirs(os.path.join(REPO, "bench_out"), exist_ok=True)
+    with open(os.path.join(REPO, "bench_out",
+                           "BENCH_stateful.json"), "w") as f:
+        json.dump({"rows": rows, "ok": ok}, f, indent=1)
+    if gate and not ok:
+        return 1
+    return 0
+
+
 BENCH_R05_GATE = 1807549.5   # BENCH_r05 headline: execs/s/chip,
 #                              fused-pallas superbatch on tlvstack_vm
 
@@ -1020,6 +1155,32 @@ def main():
         bench_schedulers(schedules, targets=tgts or None,
                         batch=batch, execs=execs)
         return 0
+
+    if "--stateful" in sys.argv[1:]:
+        # stateful session-tier A/B mode:
+        #   python bench.py --stateful [target ...] [-b BATCH]
+        #       [-n EXECS] [--gate]
+        from killerbeez_tpu.models import targets_stateful as _ts
+        rest = [a for a in sys.argv[1:] if a != "--stateful"]
+        gate = "--gate" in rest
+        rest = [a for a in rest if a != "--gate"]
+        batch, execs, tgts = 512, 16384, []
+        j = 0
+        while j < len(rest):
+            if rest[j] == "-b":
+                batch = int(rest[j + 1]); j += 2
+            elif rest[j] == "-n":
+                execs = int(rest[j + 1]); j += 2
+            else:
+                tgts.append(rest[j]); j += 1
+        known = _ts.stateful_target_names()
+        bad_t = [t for t in tgts if t not in known]
+        if bad_t:
+            print(f"error: unknown stateful target(s) {bad_t} "
+                  f"(choose from {known})", file=sys.stderr)
+            return 2
+        return bench_stateful(targets=tgts or None, batch=batch,
+                              execs=execs, gate=gate)
 
     if "--crack" in sys.argv[1:]:
         # plateau-crack A/B mode:

@@ -28,7 +28,8 @@ of facts AFL can only estimate dynamically is simply computable here:
 
 from .cfg import ControlFlowGraph, build_cfg, static_edge_prior
 from .dataflow import (
-    BranchFact, DataflowResult, analyze_dataflow, extract_dictionary,
+    BranchFact, DataflowResult, analyze_dataflow,
+    dictionary_candidates, extract_dictionary,
 )
 from .lint import Finding, lint_program
 from .solver import (
@@ -38,7 +39,7 @@ from .solver import (
 __all__ = [
     "ControlFlowGraph", "build_cfg", "static_edge_prior",
     "BranchFact", "DataflowResult", "analyze_dataflow",
-    "extract_dictionary",
+    "dictionary_candidates", "extract_dictionary",
     "Finding", "lint_program",
     "SolveResult", "concrete_run", "edge_dep_mask", "solve_edge",
     "solve_edges",

@@ -312,6 +312,20 @@ def extract_dictionary(program,
     guidance Angora derives from dynamic byte-level taint — free
     here because the program text is ours (PAPERS.md).
     """
+    return [tok for _pc, tok in
+            dictionary_candidates(program, result,
+                                  max_tokens=max_tokens)]
+
+
+def dictionary_candidates(program,
+                          result: Optional[DataflowResult] = None,
+                          max_tokens: int = 256
+                          ) -> List[Tuple[int, bytes]]:
+    """``extract_dictionary`` with provenance: deduped
+    ``(first-use pc, token)`` pairs in the same deterministic
+    (pc, bytes) order.  The pc anchors message/handler scoping for
+    sequence targets (stateful.dictionary.extract_dictionary_groups
+    maps it to the guarding protocol state)."""
     result = result or analyze_dataflow(program)
     # (first-use pc, token) candidates; the FINAL order is sorted by
     # (first-use pc, bytes) and deduped — deterministic across runs
@@ -367,12 +381,12 @@ def extract_dictionary(program,
             cands.append((f.pc, u.to_bytes(4, "little")))
             cands.append((f.pc, u.to_bytes(4, "big")))
 
-    tokens: List[bytes] = []
+    tokens: List[Tuple[int, bytes]] = []
     seen: Set[bytes] = set()
-    for _pc, tok in sorted(cands):
+    for pc, tok in sorted(cands):
         if tok and tok not in seen:
             seen.add(tok)
-            tokens.append(tok)
+            tokens.append((pc, tok))
         if len(tokens) >= max_tokens:
             break
     return tokens[:max_tokens]

@@ -33,6 +33,25 @@ Each check turns a silent correctness hazard into a reported finding:
                           targets; a whole-module must-crash is worth
                           a look)
     no-blocks             the program has no coverage blocks at all
+
+  stateful targets (``lint_program(..., stateful=StatefulSpec)``;
+  kb-lint resolves the spec from the target registry automatically):
+    state-unreachable     (warning) a protocol state the program
+                          guards on or assigns that NO session can
+                          reach from the initial state in the static
+                          CFG (stateful/protocol.py fixpoint) — dead
+                          protocol surface, almost certainly a state-
+                          machine bug in the target
+    state-clip            (warning) a state assignment at/beyond
+                          n_states: the session tier clips it into
+                          the top bucket, aliasing distinct states
+                          in the state x edge map
+    session-only-block    (info) a block dead under SINGLE-SHOT
+                          constant propagation that a session CAN
+                          light — the dead-block warning is
+                          downgraded to this for stateful targets
+                          (these blocks are the tier's target
+                          surface, not dead weight)
 """
 
 from __future__ import annotations
@@ -66,12 +85,44 @@ class Finding:
 
 def lint_program(program,
                  cfg: Optional[ControlFlowGraph] = None,
-                 dataflow: Optional[DataflowResult] = None
-                 ) -> List[Finding]:
-    """All checks over one Program, errors first."""
+                 dataflow: Optional[DataflowResult] = None,
+                 stateful=None) -> List[Finding]:
+    """All checks over one Program, errors first.  ``stateful`` (a
+    StatefulSpec) enables the session-tier checks and downgrades
+    single-shot dead-block warnings for session-reachable blocks."""
     cfg = cfg or build_cfg(program)
     dataflow = dataflow or analyze_dataflow(program)
     out: List[Finding] = []
+
+    session_live = None
+    if stateful is not None:
+        from ..stateful import protocol as _proto
+        reached, live_by_state = _proto.reachable_states(program,
+                                                         stateful)
+        session_live = set()
+        for blocks in live_by_state.values():
+            session_live |= blocks
+        for s in _proto.unreachable_states(program, stateful,
+                                           _reached=reached):
+            out.append(Finding(
+                SEV_WARNING, "state-unreachable",
+                f"protocol state {s} is guarded on or assigned but "
+                f"no session reaches it from the initial state in "
+                f"the static CFG — dead protocol surface (reachable "
+                f"states: {sorted(reached)})",
+                {"state": int(s), "reachable": sorted(reached)}))
+        for pc, v in _proto.state_assignments(program,
+                                              stateful.state_reg):
+            if v >= stateful.n_states:
+                out.append(Finding(
+                    SEV_WARNING, "state-clip",
+                    f"state assignment r{stateful.state_reg} = {v} "
+                    f"at pc {pc} is at/beyond n_states="
+                    f"{stateful.n_states}: the session tier clips "
+                    f"it into bucket {stateful.n_states - 1}, "
+                    f"aliasing distinct states in the state x edge "
+                    f"map", {"pc": int(pc), "value": int(v),
+                             "n_states": int(stateful.n_states)}))
 
     # -- empty modules ------------------------------------------------
     for name, lo, hi in program.modules:
@@ -173,6 +224,17 @@ def lint_program(program,
     for k in sorted(dataflow.dead_blocks):
         if k not in cfg.reachable:
             continue                    # already an unreachable error
+        if session_live is not None and k in session_live:
+            # dead SINGLE-SHOT, alive in sessions: the stateful
+            # tier's target surface, not dead weight
+            out.append(Finding(
+                SEV_INFO, "session-only-block",
+                f"block {k} (pc {cfg.block_pcs[k]}) is dead under "
+                f"single-shot constant propagation but reachable by "
+                f"message sequences — deep-state coverage only the "
+                f"session tier can earn",
+                {"block": k, "pc": cfg.block_pcs[k]}))
+            continue
         out.append(Finding(
             SEV_WARNING, "dead-block",
             f"block {k} (pc {cfg.block_pcs[k]}) is CFG-reachable but "

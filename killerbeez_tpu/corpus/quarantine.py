@@ -123,6 +123,15 @@ class EntryValidator:
                     not all(isinstance(s, int) and 0 <= s < (1 << 32)
                             for s in sig):
                 return None, "schema:sig"
+        ssig = meta.get("state_sig")
+        if ssig is not None:
+            if not isinstance(ssig, list) or \
+                    len(ssig) > self.max_sig_slots or \
+                    not all(isinstance(p, list) and len(p) == 2
+                            and all(isinstance(v, int)
+                                    and 0 <= v < (1 << 32) for v in p)
+                            for p in ssig):
+                return None, "schema:state_sig"
         hits = meta.get("edge_hits")
         if hits is not None:
             if not isinstance(hits, dict) or \
@@ -146,7 +155,7 @@ class EntryValidator:
         if claimed is not None:
             if not isinstance(claimed, str) or len(claimed) > 256:
                 return None, "schema:cov_hash"
-            if claimed != coverage_hash(sig, buf):
+            if claimed != coverage_hash(sig, buf, ssig):
                 return None, "integrity:cov_hash-mismatch"
         if self.executor is not None and sig:
             try:

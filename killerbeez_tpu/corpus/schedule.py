@@ -38,17 +38,20 @@ class Arm(list):
     historical shape — credits write through indices 1/2) plus store
     metadata as attributes."""
 
-    __slots__ = ("md5", "seq", "sig", "parent", "source", "discovered")
+    __slots__ = ("md5", "seq", "sig", "state_sig", "parent",
+                 "source", "discovered")
 
     def __init__(self, buf: bytes, selections: float = 0.0,
                  finds: float = 0.0, md5: Optional[str] = None,
                  seq: int = 0, sig: Optional[List[int]] = None,
                  parent: Optional[str] = None, source: str = "local",
-                 discovered: Optional[float] = None):
+                 discovered: Optional[float] = None,
+                 state_sig: Optional[List] = None):
         super().__init__([bytes(buf), selections, finds])
         self.md5 = md5 or md5_hex(buf)
         self.seq = int(seq)
         self.sig = sorted(set(int(s) for s in sig)) if sig else None
+        self.state_sig = state_sig
         self.parent = parent
         self.source = source
         self.discovered = discovered
@@ -59,20 +62,22 @@ class Arm(list):
 
     @property
     def cov_hash(self) -> str:
-        return coverage_hash(self.sig, self[0])
+        return coverage_hash(self.sig, self[0], self.state_sig)
 
     def to_entry(self) -> CorpusEntry:
         return CorpusEntry(
             self[0], md5=self.md5, seq=self.seq, sig=self.sig,
             edge_hits=None, selections=float(self[1]),
             finds=float(self[2]), parent=self.parent,
-            source=self.source, discovered=self.discovered)
+            source=self.source, discovered=self.discovered,
+            state_sig=self.state_sig)
 
     @classmethod
     def from_entry(cls, e: CorpusEntry) -> "Arm":
         return cls(e.buf, selections=e.selections, finds=e.finds,
                    md5=e.md5, seq=e.seq, sig=e.sig, parent=e.parent,
-                   source=e.source, discovered=e.discovered)
+                   source=e.source, discovered=e.discovered,
+                   state_sig=e.state_sig)
 
 
 class Scheduler:

@@ -25,6 +25,8 @@ Sidecar schema (docs/CORPUS.md):
     {"md5": ..., "seq": N,            # admission order (monotone)
      "cov_hash": ...,                 # coverage dedup key (sync)
      "sig": [slot, ...] | null,       # coverage signature (edge slots)
+     "state_sig": [[state, slot], ...] | null,  # state x edge pairs
+                                      # (stateful session tier)
      "edge_hits": {slot: count} | null,   # edge-hit summary
      "selections": float, "finds": float, # bandit arm stats (decayed)
      "parent": md5 | "base" | null,   # lineage: generating arm
@@ -61,23 +63,32 @@ _RESERVED = (STATE_FILE, MUTATOR_STATE_FILE, INSTR_STATE_FILE,
 
 
 def coverage_hash(sig: Optional[List[int]],
-                  buf: Optional[bytes] = None) -> str:
+                  buf: Optional[bytes] = None,
+                  state_sig: Optional[List] = None) -> str:
     """Dedup key for cross-worker exchange: the sha1 of the sorted
     edge-slot signature when one exists (two different inputs hitting
     the same edge set are one frontier), else the content md5 — an
-    unsigned entry still dedups exactly."""
-    if sig:
-        h = hashlib.sha1(
-            ",".join(str(s) for s in sorted(set(sig))).encode())
-        return "sig:" + h.hexdigest()
+    unsigned entry still dedups exactly.  Stateful session entries
+    fold their state x edge pairs in too: a sequence admitted for
+    STATE-only novelty (same edge set, new protocol states) is a
+    distinct frontier and must not dedup against its stateless
+    twin."""
+    if sig or state_sig:
+        parts = ",".join(str(s) for s in sorted(set(sig or [])))
+        if state_sig:
+            parts += "|" + ",".join(
+                f"{a}:{b}" for a, b in
+                sorted((int(a), int(b)) for a, b in state_sig))
+        return "sig:" + hashlib.sha1(parts.encode()).hexdigest()
     return "md5:" + (md5_hex(buf) if buf is not None else "")
 
 
 class CorpusEntry:
     """One stored corpus entry: input bytes + metadata sidecar."""
 
-    __slots__ = ("buf", "md5", "seq", "sig", "edge_hits", "selections",
-                 "finds", "parent", "source", "discovered", "cov_hash")
+    __slots__ = ("buf", "md5", "seq", "sig", "state_sig", "edge_hits",
+                 "selections", "finds", "parent", "source",
+                 "discovered", "cov_hash")
 
     def __init__(self, buf: bytes, md5: Optional[str] = None,
                  seq: int = 0, sig: Optional[List[int]] = None,
@@ -85,11 +96,16 @@ class CorpusEntry:
                  selections: float = 0.0, finds: float = 0.0,
                  parent: Optional[str] = None, source: str = "local",
                  discovered: Optional[float] = None,
-                 cov_hash: Optional[str] = None):
+                 cov_hash: Optional[str] = None,
+                 state_sig: Optional[List] = None):
         self.buf = bytes(buf)
         self.md5 = md5 or md5_hex(self.buf)
         self.seq = int(seq)
         self.sig = sorted(set(int(s) for s in sig)) if sig else None
+        # state x edge pairs from the stateful session tier, sorted
+        # [[state, slot], ...] (kb-corpus's state-coverage column)
+        self.state_sig = (sorted([int(a), int(b)] for a, b in state_sig)
+                          if state_sig else None)
         self.edge_hits = ({int(k): int(v) for k, v in edge_hits.items()}
                           if edge_hits else None)
         self.selections = float(selections)
@@ -98,12 +114,13 @@ class CorpusEntry:
         self.source = source
         self.discovered = (time.time() if discovered is None
                            else float(discovered))
-        self.cov_hash = cov_hash or coverage_hash(self.sig, self.buf)
+        self.cov_hash = cov_hash or coverage_hash(
+            self.sig, self.buf, self.state_sig)
 
     def meta_dict(self) -> Dict[str, Any]:
         return {
             "md5": self.md5, "seq": self.seq, "cov_hash": self.cov_hash,
-            "sig": self.sig,
+            "sig": self.sig, "state_sig": self.state_sig,
             "edge_hits": ({str(k): v for k, v in self.edge_hits.items()}
                           if self.edge_hits else None),
             "selections": self.selections, "finds": self.finds,
@@ -121,7 +138,8 @@ class CorpusEntry:
                    parent=meta.get("parent"),
                    source=meta.get("source", "local"),
                    discovered=meta.get("discovered"),
-                   cov_hash=meta.get("cov_hash"))
+                   cov_hash=meta.get("cov_hash"),
+                   state_sig=meta.get("state_sig"))
 
 
 def _atomic_write(path: str, data: bytes) -> None:

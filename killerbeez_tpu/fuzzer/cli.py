@@ -237,6 +237,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "-fb 0 the candidate stream is bit-identical "
                         "to the host-driven loop "
                         "(docs/GENERATIONS.md)")
+    p.add_argument("--stateful", type=int, nargs="?", const=0,
+                   default=None, metavar="M",
+                   help="stateful protocol sessions (jit_harness): "
+                        "inputs are framed message sequences "
+                        "(stateful/framing.py; build seeds with "
+                        "kb-frame) executed message-by-message from "
+                        "carried machine state, with a state x edge "
+                        "virgin map folded alongside the classic "
+                        "novelty maps.  The optional value overrides "
+                        "the sequence capacity M (default: the "
+                        "target's registered StatefulSpec).  Forces "
+                        "the xla engine; the fused superbatch stands "
+                        "down, -G runs the stateful generation scan "
+                        "(docs/STATEFUL.md decision table)")
     p.add_argument("-K", "--accumulate", type=int, default=0,
                    help="fused device path: accumulate K batches "
                         "per device dispatch so the host pulls one "
@@ -367,6 +381,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
 
+        if args.stateful is not None:
+            # inject the session-tier options into the
+            # instrumentation config (the same augmentation pattern
+            # the dictionary mutator uses)
+            import json as _json
+            if args.instrumentation != "jit_harness":
+                print("error: --stateful needs the jit_harness "
+                      "instrumentation (the session executor runs "
+                      "the KBVM)", file=sys.stderr)
+                return 2
+            try:
+                iopts = _json.loads(args.instrumentation_options) \
+                    if args.instrumentation_options else {}
+            except ValueError:
+                iopts = None     # factory reports the parse error
+            if isinstance(iopts, dict):
+                iopts.setdefault("stateful", 1)
+                if args.stateful > 0:
+                    iopts["msgs"] = args.stateful
+                args.instrumentation_options = _json.dumps(iopts)
+
         instrumentation = instrumentation_factory(
             args.instrumentation, args.instrumentation_options)
         if args.instrumentation_state_file:
@@ -477,6 +512,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print("error: --crack needs a KBVM device target "
                       "(jit_harness, single-chip) — the solver works "
                       "on the program text", file=sys.stderr)
+                return 2
+            if getattr(instrumentation, "stateful_spec", None) \
+                    is not None:
+                print("error: --crack models single-shot execution "
+                      "(path conditions over ONE input) — it cannot "
+                      "drive the stateful session tier; run it "
+                      "without --stateful, or fuzz sequences with "
+                      "-G/havoc/multipart (docs/STATEFUL.md)",
+                      file=sys.stderr)
                 return 2
             from .crack import BranchCracker
             fuzzer.cracker = BranchCracker(

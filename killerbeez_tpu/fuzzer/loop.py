@@ -296,6 +296,11 @@ class Fuzzer:
         self._fb_batches = 0
         self._accum_warned = False
         self._dbg = None
+        #: stateful session tier observability: last gauge refresh +
+        #: the high-water of touched state x edge pairs (one
+        #: state_cov event per increase)
+        self._state_gauge_t = 0.0
+        self._state_pairs_seen = 0
         self.stats = FuzzStats(telemetry.registry)
         self._seen = {k: set() for k in ("crashes", "hangs", "new_paths")}
         if write_findings:
@@ -574,6 +579,17 @@ class Fuzzer:
                 arm.sig = self._signer(buf)
             except Exception as e:
                 WARNING_MSG("corpus signer failed: %s", e)
+        # stateful session tier: record the entry's state x edge
+        # signature in its sidecar (one pure side execution through
+        # the session scan — admissions are rare, the hot path never
+        # pays it; kb-corpus and sync consumers read it back)
+        ssig_fn = getattr(getattr(self.driver, "instrumentation",
+                                  None), "state_signature", None)
+        if ssig_fn is not None:
+            try:
+                arm.state_sig = ssig_fn(buf)
+            except Exception as e:
+                WARNING_MSG("state signature failed: %s", e)
         if self.store is not None and not os.path.exists(
                 self.store.entry_path(digest)):
             arm.seq = self.store.next_seq()
@@ -696,6 +712,7 @@ class Fuzzer:
             if self.watchdog is not None:
                 self.watchdog.stop()
             self._profile_stop()
+            self._update_state_gauges(force=True)
             self.telemetry.registry.run_ended()
             self.telemetry.flush()
             # flight recorder: the span ring exports on every run
@@ -903,6 +920,38 @@ class Fuzzer:
                      os.path.join(self.output_dir, "device_trace"))
         except Exception as e:
             WARNING_MSG("device profile stop failed: %s", e)
+
+    def _update_state_gauges(self, force: bool = False) -> None:
+        """Stateful session tier: refresh the state-coverage gauges
+        (state_cov_pairs / state_cov_states) from the live virgin
+        map and emit one state_cov event per high-water increase.
+        Time-gated on the persist interval — the read syncs a tiny
+        device array, so it must never ride the per-batch hot path.
+        A no-op when the tier is off."""
+        instr = getattr(self.driver, "instrumentation", None)
+        fn = getattr(instr, "state_coverage_stats", None)
+        if fn is None:
+            return
+        t = time.time()
+        if not force and t - self._state_gauge_t < \
+                self._persist_interval:
+            return
+        self._state_gauge_t = t
+        try:
+            st = fn()
+        except Exception as e:    # observability must never stop it
+            WARNING_MSG("state coverage stats failed: %s", e)
+            return
+        if st is None:
+            return                # tier off on this instrumentation
+        pairs, states = st
+        reg = self.telemetry.registry
+        reg.gauge("state_cov_pairs", pairs)
+        reg.gauge("state_cov_states", states)
+        if pairs > self._state_pairs_seen:
+            self._state_pairs_seen = pairs
+            self.telemetry.event("state_cov", pairs=int(pairs),
+                                 states=int(states))
 
     def _wd_guard(self, stage: str):
         """Watchdog deadline over one blocking region (no-op without
@@ -1247,6 +1296,7 @@ class Fuzzer:
                 reg = self.telemetry.registry
                 reg.rate("execs", room)
                 reg.gauge("pipeline_depth", len(pending))
+                self._update_state_gauges()
                 self.telemetry.maybe_flush()
                 self._persist_campaign()
                 if self.sync is not None:
@@ -1467,6 +1517,7 @@ class Fuzzer:
                     reg.rate("execs", g_eff * n_real)
                     reg.gauge("generations_per_dispatch", g_eff)
                     reg.gauge("pipeline_depth", len(pending))
+                    self._update_state_gauges()
                     self.telemetry.maybe_flush()
                     self._persist_campaign()
                     if self.sync is not None:

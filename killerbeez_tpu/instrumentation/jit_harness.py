@@ -125,6 +125,35 @@ COMPACT_CAP = 1024
 
 
 @partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
+                                   "exact", "m_max", "n_states",
+                                   "state_reg"))
+def _session_step(instrs, edge_table, u_slots, seg_id, inputs,
+                  lengths, vb, vc, vh, vs, mem_size, max_steps,
+                  n_edges, exact, m_max, n_states, state_reg):
+    """The stateful twin of ``_fused_step``: framed-sequence batch ->
+    session execution (stateful/session.py) -> classic static-edge
+    triage PLUS state x edge triage, one XLA program.  The reported
+    per-lane novelty is ``max(classic, state)`` — a lane novel only
+    in the state dimension is a finding too (the tier's whole
+    point) — while each virgin map updates from its own dimension
+    alone."""
+    from ..stateful.coverage import state_triage, state_triage_exact
+    from ..stateful.session import _run_session_impl
+    res = _run_session_impl(instrs, edge_table, inputs, lengths,
+                            mem_size, max_steps, n_edges, m_max,
+                            n_states, state_reg)
+    statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
+                         res.status)
+    new_paths, uc, uh, vb2, vc2, vh2 = _triage_counts(
+        res.counts, statuses, u_slots, seg_id, vb, vc, vh, exact)
+    s_rets, vs2 = (state_triage_exact if exact else state_triage)(
+        vs, res.se_counts)
+    combined = jnp.maximum(new_paths, s_rets)
+    return (statuses, combined, uc, uh, res.exit_code, vb2, vc2, vh2,
+            vs2, res.counts, res.se_counts)
+
+
+@partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
                                    "exact", "stack_pow2",
                                    "phase1_steps", "dots"))
 def _fused_fuzz_step(instrs, edge_table, u_slots, seg_id, seed_buf,
@@ -234,7 +263,8 @@ class JitHarnessInstrumentation(Instrumentation):
                      "novelty": str, "edges": int, "engine": str,
                      "phase1_steps": int, "gen_ring_slots": int,
                      "gen_findings_cap": int, "gen_admits": int,
-                     "gen_fold_every": int}
+                     "gen_fold_every": int, "stateful": int,
+                     "msgs": int, "n_states": int, "state_reg": int}
     OPTION_DESCS = {
         "target": "built-in KBVM target name (test/hang/libtest/cgc_like)",
         "program_file": "path to a .npz compiled KBVM program",
@@ -272,11 +302,24 @@ class JitHarnessInstrumentation(Instrumentation):
                           "host-mesh parity cadence).  Between folds "
                           "shards may re-find each other's paths — "
                           "over-report, never under-report",
+        "stateful": "1 = session tier: inputs are framed message "
+                    "sequences executed message-by-message from "
+                    "carried machine state, with state x edge "
+                    "novelty folded alongside the classic map "
+                    "(docs/STATEFUL.md; forces the xla engine)",
+        "msgs": "stateful: max messages per sequence (0 = the "
+                "target's registered StatefulSpec, else 4)",
+        "n_states": "stateful: abstract-state buckets the state "
+                    "register clips into (0 = registered spec, "
+                    "else 16)",
+        "state_reg": "stateful: the protocol-state register "
+                     "(-1 = registered spec, else r7)",
     }
     DEFAULTS = {"novelty": "exact", "edges": 0, "engine": "xla",
                 "phase1_steps": -1, "gen_ring_slots": 32,
                 "gen_findings_cap": 0, "gen_admits": 8,
-                "gen_fold_every": 0}
+                "gen_fold_every": 0, "stateful": 0, "msgs": 0,
+                "n_states": 0, "state_reg": -1}
 
     def __init__(self, options: Optional[str] = None):
         super().__init__(options)
@@ -291,6 +334,27 @@ class JitHarnessInstrumentation(Instrumentation):
             raise ValueError(
                 'engine must be "xla", "pallas" or "pallas_fused"')
         self.engine = self.options["engine"]
+        # -- stateful session tier (killerbeez_tpu/stateful/) --------
+        # resolved spec: explicit options win, then the target's
+        # registered StatefulSpec, then the package defaults
+        self.stateful_spec = None
+        if self.options["stateful"]:
+            from ..models.targets_stateful import get_stateful_spec
+            from ..stateful import StatefulSpec
+            reg_spec = get_stateful_spec(prog.name) or StatefulSpec()
+            self.stateful_spec = StatefulSpec(
+                m_max=(int(self.options["msgs"]) or reg_spec.m_max),
+                n_states=(int(self.options["n_states"])
+                          or reg_spec.n_states),
+                state_reg=(int(self.options["state_reg"])
+                           if int(self.options["state_reg"]) >= 0
+                           else reg_spec.state_reg))
+            if self.engine != "xla":
+                WARNING_MSG(
+                    "jit_harness: stateful sessions run the one-hot "
+                    "xla engine — %r stands down (the pallas kernel "
+                    "executes single-shot inputs only)", self.engine)
+                self.engine = "xla"
         self._fuse_warned = False
         from ..ops.vm_kernel import auto_phase1_steps, dot_modes
         # exactness-guarded MXU dtypes, decided once per program
@@ -320,8 +384,17 @@ class JitHarnessInstrumentation(Instrumentation):
         self.virgin_bits = jnp.full((ms,), 0xFF, dtype=jnp.uint8)
         self.virgin_crash = jnp.full((ms,), 0xFF, dtype=jnp.uint8)
         self.virgin_tmout = jnp.full((ms,), 0xFF, dtype=jnp.uint8)
+        # the state x edge virgin map (stateful tier only; edge-index
+        # space, n_states x (E+1) bytes — see stateful/coverage.py)
+        if self.stateful_spec is not None:
+            from ..stateful.coverage import fresh_virgin_state
+            self.virgin_state = fresh_virgin_state(
+                self.stateful_spec.n_states, prog.n_edges)
+        else:
+            self.virgin_state = None
         self.total_execs = 0
         self._last_counts: Optional[np.ndarray] = None
+        self._last_se: Optional[np.ndarray] = None
         self._last_unique_crash = False
         self._last_unique_hang = False
         # --generations device state: seed-slot ring (lazy-built from
@@ -365,6 +438,8 @@ class JitHarnessInstrumentation(Instrumentation):
         self._apply_exact_gate(b)
         inputs = jnp.asarray(inputs, dtype=jnp.uint8)
         lengths = jnp.asarray(lengths, dtype=jnp.int32)
+        if self.stateful_spec is not None:
+            return self._run_batch_stateful(inputs, lengths)
         (statuses, new_paths, uc, uh, exit_codes, vb, vc, vh,
          counts) = _fused_step(
             self._instrs, self._edge_table, self._u_slots, self._seg_id,
@@ -388,6 +463,30 @@ class JitHarnessInstrumentation(Instrumentation):
             exit_codes=exit_codes,
         )
 
+    def _run_batch_stateful(self, inputs, lengths) -> BatchResult:
+        """Session-tier batch execution: framed sequences through the
+        device session scan, dual-map triage (see _session_step)."""
+        spec = self.stateful_spec
+        (statuses, new_paths, uc, uh, exit_codes, vb, vc, vh, vs,
+         counts, se) = _session_step(
+            self._instrs, self._edge_table, self._u_slots,
+            self._seg_id, inputs, lengths, self.virgin_bits,
+            self.virgin_crash, self.virgin_tmout, self.virgin_state,
+            self.program.mem_size, self.program.max_steps,
+            self.program.n_edges, self.exact, spec.m_max,
+            spec.n_states, spec.state_reg)
+        self.virgin_bits, self.virgin_crash, self.virgin_tmout = \
+            vb, vc, vh
+        self.virgin_state = vs
+        self.total_execs += int(inputs.shape[0])
+        if self.options.get("edges"):
+            self._last_counts = np.asarray(counts)
+            self._last_se = np.asarray(se)
+        # results stay LAZY (see run_batch)
+        return BatchResult(statuses=statuses, new_paths=new_paths,
+                           unique_crashes=uc, unique_hangs=uh,
+                           exit_codes=exit_codes)
+
     # -- fused mutate+execute (the flagship product path) ---------------
 
     def wants_fused(self, mutator) -> bool:
@@ -398,6 +497,10 @@ class JitHarnessInstrumentation(Instrumentation):
         per-lane keys, so candidates and verdicts are bit-identical
         to the mutate-then-execute pipeline, just without the HBM
         round-trip between the two."""
+        if self.stateful_spec is not None:
+            # sessions execute in the one-hot engine; the fused VMEM
+            # kernel runs single-shot inputs only
+            return False
         fusable = getattr(mutator, "fused_spec", None) is not None
         if self.engine == "pallas_fused" and not fusable \
                 and not self._fuse_warned:
@@ -553,21 +656,30 @@ class JitHarnessInstrumentation(Instrumentation):
             self.options["gen_admits"],
             self.options["gen_findings_cap"], b,
             self._gen_ring_key[1])
-        (vb, vc, vh), ring, rep = run_generations(
+        spec = self.stateful_spec
+        stateful = None if spec is None else (
+            spec.m_max, spec.n_states, spec.state_reg)
+        vs = self.virgin_state if spec is not None \
+            else jnp.zeros((1,), jnp.uint8)
+        (vb, vc, vh, vs), ring, rep = run_generations(
             self._instrs, self._edge_table, self._u_slots,
             self._seg_id, *self._gen_ring, base_key,
             jnp.asarray(its), jnp.int32(n),
             jnp.uint32(self._gen_count), jnp.uint32(salt),
             self.virgin_bits, self.virgin_crash, self.virgin_tmout,
+            vs,
             self.program.mem_size, self.program.max_steps,
             self.program.n_edges, self.exact, stack_pow2, int(g),
             engine=("pallas" if self.engine in ("pallas",
                                                 "pallas_fused")
                     else "xla"),
             phase1_steps=self.phase1_steps, dots=self._dots,
-            reseed=bool(reseed), adm_cap=adm_cap, findings_cap=cap)
+            reseed=bool(reseed), adm_cap=adm_cap, findings_cap=cap,
+            stateful=stateful)
         self.virgin_bits, self.virgin_crash, self.virgin_tmout = \
             vb, vc, vh
+        if spec is not None:
+            self.virgin_state = vs
         self._gen_ring = ring
         out = GenerationOutcome(*rep, gen0=self._gen_count, g=int(g),
                                 n_real=n, cap=cap)
@@ -615,6 +727,18 @@ class JitHarnessInstrumentation(Instrumentation):
             if n:
                 agg[int(s)] = agg.get(int(s), 0) + int(n)
         return sorted(agg.items())
+
+    def last_trace(self) -> Optional[np.ndarray]:
+        """Dense uint8[map_size] bitmap of the last exec (lane 0),
+        rebuilt from the static-edge counts — the afl-style raw-trace
+        surface the picker consumes (requires {"edges": 1}, like
+        get_edges; counts wrap at u8 exactly like trace_bits)."""
+        if self._last_counts is None:
+            return None
+        dense = np.zeros(self.program.map_size, np.uint8)
+        np.add.at(dense, np.asarray(self.program.edge_slot),
+                  self._last_counts[0, :-1])
+        return dense
 
     def get_edge_pairs(self, module: Optional[str] = None
                        ) -> Optional[List[Tuple[int, int, int]]]:
@@ -671,6 +795,44 @@ class JitHarnessInstrumentation(Instrumentation):
                                   list(self.program.module_names),
                                   module, MAP_SIZE)
 
+    # -- stateful session surface (showmap / corpus / telemetry) --------
+
+    def state_signature(self, buf: bytes):
+        """The state x edge signature of ONE framed input as sorted
+        ``[state, slot]`` pairs — PURE (no virgin-map fold; a side
+        execution through the session scan).  The corpus sidecar and
+        picker/showmap wire format.  None when the tier is off."""
+        if self.stateful_spec is None:
+            return None
+        from ..stateful.session import run_single_session
+        _res, pairs = run_single_session(self.program, buf,
+                                         self.stateful_spec)
+        return pairs
+
+    def state_coverage_stats(self):
+        """(touched state x edge pairs, distinct states seen) from
+        the live virgin map — the kb-stats gauges.  None when the
+        tier is off.  Forces a (tiny) device sync."""
+        if self.stateful_spec is None:
+            return None
+        from ..stateful.coverage import state_coverage_stats
+        return state_coverage_stats(np.asarray(self.virgin_state),
+                                    self.stateful_spec.n_states)
+
+    def get_state_pairs(self):
+        """Last exec's (state, slot, count) records (requires
+        {"edges": 1}, like get_edges) — the showmap/picker "state"
+        section source."""
+        if self._last_se is None:
+            return None
+        se = self._last_se[0, :, :-1]
+        slots = np.asarray(self.program.edge_slot)
+        agg: dict = {}
+        for s, e in zip(*np.nonzero(se)):
+            key = (int(s), int(slots[e]))
+            agg[key] = agg.get(key, 0) + int(se[s, e])
+        return [(s, slot, c) for (s, slot), c in sorted(agg.items())]
+
     # -- state / merge --------------------------------------------------
 
     def get_state(self) -> str:
@@ -682,6 +844,12 @@ class JitHarnessInstrumentation(Instrumentation):
             "virgin_crash": encode_array(np.asarray(self.virgin_crash)),
             "virgin_tmout": encode_array(np.asarray(self.virgin_tmout)),
         }
+        if self.stateful_spec is not None:
+            d["virgin_state"] = encode_array(
+                np.asarray(self.virgin_state))
+            d["stateful"] = {"m_max": self.stateful_spec.m_max,
+                             "n_states": self.stateful_spec.n_states,
+                             "state_reg": self.stateful_spec.state_reg}
         if len(self.program.modules) > 1:
             d["modules"] = list(self.program.module_names)
         return json.dumps(d)
@@ -700,6 +868,35 @@ class JitHarnessInstrumentation(Instrumentation):
             raise ValueError(
                 f"state modules {mods} != {self.program.module_names}")
 
+    def _check_state_state_layout(self, d: Dict[str, Any],
+                                  arr) -> None:
+        """virgin_state interop requires the same (n_states, E+1)
+        shape AND the same session spec — two same-SIZED maps built
+        under different state registers (or message capacities)
+        encode different state machines, and AND-folding them would
+        mark genuinely-novel (state, edge) rows as seen (the exact
+        aliasing _check_state_layout prevents for modules)."""
+        from ..stateful.coverage import state_map_size
+        want = state_map_size(self.stateful_spec.n_states,
+                              self.program.n_edges)
+        if arr.shape != (want,):
+            raise ValueError(
+                f"state-map is {arr.shape[0]} bytes but "
+                f"{self.program.name!r} with n_states="
+                f"{self.stateful_spec.n_states} has {want}")
+        meta = d.get("stateful")
+        if meta is not None:
+            mine = {"m_max": self.stateful_spec.m_max,
+                    "n_states": self.stateful_spec.n_states,
+                    "state_reg": self.stateful_spec.state_reg}
+            theirs = {k: meta.get(k) for k in mine}
+            if theirs != mine:
+                raise ValueError(
+                    f"state spec mismatch: state is from "
+                    f"{theirs}, this instance runs {mine} — "
+                    f"same-sized maps under different specs encode "
+                    f"different state machines")
+
     def set_state(self, state: str) -> None:
         d = json.loads(state)
         if d.get("instrumentation") not in (None, self.name):
@@ -712,6 +909,10 @@ class JitHarnessInstrumentation(Instrumentation):
                 arr = decode_array(d[key])
                 self._check_state_layout(d, arr)
                 setattr(self, key, jnp.asarray(arr))
+        if self.stateful_spec is not None and "virgin_state" in d:
+            arr = decode_array(d["virgin_state"])
+            self._check_state_state_layout(d, arr)
+            self.virgin_state = jnp.asarray(arr)
 
     def merge(self, other_state: str) -> None:
         d = json.loads(other_state)
@@ -721,6 +922,11 @@ class JitHarnessInstrumentation(Instrumentation):
                 arr = decode_array(d[key])
                 self._check_state_layout(d, arr)
                 setattr(self, key, merge_virgin(mine, jnp.asarray(arr)))
+        if self.stateful_spec is not None and "virgin_state" in d:
+            arr = decode_array(d["virgin_state"])
+            self._check_state_state_layout(d, arr)
+            self.virgin_state = merge_virgin(self.virgin_state,
+                                             jnp.asarray(arr))
         self.total_execs += int(d.get("total_execs", 0))
 
     def coverage_bytes(self) -> int:

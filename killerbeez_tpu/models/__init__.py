@@ -14,6 +14,7 @@ from .vm import Program, VMResult, compile_runner, run_batch
 from .compiler import Assembler, assign_block_ids
 from . import targets
 from . import targets_cgc  # registers the CGC-grade targets
+from . import targets_stateful  # registers the session-tier targets
 
 __all__ = ["Program", "VMResult", "compile_runner", "run_batch",
            "Assembler", "assign_block_ids", "targets"]

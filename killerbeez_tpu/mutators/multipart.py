@@ -22,13 +22,28 @@ from .base import MUTATE_INDEX_MASK, MUTATE_MULTIPLE_INPUTS, Mutator
 
 
 class ManagerMutator(Mutator):
-    """Composes child mutators, one per input part."""
+    """Composes child mutators, one per input part.
+
+    With ``{"framed": 1}`` the composite candidate is a FRAMED
+    message sequence (stateful/framing.py) instead of a bare
+    concatenation: message boundaries ride in the frame header, so
+    per-message mutation can never corrupt them — the structure-aware
+    mutation mode of the stateful session tier.  The seed may then be
+    either the usual mem-array encoding or an already-framed buffer
+    (kb-frame output): framed seeds split back into their messages."""
     name = "manager"
-    OPTION_SCHEMA = {"mutators": list, "mutator_options": list}
+    OPTION_SCHEMA = {"mutators": list, "mutator_options": list,
+                     "framed": int, "m_max": int}
     OPTION_DESCS = {
         "mutators": 'child mutator names, e.g. ["bit_flip","havoc"]',
         "mutator_options": "per-child JSON option objects (optional)",
+        "framed": "1 = compose candidates as framed message "
+                  "sequences (stateful session tier; boundaries "
+                  "survive any child mutation by construction)",
+        "m_max": "framed: the sequence capacity (must match the "
+                 "target's StatefulSpec; default 4)",
     }
+    DEFAULTS = {"framed": 0, "m_max": 4}
 
     def __init__(self, options, input_bytes):
         # input_bytes: either an encoded mem array (JSON list of b64
@@ -63,11 +78,33 @@ class ManagerMutator(Mutator):
             parts = decode_mem_array(input_bytes.decode("ascii"))
             assert isinstance(parts, list) and parts
         except Exception:
-            parts = [input_bytes]
+            if self.options.get("framed"):
+                # framed mode accepts a kb-frame sequence directly:
+                # the framing parse is total, so any buffer splits
+                from ..stateful.framing import unframe
+                # the framing parse is total: always >= 1 message
+                parts = [p or b"\x00"      # children reject empties
+                         for p in unframe(
+                             input_bytes,
+                             int(self.options.get("m_max", 4)))]
+            else:
+                parts = [input_bytes]
         self.parts = [bytes(p) for p in parts]
         self.seed_bytes = input_bytes
         self.seed_len = len(input_bytes)
         self.max_length = max(len(p) for p in self.parts)
+
+    def _compose(self, parts) -> bytes:
+        """Parts -> one candidate buffer: framed sequence when the
+        framed option is on (boundaries in the header, clipped to
+        the strict frame bounds), bare concatenation otherwise."""
+        if self.options.get("framed"):
+            from ..stateful.framing import MAX_MSG_LEN, frame_messages
+            m_max = int(self.options.get("m_max", 4))
+            clipped = [bytes(p[:MAX_MSG_LEN])
+                       for p in parts[:m_max]] or [b""]
+            return frame_messages(clipped, m_max)
+        return b"".join(parts)
 
     # -- iteration ------------------------------------------------------
 
@@ -94,7 +131,7 @@ class ManagerMutator(Mutator):
                     self.current[i] = out
                     self._next_child = (i + 1) % n
                     self.iteration += 1
-                    whole = b"".join(self.current)
+                    whole = self._compose(self.current)
                     return whole[:max_size] if max_size else whole
         return None  # all children exhausted
 
@@ -159,13 +196,20 @@ class ManagerMutator(Mutator):
         return out
 
     def mutate_batch(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Concatenated-composite form of mutate_batch_parts (matches
-        ``mutate``'s return shape for single-buffer consumers)."""
+        """Composite form of mutate_batch_parts (matches ``mutate``'s
+        return shape for single-buffer consumers; framed sequences
+        when the framed option is on)."""
         from .base import pack_byte_rows
         parts = self.mutate_batch_parts(n)
-        return pack_byte_rows([b"".join(p) for p in parts])
+        return pack_byte_rows([self._compose(p) for p in parts])
 
     def get_input_info(self) -> Tuple[int, List[int]]:
+        if self.options.get("framed"):
+            # framed mode: the composite IS one input (the sequence
+            # travels as a single framed buffer — what single-input
+            # drivers like `file` consume; parts are internal
+            # structure, not separate driver inputs)
+            return 1, [len(self._compose(self.current))]
         return len(self.children), [len(p) for p in self.current]
 
     # -- state ----------------------------------------------------------

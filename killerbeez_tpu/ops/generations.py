@@ -46,6 +46,13 @@ the same findings (the determinism gate in tests).  With reseeding
 on, generation g mutates the ring slot picked by a ``_mix32`` draw
 over the filled slots — deterministic and host-replayable, but
 intentionally different seeds than the host bandit would pick.
+
+The stateful session tier (killerbeez_tpu/stateful/) plugs in via
+the ``stateful`` static option: each candidate executes as a framed
+message SEQUENCE (the sequence loop is a scan-within-this-scan) and
+a fourth virgin map — state x edge — rides the carry, with the
+per-lane verdict becoming ``max(classic, state)``.  Same parity
+doctrine, pinned in tests/test_stateful.py.
 """
 
 from __future__ import annotations
@@ -311,13 +318,13 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
                     ring_bufs, ring_lens, ring_filled, ring_hits,
                     ring_finds, ring_ptr,
                     base_key, its0, n_real, gen0, salt,
-                    vb, vc, vh,
+                    vb, vc, vh, vs,
                     mem_size, max_steps, n_edges, exact, stack_pow2,
                     g, engine="xla", phase1_steps=0,
                     dots=("f32", "f32"), reseed=True,
                     adm_cap=DEFAULT_ADM_CAP,
                     findings_cap=DEFAULT_FINDINGS_CAP,
-                    interpret=False):
+                    interpret=False, stateful=None):
     """G generations in ONE device program.  Returns (new virgin maps,
     new ring state, GenerationOutcome fields) — see module docstring
     for the state/replay contract.
@@ -331,6 +338,17 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
     VMEM kernel).  ``exact``/``dots``/``phase1_steps`` thread through
     unchanged from the jit_harness config so novelty verdicts are
     identical to the host-driven loop's.
+
+    ``stateful`` turns each candidate into a framed SESSION (the
+    sequence loop is a scan-within-this-scan): a static
+    ``(m_max, n_states, state_reg)`` tuple, with ``vs`` the
+    state x edge virgin map threaded through the carry alongside the
+    classic three (stateful/coverage.py).  The per-lane novelty
+    verdict becomes ``max(classic, state)`` — the state dimension
+    ADDS findings to the ring and admissions, exactly like the
+    host-driven stateful loop.  Requires engine "xla" (the session
+    executor runs the one-hot engine).  With ``stateful=None`` the
+    ``vs`` carry is a 1-byte dummy, returned untouched.
     """
     from ..instrumentation.base import pack_verdicts
     from ..instrumentation.jit_harness import _triage_counts
@@ -340,11 +358,15 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
     F = int(findings_cap)
     A = int(adm_cap) if reseed else 1   # ledger shape floor
     lanes_real = jnp.arange(b) < n_real
+    if stateful is not None and engine != "xla":
+        raise ValueError(
+            "stateful generations need the xla engine (the session "
+            "executor is the one-hot engine path)")
 
     def one_generation(carry, j):
-        (vb, vc, vh, ring_bufs, ring_lens, ring_filled, ring_hits,
-         ring_finds, ring_ptr, fr_pack, fr_gen, fr_iter, fr_len,
-         fr_bufs, fr_ptr) = carry
+        (vb, vc, vh, vs, ring_bufs, ring_lens, ring_filled,
+         ring_hits, ring_finds, ring_ptr, fr_pack, fr_gen, fr_iter,
+         fr_len, fr_bufs, fr_ptr) = carry
         gen_id = gen0 + j
         if reseed:
             sel = _select_slot(ring_filled, gen_id, salt)
@@ -371,12 +393,27 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
             bufs, lens = jax.vmap(
                 lambda k: havoc_at(seed_buf, seed_len, k,
                                    stack_pow2=stack_pow2))(keys)
-            res = _run_batch_impl(instrs, edge_table, bufs, lens,
-                                  mem_size, max_steps, n_edges, False)
+            if stateful is not None:
+                from ..stateful.session import _run_session_impl
+                m_max, n_states, state_reg = stateful
+                res = _run_session_impl(
+                    instrs, edge_table, bufs, lens, mem_size,
+                    max_steps, n_edges, m_max, n_states, state_reg)
+            else:
+                res = _run_batch_impl(instrs, edge_table, bufs, lens,
+                                      mem_size, max_steps, n_edges,
+                                      False)
         statuses = jnp.where(res.status == FUZZ_RUNNING, FUZZ_HANG,
                              res.status)
         new_paths, uc, uh, vb, vc, vh = _triage_counts(
             res.counts, statuses, u_slots, seg_id, vb, vc, vh, exact)
+        if stateful is not None:
+            from ..stateful.coverage import (
+                state_triage, state_triage_exact,
+            )
+            s_rets, vs = (state_triage_exact if exact
+                          else state_triage)(vs, res.se_counts)
+            new_paths = jnp.maximum(new_paths, s_rets)
         packed = pack_verdicts(statuses, new_paths, uc, uh)
 
         flags = ((statuses != FUZZ_NONE) | (new_paths > 0)) \
@@ -392,12 +429,12 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
             (fr_pack, fr_gen, fr_iter, fr_len, fr_bufs, fr_ptr),
             A, reseed)
 
-        carry = (vb, vc, vh, ring_bufs, ring_lens, ring_filled,
+        carry = (vb, vc, vh, vs, ring_bufs, ring_lens, ring_filled,
                  ring_hits, ring_finds, ring_ptr, fr_pack, fr_gen,
                  fr_iter, fr_len, fr_bufs, fr_ptr)
         return carry, (sel, araw) + ledger
 
-    carry0 = (vb, vc, vh, ring_bufs, ring_lens, ring_filled,
+    carry0 = (vb, vc, vh, vs, ring_bufs, ring_lens, ring_filled,
               ring_hits, ring_finds, ring_ptr,
               jnp.zeros((F,), jnp.uint8),        # fr_pack
               jnp.zeros((F,), jnp.int32),        # fr_gen
@@ -408,12 +445,12 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
     carry, ys = jax.lax.scan(
         one_generation, carry0,
         jnp.arange(g, dtype=jnp.uint32))
-    (vb, vc, vh, ring_bufs, ring_lens, ring_filled, ring_hits,
+    (vb, vc, vh, vs, ring_bufs, ring_lens, ring_filled, ring_hits,
      ring_finds, ring_ptr, fr_pack, fr_gen, fr_iter, fr_len,
      fr_bufs, fr_ptr) = carry
     (sel, adm_raw, adm_valid, adm_slot, adm_iter, adm_len,
      adm_bufs) = ys
-    return ((vb, vc, vh),
+    return ((vb, vc, vh, vs),
             (ring_bufs, ring_lens, ring_filled, ring_hits,
              ring_finds, ring_ptr),
             (fr_pack, fr_gen, fr_iter, fr_len, fr_bufs, fr_ptr,
@@ -423,10 +460,12 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
 
 #: positional args of _run_generations_impl that are pure carry state
 #: (consumed each dispatch, safe to update in place): ring_bufs(4),
-#: ring_lens(5), ring_hits(7), ring_finds(8), vb(15), vc(16), vh(17).
+#: ring_lens(5), ring_hits(7), ring_finds(8), vb(15), vc(16), vh(17),
+#: vs(18) — the state x edge virgin map (a 1-byte dummy when the
+#: stateful tier is off, returned as-is so the donation stays usable).
 #: ring_filled(6)/ring_ptr(9) are exported in the outcome report and
 #: must survive the next dispatch — never donated.
-_CARRY_ARGNUMS = (4, 5, 7, 8, 15, 16, 17)
+_CARRY_ARGNUMS = (4, 5, 7, 8, 15, 16, 17, 18)
 
 _RUN_GENERATIONS_JIT = None
 
@@ -443,7 +482,8 @@ def run_generations(*args, **kwargs):
             static_argnames=("mem_size", "max_steps", "n_edges",
                              "exact", "stack_pow2", "g", "engine",
                              "phase1_steps", "dots", "reseed",
-                             "adm_cap", "findings_cap", "interpret"),
+                             "adm_cap", "findings_cap", "interpret",
+                             "stateful"),
             donate_argnums=carry_donation_argnums(
                 jax.default_backend(), _CARRY_ARGNUMS))
     return _RUN_GENERATIONS_JIT(*args, **kwargs)

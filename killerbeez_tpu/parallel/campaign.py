@@ -87,15 +87,29 @@ class ShardedCampaignDriver(Driver):
             interpret = jax.default_backend() != "tpu"
         prog = instrumentation.program
         engine = instrumentation.engine
+        # stateful session tier: inherit the instrumentation's
+        # resolved StatefulSpec (jit_harness coerced the engine to
+        # xla already); the state x edge map rides the mesh state as
+        # a P("dp")-sharded [dp, M] block, dp-folded like the
+        # classic maps
+        sspec = getattr(instrumentation, "stateful_spec", None)
+        self._stateful = None if sspec is None else (
+            sspec.m_max, sspec.n_states, sspec.state_reg)
         self._step = make_sharded_fuzz_step(
             prog, self.mesh, self.batch_per_device,
             max_len=mutator.max_length,
             stack_pow2=int(mutator.options.get("stack_pow2", 4)),
             engine=engine, interpret=interpret,
-            seed=int(mutator.options.get("seed", 0)))
+            seed=int(mutator.options.get("seed", 0)),
+            stateful=self._stateful)
         # seed the device state from the instrumentation's maps so
         # -isf resume and merged states carry over
         spec = NamedSharding(self.mesh, P("mp"))
+        if self._stateful is not None:
+            vs_np = np.tile(np.asarray(instrumentation.virgin_state),
+                            (n_dp, 1))
+        else:
+            vs_np = np.full((n_dp, 1), 0xFF, np.uint8)
         self.state = ShardedFuzzState(
             virgin_bits=jax.device_put(
                 jnp.asarray(np.asarray(instrumentation.virgin_bits)),
@@ -107,6 +121,9 @@ class ShardedCampaignDriver(Driver):
                 jnp.asarray(np.asarray(instrumentation.virgin_tmout)),
                 spec),
             step=jnp.int32(0),
+            virgin_state=jax.device_put(
+                jnp.asarray(vs_np),
+                NamedSharding(self.mesh, P("dp"))),
         )
         #: accumulated mesh-wide stats: per-shard snapshots folded
         #: through telemetry.aggregate each sync epoch (associative,
@@ -150,6 +167,10 @@ class ShardedCampaignDriver(Driver):
         instr.virgin_bits = self.state.virgin_bits
         instr.virgin_crash = self.state.virgin_crash
         instr.virgin_tmout = self.state.virgin_tmout
+        if self._stateful is not None:
+            # dp rows are fold-identical; row 0 is the canonical view
+            # get_state()/merge()/state_coverage_stats() export
+            instr.virgin_state = self.state.virgin_state[0]
         instr.total_execs += execs
         # mesh telemetry fold: one merge of the dp shards' epoch
         # snapshots, accumulated into the campaign view (host-side
@@ -272,7 +293,8 @@ class ShardedCampaignDriver(Driver):
             stack_pow2=int(stack_pow2),
             engine=instr.engine, interpret=self._interpret,
             seed=int(self.mutator.options.get("seed", 0)),
-            salt=salt, adm_cap=adm_cap, findings_cap=cap)
+            salt=salt, adm_cap=adm_cap, findings_cap=cap,
+            stateful=self._stateful)
         self._gen_ring = sharded_gen_ring_init(
             self.mesh, seed_buf, int(seed_len), slots, L)
         self._gen_ring_key = key

@@ -89,23 +89,37 @@ def make_mesh(n_dp: int, n_mp: int = 1, devices=None) -> Mesh:
 
 
 class ShardedFuzzState(NamedTuple):
-    """Device-resident fuzzing state: virgin maps sharded over mp."""
+    """Device-resident fuzzing state: virgin maps sharded over mp.
+
+    ``virgin_state`` is the stateful tier's state x edge map, carried
+    as a P("dp")-sharded [dp, M] array whose rows are identical after
+    every dp AND-fold (same doctrine as the classic maps, different
+    layout: the map is tiny and every mp shard computes it whole, so
+    dp rows are the natural shard unit).  A [dp, 1] dummy when the
+    session tier is off — the step signature stays uniform."""
     virgin_bits: jax.Array   # uint8[MAP_SIZE]
     virgin_crash: jax.Array
     virgin_tmout: jax.Array
     step: jax.Array          # int32 scalar, counts batches done
+    virgin_state: jax.Array = None  # uint8[dp, M_state] (or [dp, 1])
 
 
-def sharded_state_init(mesh: Mesh,
-                       map_size: int = MAP_SIZE) -> ShardedFuzzState:
-    """``map_size`` must match the program's (64KB x n_modules)."""
+def sharded_state_init(mesh: Mesh, map_size: int = MAP_SIZE,
+                       state_map_size: int = 0) -> ShardedFuzzState:
+    """``map_size`` must match the program's (64KB x n_modules);
+    ``state_map_size`` the stateful tier's n_states x (E+1) bytes
+    (0 = tier off, a 1-byte dummy rides along)."""
     spec = NamedSharding(mesh, P("mp"))
     full = jnp.full((map_size,), 0xFF, dtype=jnp.uint8)
+    n_dp = mesh.shape["dp"]
+    vs = jnp.full((n_dp, max(int(state_map_size), 1)), 0xFF,
+                  dtype=jnp.uint8)
     return ShardedFuzzState(
         virgin_bits=jax.device_put(full, spec),
         virgin_crash=jax.device_put(full, spec),
         virgin_tmout=jax.device_put(full, spec),
         step=jnp.int32(0),
+        virgin_state=jax.device_put(vs, NamedSharding(mesh, P("dp"))),
     )
 
 
@@ -180,12 +194,22 @@ class _ShardKernels:
     def __init__(self, program: Program, mesh: Mesh,
                  batch_per_device: int, max_len: int,
                  stack_pow2: int = 4, engine: str = "xla",
-                 interpret: bool = False, seed: int = 0):
+                 interpret: bool = False, seed: int = 0,
+                 stateful=None):
         n_mp = mesh.shape["mp"]
         if program.map_size % n_mp:
             raise ValueError("mp must divide the program's map size")
         if engine not in ("xla", "pallas", "pallas_fused"):
             raise ValueError(f"unknown engine {engine!r}")
+        #: stateful session tier: a static (m_max, n_states,
+        #: state_reg) tuple; candidates execute as framed sequences
+        #: and ``state_triage_local`` folds the state x edge map
+        self.stateful = (tuple(int(v) for v in stateful)
+                         if stateful is not None else None)
+        if self.stateful is not None and engine != "xla":
+            raise ValueError(
+                "stateful mesh campaigns need the xla engine (the "
+                "session executor is the one-hot engine path)")
         self.program = program
         self.mesh = mesh
         self.batch_per_device = int(batch_per_device)
@@ -269,7 +293,15 @@ class _ShardKernels:
         bufs, lens = jax.vmap(
             lambda k: havoc_at(seed_buf, seed_len, k,
                                stack_pow2=self.stack_pow2))(keys)
-        if self.engine == "pallas":
+        if self.stateful is not None:
+            # session tier: the mutants are framed sequences and the
+            # result carries se_counts alongside the classic fields
+            from ..stateful.session import _run_session_impl
+            m_max, n_states, state_reg = self.stateful
+            res = _run_session_impl(
+                self.instrs, self.edge_table, bufs, lens, p.mem_size,
+                p.max_steps, p.n_edges, m_max, n_states, state_reg)
+        elif self.engine == "pallas":
             res = self._exec_pallas(bufs, lens)
         else:
             res = _run_batch_impl(self.instrs, self.edge_table, bufs,
@@ -355,12 +387,23 @@ class _ShardKernels:
                     jnp.where(jnp.any(hang), outside, zero_out))
         return rets, uc, uh, vb2, vc2, vh2
 
+    def state_triage_local(self, vs, se_counts):
+        """State x edge novelty for this dp shard's lanes (stateful
+        tier).  The map is whole on every shard (it is tiny and
+        se_counts is full-width), so the compute is mp-replicated —
+        no collectives; the caller schedules the dp AND-fold on its
+        own cadence exactly like the classic maps.  Per-dp-shard
+        in-batch dedup: over-report between folds, never
+        under-report (the established mesh doctrine)."""
+        from ..stateful.coverage import state_triage
+        return state_triage(vs, se_counts)
+
 
 def make_sharded_fuzz_step(program: Program, mesh: Mesh,
                            batch_per_device: int, max_len: int,
                            stack_pow2: int = 4, engine: str = "xla",
                            interpret: bool = False, seed: int = 0,
-                           compact_cap: int = 1024):
+                           compact_cap: int = 1024, stateful=None):
     """Build the jitted multi-chip fuzz step.
 
     Returns ``step(state, seed_buf, seed_len, base_it) ->
@@ -393,10 +436,12 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
     compact_cap = min(compact_cap, batch_per_device)
     kern = _ShardKernels(program, mesh, batch_per_device, max_len,
                          stack_pow2=stack_pow2, engine=engine,
-                         interpret=interpret, seed=seed)
+                         interpret=interpret, seed=seed,
+                         stateful=stateful)
 
-    def local_step(vb, vc, vh, seed_buf, seed_len, base_it):
+    def local_step(vb, vc, vh, vs, seed_buf, seed_len, base_it):
         dp_i = jax.lax.axis_index("dp")
+        vs0 = vs[0]                   # P("dp") block: [1, M] -> [M]
 
         # ---- mutate + execute: per-global-lane keys at the 64-bit
         # counter [lo, hi] (mesh-shape independent) ----
@@ -408,11 +453,19 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         # ---- mp-sharded triage: coverage, novelty, dedup, clears ----
         rets, uc, uh, vb2, vc2, vh2 = kern.triage_local(
             vb, vc, vh, res.counts, statuses)
+        if kern.stateful is not None:
+            # state x edge novelty joins the verdict (max, like the
+            # single-chip session step)
+            s_rets, vs0 = kern.state_triage_local(vs0, res.se_counts)
+            rets = jnp.maximum(rets, s_rets)
 
         # ---- union across dp (the per-step "merger") ----
         vb2 = _gather_and_fold(vb2, "dp")
         vc2 = _gather_and_fold(vc2, "dp")
         vh2 = _gather_and_fold(vh2, "dp")
+        if kern.stateful is not None:
+            vs0 = _gather_and_fold(vs0, "dp")
+        vs2 = vs0[None]               # back to the [1, M] dp block
 
         # ---- in-step compaction (per dp shard): gather interesting
         # lanes' candidate bytes here so campaign triage never pulls
@@ -425,15 +478,16 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         # global lane ids so the host maps report rows -> batch lanes
         sel_idx = (sel + dp_i * batch_per_device).astype(jnp.int32)
         count = jnp.sum(flags).astype(jnp.int32).reshape(1)
-        return (vb2, vc2, vh2, statuses, rets, uc, uh,
+        return (vb2, vc2, vh2, vs2, statuses, rets, uc, uh,
                 res.exit_code, bufs, lens,
                 sel_idx, sel_bufs, sel_lens, count)
 
     sharded = shard_map(
         local_step, mesh=mesh,
-        in_specs=(P("mp"), P("mp"), P("mp"), P(), P(), P()),
+        in_specs=(P("mp"), P("mp"), P("mp"), P("dp"), P(), P(), P()),
         out_specs=(P("mp"), P("mp"), P("mp"), P("dp"), P("dp"),
-                   P("dp"), P("dp"), P("dp"), P("dp", None), P("dp"),
+                   P("dp"), P("dp"), P("dp"), P("dp"),
+                   P("dp", None), P("dp"),
                    P("dp"), P("dp", None), P("dp"), P("dp")),
         check_vma=False,
     )
@@ -444,23 +498,26 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         the mesh twin of jit_harness._fused_fuzz_multi."""
         n_global = jnp.uint32(n_dp * batch_per_device)
 
-        def body_fn(vb, vc, vh, seed_buf, seed_len, base_it):
+        def body_fn(vb, vc, vh, vs, seed_buf, seed_len, base_it):
             def body(carry, j):
-                vb, vc, vh = carry
+                vb, vc, vh, vs = carry
                 off = j * n_global
                 lo = base_it[0] + off
                 hi = base_it[1] + (lo < base_it[0]).astype(jnp.uint32)
-                (vb2, vc2, vh2, statuses, rets, uc, uh, _ec, bufs,
-                 lens, sel_idx, sel_bufs, sel_lens, count) = local_step(
-                    vb, vc, vh, seed_buf, seed_len,
+                (vb2, vc2, vh2, vs2, statuses, rets, uc, uh, _ec,
+                 bufs, lens, sel_idx, sel_bufs, sel_lens,
+                 count) = local_step(
+                    vb, vc, vh, vs, seed_buf, seed_len,
                     jnp.stack([lo, hi]))
                 packed = pack_verdicts(statuses, rets, uc, uh)
-                return (vb2, vc2, vh2), (packed, bufs, lens, sel_idx,
-                                         sel_bufs, sel_lens, count)
+                return (vb2, vc2, vh2, vs2), (packed, bufs, lens,
+                                              sel_idx, sel_bufs,
+                                              sel_lens, count)
 
-            (vb, vc, vh), outs = jax.lax.scan(
-                body, (vb, vc, vh), jnp.arange(k, dtype=jnp.uint32))
-            return (vb, vc, vh) + tuple(outs)
+            (vb, vc, vh, vs), outs = jax.lax.scan(
+                body, (vb, vc, vh, vs),
+                jnp.arange(k, dtype=jnp.uint32))
+            return (vb, vc, vh, vs) + tuple(outs)
 
         return body_fn
 
@@ -471,8 +528,9 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         if fn is None:
             fn = jax.jit(shard_map(
                 local_multi(k), mesh=mesh,
-                in_specs=(P("mp"), P("mp"), P("mp"), P(), P(), P()),
-                out_specs=(P("mp"), P("mp"), P("mp"),
+                in_specs=(P("mp"), P("mp"), P("mp"), P("dp"),
+                          P(), P(), P()),
+                out_specs=(P("mp"), P("mp"), P("mp"), P("dp"),
                            P(None, "dp"),          # packed [k, B]
                            P(None, "dp", None),    # bufs [k, B, L]
                            P(None, "dp"),          # lens [k, B]
@@ -488,11 +546,11 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
     def _step_jit(state: ShardedFuzzState, seed_buf, seed_len, base_it):
         seed_buf = _validate(state, seed_buf)  # defined below; bound
         # at call time — shared with step_multi
-        (vb, vc, vh, statuses, rets, uc, uh, exit_codes, bufs,
+        (vb, vc, vh, vs, statuses, rets, uc, uh, exit_codes, bufs,
          lens, sel_idx, sel_bufs, sel_lens, counts) = sharded(
             state.virgin_bits, state.virgin_crash, state.virgin_tmout,
-            seed_buf, seed_len, base_it)
-        new_state = ShardedFuzzState(vb, vc, vh, state.step + 1)
+            state.virgin_state, seed_buf, seed_len, base_it)
+        new_state = ShardedFuzzState(vb, vc, vh, state.step + 1, vs)
         return (new_state, statuses, rets, uc, uh, exit_codes, bufs,
                 lens, (sel_idx, sel_bufs, sel_lens, counts))
 
@@ -530,11 +588,12 @@ def make_sharded_fuzz_step(program: Program, mesh: Mesh,
         Returns (state', packed uint8[k, B], bufs[k, B, L],
         lens[k, B], (idx, bufs, lens, counts) stacked compact)."""
         seed_buf = _validate(state, seed_buf)
-        (vb, vc, vh, packed, bufs, lens, sel_idx, sel_bufs, sel_lens,
-         counts) = _sharded_multi(int(k))(
+        (vb, vc, vh, vs, packed, bufs, lens, sel_idx, sel_bufs,
+         sel_lens, counts) = _sharded_multi(int(k))(
             state.virgin_bits, state.virgin_crash, state.virgin_tmout,
-            seed_buf, seed_len, _halves(base_it))
-        new_state = ShardedFuzzState(vb, vc, vh, state.step + int(k))
+            state.virgin_state, seed_buf, seed_len, _halves(base_it))
+        new_state = ShardedFuzzState(vb, vc, vh, state.step + int(k),
+                                     vs)
         return (new_state, packed, bufs, lens,
                 (sel_idx, sel_bufs, sel_lens, counts))
 
@@ -589,7 +648,8 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                              interpret: bool = False, seed: int = 0,
                              salt: int = 0,
                              adm_cap: int = DEFAULT_ADM_CAP,
-                             findings_cap: int = DEFAULT_FINDINGS_CAP):
+                             findings_cap: int = DEFAULT_FINDINGS_CAP,
+                             stateful=None):
     """Build the mesh-resident generation dispatch: the single-chip
     generation scan (ops/generations.py) lifted into a ``shard_map``
     over the (dp, mp) mesh.
@@ -634,7 +694,8 @@ def make_sharded_generations(program: Program, mesh: Mesh,
     b = int(batch_per_device)
     kern = _ShardKernels(program, mesh, b, max_len,
                          stack_pow2=stack_pow2, engine=engine,
-                         interpret=interpret, seed=seed)
+                         interpret=interpret, seed=seed,
+                         stateful=stateful)
     F = int(findings_cap)
     A = max(int(adm_cap), 1)
     salt_u32 = jnp.uint32(int(salt) & 0xFFFFFFFF)
@@ -644,20 +705,20 @@ def make_sharded_generations(program: Program, mesh: Mesh,
         A_eff = A if reseed else 1
 
         def body(vb, vc, vh, rbufs, rlens, rfilled, rhits, rfinds,
-                 rptr, base_it, gen0, salt):
+                 rptr, vs, base_it, gen0, salt):
             dp_i = jax.lax.axis_index("dp")
             # P("dp") blocks arrive with a leading axis of 1
-            rbufs, rlens, rfilled, rhits, rfinds, rptr = (
+            rbufs, rlens, rfilled, rhits, rfinds, rptr, vs = (
                 rbufs[0], rlens[0], rfilled[0], rhits[0], rfinds[0],
-                rptr[0])
+                rptr[0], vs[0])
             L = rbufs.shape[1]
             # per-shard slot-policy salt (host-replayable: salt ^ d)
             salt_d = salt ^ dp_i.astype(jnp.uint32)
 
             def one_generation(carry, j):
-                (vb, vc, vh, rbufs, rlens, rfilled, rhits, rfinds,
-                 rptr, fr_pack, fr_gen, fr_iter, fr_len, fr_bufs,
-                 fr_ptr) = carry
+                (vb, vc, vh, vs, rbufs, rlens, rfilled, rhits,
+                 rfinds, rptr, fr_pack, fr_gen, fr_iter, fr_len,
+                 fr_bufs, fr_ptr) = carry
                 gen_id = gen0 + j
                 if reseed:
                     sel = _select_slot(rfilled, gen_id, salt_d)
@@ -678,6 +739,10 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                                      FUZZ_HANG, res.status)
                 rets, uc, uh, vb, vc, vh = kern.triage_local(
                     vb, vc, vh, res.counts, statuses)
+                if kern.stateful is not None:
+                    s_rets, vs = kern.state_triage_local(
+                        vs, res.se_counts)
+                    rets = jnp.maximum(rets, s_rets)
                 packed = pack_verdicts(statuses, rets, uc, uh)
 
                 # findings-ring append + FIFO admission + ledger:
@@ -697,9 +762,9 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                      fr_ptr),
                     A_eff, reseed)
 
-                carry = (vb, vc, vh, rbufs, rlens, rfilled, rhits,
-                         rfinds, rptr, fr_pack, fr_gen, fr_iter,
-                         fr_len, fr_bufs, fr_ptr)
+                carry = (vb, vc, vh, vs, rbufs, rlens, rfilled,
+                         rhits, rfinds, rptr, fr_pack, fr_gen,
+                         fr_iter, fr_len, fr_bufs, fr_ptr)
                 return carry, (sel, araw) + ledger
 
             def chunk(carry, c):
@@ -707,16 +772,18 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                 carry, ys = jax.lax.scan(
                     one_generation, carry,
                     j0 + jnp.arange(fold_every, dtype=jnp.uint32))
-                (vb, vc, vh, *rest) = carry
+                (vb, vc, vh, vs, *rest) = carry
                 # the in-scan "merger": AND-fold virgin maps across
                 # dp so shards stop re-finding each other's paths —
                 # no host round-trip, same fold as the per-batch step
                 vb = _gather_and_fold(vb, "dp")
                 vc = _gather_and_fold(vc, "dp")
                 vh = _gather_and_fold(vh, "dp")
-                return (vb, vc, vh) + tuple(rest), ys
+                if kern.stateful is not None:
+                    vs = _gather_and_fold(vs, "dp")
+                return (vb, vc, vh, vs) + tuple(rest), ys
 
-            carry0 = (vb, vc, vh, rbufs, rlens, rfilled, rhits,
+            carry0 = (vb, vc, vh, vs, rbufs, rlens, rfilled, rhits,
                       rfinds, rptr,
                       jnp.zeros((F,), jnp.uint8),       # fr_pack
                       jnp.zeros((F,), jnp.int32),       # fr_gen
@@ -726,8 +793,8 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                       jnp.int32(0))                     # fr_ptr
             carry, ys = jax.lax.scan(
                 chunk, carry0, jnp.arange(n_chunks, dtype=jnp.uint32))
-            (vb, vc, vh, rbufs, rlens, rfilled, rhits, rfinds, rptr,
-             fr_pack, fr_gen, fr_iter, fr_len, fr_bufs,
+            (vb, vc, vh, vs, rbufs, rlens, rfilled, rhits, rfinds,
+             rptr, fr_pack, fr_gen, fr_iter, fr_len, fr_bufs,
              fr_ptr) = carry
             # [n_chunks, fold_every, ...] -> [g, ...] ledger rows
             ys = jax.tree_util.tree_map(
@@ -738,7 +805,7 @@ def make_sharded_generations(program: Program, mesh: Mesh,
             def exp(a):     # restore the leading dp-block axis
                 return a[None]
 
-            return (vb, vc, vh,
+            return (vb, vc, vh, exp(vs),
                     exp(rbufs), exp(rlens), exp(rfilled), exp(rhits),
                     exp(rfinds), exp(rptr),
                     exp(fr_pack), exp(fr_gen), exp(fr_iter),
@@ -760,15 +827,16 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                 shard_map(
                     gen_body(g, reseed, fold_every), mesh=mesh,
                     in_specs=(P("mp"), P("mp"), P("mp"),
-                              *dp_specs, P(), P(), P()),
+                              *dp_specs, P("dp"), P(), P(), P()),
                     out_specs=((P("mp"), P("mp"), P("mp"))
-                               + (P("dp"),) * 19),
+                               + (P("dp"),) * 20),
                     check_vma=False),
                 # donate the carry: vb/vc/vh + ring bufs/lens/hits/
-                # finds update in place; ring filled(5)/ptr(8) are
-                # exported in the outcome report, never donated
+                # finds + the state map (9) update in place; ring
+                # filled(5)/ptr(8) are exported in the outcome
+                # report, never donated
                 donate_argnums=carry_donation_argnums(
-                    jax.default_backend(), (0, 1, 2, 3, 4, 6, 7)))
+                    jax.default_backend(), (0, 1, 2, 3, 4, 6, 7, 9)))
             _cache[key] = fn
         return fn
 
@@ -802,11 +870,11 @@ def make_sharded_generations(program: Program, mesh: Mesh,
         outs = _jit(g, bool(reseed), fold)(
             state.virgin_bits, state.virgin_crash, state.virgin_tmout,
             ring.bufs, ring.lens, ring.filled, ring.hits, ring.finds,
-            ring.ptr, _counter_halves(base_it), jnp.uint32(int(gen0)),
-            salt_u32)
-        (vb, vc, vh, rbufs, rlens, rfilled, rhits, rfinds, rptr,
+            ring.ptr, state.virgin_state, _counter_halves(base_it),
+            jnp.uint32(int(gen0)), salt_u32)
+        (vb, vc, vh, vs, rbufs, rlens, rfilled, rhits, rfinds, rptr,
          *rep) = outs
-        new_state = ShardedFuzzState(vb, vc, vh, state.step + g)
+        new_state = ShardedFuzzState(vb, vc, vh, state.step + g, vs)
         new_ring = ShardedGenRing(rbufs, rlens, rfilled, rhits,
                                   rfinds, rptr)
         return new_state, new_ring, tuple(rep)

@@ -1,0 +1,72 @@
+"""Stateful protocol fuzzing tier — device-resident session sequences.
+
+The reference framework's driver layer exists so network/TCP
+state-machine targets can be fuzzed message-by-message (network
+drivers feed one mutated packet at a time into a live process); the
+TPU tier treated every input as one stateless buffer until this
+package.  Here an input is a *framed sequence* of messages
+(``framing.py``), the batched KBVM executes message k from the
+machine state message k-1 left behind — registers and scratch memory
+checkpointed per lane on device, pc re-entering at the program top
+like a persistent-mode server's dispatch loop (``session.py``) — and
+novelty gains a second dimension: a state x edge virgin map keyed by
+(abstract protocol state entering the message, static edge), the
+PTrix move of feeding the fuzzer state-sensitive coverage beyond the
+plain edge map (``coverage.py``).
+
+The abstract protocol state is the value of a designated KBVM
+register (``state_reg``, r7 by convention) clipped to ``n_states``
+buckets: stateful targets keep their protocol state there across
+messages precisely because registers persist.  Message boundaries
+reset pc, coverage chain (prev block) and status; registers, memory
+and the path hash carry over — so the static edge universe stays
+exact (every message is an independent walk of the program text) and
+the interesting cross-message signal lands in the state x edge map,
+where it belongs.
+
+Wired end to end: jit_harness ``{"stateful": 1}`` options, the
+``--stateful`` CLI flag, the single-chip and mesh generation scans
+(the sequence loop is a scan-within-the-scan), multipart/framed
+structure-aware mutation, per-entry state-coverage sidecars, and the
+stateful built-in target families in ``models/targets_stateful.py``.
+See docs/STATEFUL.md for the sequence format, coverage semantics and
+stand-down rules.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class StatefulSpec:
+    """Session-tier configuration for one target.
+
+    ``m_max``     maximum messages per sequence (static scan length);
+    ``n_states``  abstract-state buckets (state values clip into
+                  [0, n_states));
+    ``state_reg`` the KBVM register holding the protocol state
+                  (read AFTER each message; r7 by convention).
+    """
+    m_max: int = 4
+    n_states: int = 16
+    state_reg: int = 7
+
+    def __post_init__(self):
+        if not (1 <= self.m_max <= 32):
+            raise ValueError("m_max must be in [1, 32]")
+        if not (2 <= self.n_states <= 256):
+            raise ValueError("n_states must be in [2, 256]")
+        if not (0 <= self.state_reg < 8):
+            raise ValueError("state_reg must be r0..r7")
+
+
+from .framing import (  # noqa: E402
+    frame_messages, parse_frames, parse_frames_np, unframe,
+)
+from .session import SessionResult, run_session_batch  # noqa: E402
+
+__all__ = [
+    "StatefulSpec", "frame_messages", "unframe", "parse_frames",
+    "parse_frames_np", "SessionResult", "run_session_batch",
+]

@@ -1,0 +1,119 @@
+"""State x edge novelty — the session tier's second virgin map.
+
+The classic AFL map answers "did this input light an edge (bucket) we
+have never seen"; protocol targets need "did it light an edge FROM a
+protocol state we have never seen it from" — PTrix's observation that
+path/state-sensitive feedback is what unlocks state machines.  The
+map here is tiny and exact: ``n_states x (E+1)`` uint8 hit counts
+over the program's static edge universe (edge-index space, not AFL
+slot space — the state dimension never aliases through slot
+collisions), classified into AFL count buckets and AND-folded into a
+``virgin_state`` byte map with exactly ``has_new_bits`` semantics.
+
+Two triage modes mirroring the classic ones (jit_harness novelty):
+
+  * ``state_triage_exact``    — lanes judged sequentially (lane i
+    sees the virgin map after lanes < i): the parity mode;
+  * ``state_triage``          — throughput mode: all lanes vs the
+    incoming map, in-batch dedup by classified-map hash, one
+    OR-folded virgin clear.  Over-reports within a batch the same
+    benign way the classic throughput path does.
+
+Both return AFL ret codes per lane (2 = a never-seen (state, edge)
+pair, 1 = only a new hit-count bucket, 0 = nothing) and the updated
+virgin map.  The combined novelty verdict the session tier feeds
+triage/admission is ``max(classic_ret, state_ret)`` — the state
+dimension ADDS findings, it never suppresses classic ones.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.coverage import classify_counts, has_new_bits
+from ..ops.sparse_coverage import first_occurrence, stream_hash
+
+
+def state_map_size(n_states: int, n_edges: int) -> int:
+    """Bytes in a program's state x edge virgin map."""
+    return int(n_states) * (int(n_edges) + 1)
+
+
+def fresh_virgin_state(n_states: int, n_edges: int) -> jnp.ndarray:
+    return jnp.full((state_map_size(n_states, n_edges),), 0xFF,
+                    dtype=jnp.uint8)
+
+
+def _classify_flat(se_counts) -> jnp.ndarray:
+    """uint8[B, S, E+1] -> classified uint8[B, S*(E+1)]."""
+    b = se_counts.shape[0]
+    return classify_counts(se_counts.reshape(b, -1))
+
+
+def state_triage(virgin_state, se_counts,
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Throughput-mode state novelty.  Args: virgin uint8[S*(E+1)],
+    se_counts uint8[B, S, E+1].  Returns (rets int32[B], virgin')."""
+    cls = _classify_flat(se_counts)
+    v = virgin_state[None, :]
+    new_count = jnp.any((cls & v) != 0, axis=1)
+    new_tuple = jnp.any((cls != 0) & (v == 0xFF), axis=1)
+    rets = jnp.where(new_tuple, 2, jnp.where(new_count, 1, 0)
+                     ).astype(jnp.int32)
+    hashes = stream_hash(cls.astype(jnp.uint32))
+    first = first_occurrence(hashes, jnp.ones(hashes.shape, bool))
+    rets = jnp.where(first, rets, 0)
+    seen = jax.lax.reduce(
+        jnp.where((rets > 0)[:, None], cls, jnp.uint8(0)),
+        jnp.uint8(0), jax.lax.bitwise_or, dimensions=(0,))
+    return rets, virgin_state & ~seen
+
+
+def state_triage_exact(virgin_state, se_counts,
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Sequential-parity state novelty: lane i is judged against the
+    virgin map after lanes < i — bit-for-bit what a single-exec loop
+    would report (the stateful parity gates run in this mode, like
+    the classic ``exact`` novelty)."""
+    cls = _classify_flat(se_counts)
+
+    def step(v, c):
+        ret, v2 = has_new_bits(v, c)
+        return v2, ret
+
+    virgin2, rets = jax.lax.scan(step, virgin_state, cls)
+    return rets, virgin2
+
+
+def np_state_triage_exact(virgin_state: np.ndarray,
+                          se_counts: np.ndarray
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Pure-numpy witness of ``state_triage_exact`` (host replay for
+    the parity suites — the same role np_select_slot plays for the
+    generation scan's slot policy)."""
+    from ..ops.coverage import COUNT_CLASS_LOOKUP
+    v = np.asarray(virgin_state).copy()
+    se = np.asarray(se_counts)
+    b = se.shape[0]
+    cls = COUNT_CLASS_LOOKUP[se.reshape(b, -1)]
+    rets = np.zeros(b, np.int32)
+    for i in range(b):
+        t = cls[i]
+        new_tuple = bool(((t != 0) & (v == 0xFF)).any())
+        new_count = bool((t & v).any())
+        rets[i] = 2 if new_tuple else (1 if new_count else 0)
+        v &= ~t
+    return rets, v
+
+
+def state_coverage_stats(virgin_state: np.ndarray,
+                         n_states: int) -> Tuple[int, int]:
+    """(touched state x edge pairs, distinct states seen) from a
+    virgin map — the telemetry gauges' source."""
+    v = np.asarray(virgin_state).reshape(n_states, -1)
+    touched = v != 0xFF
+    return int(touched.sum()), int(touched.any(axis=1).sum())

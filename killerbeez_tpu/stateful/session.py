@@ -1,0 +1,311 @@
+"""Device-resident session execution — message k runs from the
+machine state message k-1 left behind.
+
+The persistent-server model: between messages the lane's pc re-enters
+the program top (the dispatch loop of a network daemon), REGISTERS
+and SCRATCH MEMORY persist (where stateful targets keep their
+protocol state and session data), and the coverage chain (prev block
+/ prev edge-table index) resets so every message is an independent
+walk of the program's STATIC edge universe — inter-message edges
+would otherwise fall outside the enumerable (prev, cur) table and
+vanish into the overflow column.  The session's path hash keeps
+folding across messages (order-aware session identity).
+
+Verdict semantics per session:
+
+  * a message that CRASHES ends the session with FUZZ_CRASH — later
+    messages do not execute (frozen lanes, exactly like the batched
+    engine's halted-lane freeze);
+  * a message still running after ``max_steps`` is a hang: the
+    session reports FUZZ_RUNNING and callers map it to FUZZ_HANG,
+    the same contract as ``vm.run_batch``;
+  * otherwise the session completes FUZZ_NONE with the LAST
+    message's exit code.
+
+State x edge attribution: each message's edge hit counts are added
+both to the session total (the classic AFL map dimension) and to the
+``se_counts[lane, s, :]`` row where ``s`` is the abstract protocol
+state ENTERING the message (``state_reg`` clipped to ``n_states``,
+read after the previous message) — PTrix-style state-sensitive
+feedback with the state machine's own notion of position.
+
+Two executors, parity-pinned against each other:
+
+  * ``run_session_batch`` — the in-scan path: ONE jitted program
+    scans the messages (scan-within-the-scan when the generation
+    loop drives it);
+  * ``host_reference_session_batch`` — the host-driven per-message
+    reference loop: framing parsed on host, one device dispatch per
+    message, machine state round-tripping through numpy.  This is
+    the semantic anchor the acceptance gate pins the device path to.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import FUZZ_CRASH, FUZZ_NONE, FUZZ_RUNNING
+from ..models.vm import N_REGS, _step_batched
+from . import StatefulSpec
+from .framing import parse_frames, parse_frames_np
+
+
+class SessionResult(NamedTuple):
+    """Per-lane outcome of a batched session execution.  ``status``
+    keeps the engine contract: FUZZ_RUNNING = the session hung
+    (callers map to FUZZ_HANG).  ``counts`` is the session-total
+    static-edge record (the classic map dimension); ``se_counts`` the
+    state x edge record (uint8[B, n_states, E+1], wrapping like every
+    AFL count)."""
+    status: jax.Array      # int32[B]
+    exit_code: jax.Array   # int32[B]
+    counts: jax.Array      # uint8[B, E+1] session-total edge counts
+    steps: jax.Array       # int32[B] total steps across messages
+    path_hash: jax.Array   # uint32[B] folded across messages
+    msgs: jax.Array        # int32[B] messages actually executed
+    state_final: jax.Array  # int32[B] abstract state after the last msg
+    se_counts: jax.Array   # uint8[B, S, E+1] state x edge counts
+
+
+def _gather_message(bufs, offs):
+    """msg[b, i] = bufs[b, offs[b] + i] (clipped) — the per-message
+    window of the framed buffer.  A gather, not a one-hot matmul:
+    this runs once per MESSAGE, not once per VM step, so the
+    engine's gather-avoidance rationale does not apply."""
+    L = bufs.shape[1]
+    idx = offs[:, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
+    return jnp.take_along_axis(bufs, jnp.clip(idx, 0, L - 1), axis=1)
+
+
+def _exec_message(instrs, edge_table, msg_bufs, mlens, regs, mem,
+                  path_hash, live, mem_size, max_steps, n_edges):
+    """One message for all lanes, from carried machine state: pc and
+    the coverage chain re-enter at zero, ``live=False`` lanes freeze
+    (status FUZZ_NONE -> every _step_batched update masks off).
+    Returns (status, exit_code, msg_counts, regs', mem', path_hash',
+    lane_steps)."""
+    b = msg_bufs.shape[0]
+    state0 = (jnp.zeros(b, jnp.int32),                    # pc
+              regs, mem,
+              jnp.zeros(b, jnp.int32),                    # prev_loc
+              jnp.where(live, FUZZ_RUNNING, FUZZ_NONE
+                        ).astype(jnp.int32),              # status
+              jnp.zeros(b, jnp.int32),                    # exit_code
+              jnp.zeros(b, jnp.int32),                    # prev_idx
+              jnp.zeros((b, n_edges + 1), jnp.uint8),     # counts
+              path_hash,
+              jnp.zeros((b, 0), jnp.int32),               # edges (off)
+              jnp.int32(0),
+              jnp.zeros(b, jnp.int32))                    # lane_steps
+    bufs_t = msg_bufs.T
+    mlens = mlens.astype(jnp.int32)
+
+    def cond(s):
+        return jnp.any(s[4] == FUZZ_RUNNING) & (s[10] < max_steps)
+
+    def body(s):
+        return _step_batched(instrs, edge_table, bufs_t, mlens,
+                             mem_size, False, s)
+
+    f = jax.lax.while_loop(cond, body, state0)
+    return f[4], f[5], f[7], f[1], f[2], f[8], f[11]
+
+
+@partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
+                                   "m_max", "n_states", "state_reg"))
+def _run_session_impl(instrs, edge_table, bufs, lengths, mem_size,
+                      max_steps, n_edges, m_max, n_states, state_reg):
+    """The in-scan session executor: parse framing on device, then
+    scan the (static) m_max message slots with the machine state in
+    the carry.  Inactive slots (k >= count, or the session already
+    crashed/hung) execute as frozen lanes — zero counts, zero
+    steps — so the scan is shape-static and branch-free."""
+    b, L = bufs.shape
+    bufs = bufs.astype(jnp.uint8)
+    m, offs, mlens = parse_frames(bufs, lengths, m_max)
+
+    def one_message(carry, xs):
+        (regs, mem, ph, crashed, hung, exit_code, counts, se, steps,
+         state_abs, msgs_done) = carry
+        k, off_k, len_k = xs
+        live = (k < m) & ~crashed & ~hung
+        msg = _gather_message(bufs, off_k)
+        st, ec, mc, regs2, mem2, ph2, lane_steps = _exec_message(
+            instrs, edge_table, msg, len_k, regs, mem, ph, live,
+            mem_size, max_steps, n_edges)
+        crashed = crashed | (live & (st == FUZZ_CRASH))
+        hung = hung | (live & (st == FUZZ_RUNNING))
+        completed = live & (st == FUZZ_NONE)
+        exit_code = jnp.where(completed, ec, exit_code)
+        counts = counts + mc
+        # state x edge: attribute this message's counts to the state
+        # ENTERING it (one-hot over the small state axis — no gather)
+        onehot = (jnp.arange(n_states, dtype=jnp.int32)[None, :]
+                  == state_abs[:, None])
+        se = se + (onehot[:, :, None].astype(jnp.uint8)
+                   * mc[:, None, :])
+        steps = steps + lane_steps
+        regs = jnp.where(live[:, None], regs2, regs)
+        mem = jnp.where(live[:, None], mem2, mem)
+        ph = jnp.where(live, ph2, ph)
+        state_abs = jnp.where(
+            live, jnp.clip(regs[:, state_reg], 0, n_states - 1),
+            state_abs)
+        msgs_done = msgs_done + live.astype(jnp.int32)
+        return (regs, mem, ph, crashed, hung, exit_code, counts, se,
+                steps, state_abs, msgs_done), None
+
+    carry0 = (jnp.zeros((b, N_REGS), jnp.int32),
+              jnp.zeros((b, mem_size), jnp.int32),
+              jnp.zeros(b, jnp.uint32),
+              jnp.zeros(b, bool), jnp.zeros(b, bool),
+              jnp.zeros(b, jnp.int32),
+              jnp.zeros((b, n_edges + 1), jnp.uint8),
+              jnp.zeros((b, n_states, n_edges + 1), jnp.uint8),
+              jnp.zeros(b, jnp.int32),
+              jnp.zeros(b, jnp.int32),
+              jnp.zeros(b, jnp.int32))
+    xs = (jnp.arange(m_max, dtype=jnp.int32), offs.T, mlens.T)
+    carry, _ = jax.lax.scan(one_message, carry0, xs)
+    (regs, mem, ph, crashed, hung, exit_code, counts, se, steps,
+     state_abs, msgs_done) = carry
+    status = jnp.where(crashed, FUZZ_CRASH,
+                       jnp.where(hung, FUZZ_RUNNING, FUZZ_NONE)
+                       ).astype(jnp.int32)
+    return SessionResult(status=status, exit_code=exit_code,
+                         counts=counts, steps=steps, path_hash=ph,
+                         msgs=msgs_done, state_final=state_abs,
+                         se_counts=se)
+
+
+def run_session_batch(program, inputs, lengths,
+                      spec: StatefulSpec) -> SessionResult:
+    """Execute a uint8[B, L] framed-sequence batch through
+    ``program`` as sessions.  Pure (no virgin maps touched) — triage
+    layers on top exactly like ``vm.run_batch``."""
+    return _run_session_impl(
+        jnp.asarray(program.instrs), jnp.asarray(program.edge_table),
+        jnp.asarray(inputs, dtype=jnp.uint8),
+        jnp.asarray(lengths, dtype=jnp.int32),
+        program.mem_size, program.max_steps, program.n_edges,
+        spec.m_max, spec.n_states, spec.state_reg)
+
+
+# --------------------------------------------------------------------
+# Host-driven per-message reference loop (the parity anchor)
+# --------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("mem_size", "max_steps",
+                                   "n_edges"))
+def _host_msg_step(instrs, edge_table, msg_bufs, mlens, regs, mem,
+                   path_hash, live, mem_size, max_steps, n_edges):
+    """One message as ONE device dispatch (the host loop's unit)."""
+    return _exec_message(instrs, edge_table, msg_bufs, mlens, regs,
+                         mem, path_hash, live, mem_size, max_steps,
+                         n_edges)
+
+
+def host_reference_session_batch(program, inputs, lengths,
+                                 spec: StatefulSpec) -> SessionResult:
+    """The reference semantics ``run_session_batch`` is pinned to:
+    framing parsed on HOST (``parse_frames_np``), one device dispatch
+    per message, machine state round-tripping through numpy between
+    messages.  Bit-identical results (tests/test_stateful.py)."""
+    inputs = np.asarray(inputs, dtype=np.uint8)
+    lengths = np.asarray(lengths, dtype=np.int64)
+    b, L = inputs.shape
+    S = spec.n_states
+    E1 = program.n_edges + 1
+    m, offs, mlens = parse_frames_np(inputs, lengths, spec.m_max)
+
+    regs = np.zeros((b, N_REGS), np.int32)
+    mem = np.zeros((b, program.mem_size), np.int32)
+    ph = np.zeros(b, np.uint32)
+    crashed = np.zeros(b, bool)
+    hung = np.zeros(b, bool)
+    exit_code = np.zeros(b, np.int32)
+    counts = np.zeros((b, E1), np.uint8)
+    se = np.zeros((b, S, E1), np.uint8)
+    steps = np.zeros(b, np.int32)
+    state_abs = np.zeros(b, np.int32)
+    msgs_done = np.zeros(b, np.int32)
+
+    instrs = jnp.asarray(program.instrs)
+    edge_table = jnp.asarray(program.edge_table)
+    idx_cols = np.arange(L, dtype=np.int64)
+    for k in range(spec.m_max):
+        live = (k < m) & ~crashed & ~hung
+        if not live.any():
+            break
+        idx = np.clip(offs[:, k, None].astype(np.int64)
+                      + idx_cols[None, :], 0, L - 1)
+        msg = np.take_along_axis(inputs, idx, axis=1)
+        st, ec, mc, regs2, mem2, ph2, lane_steps = (
+            np.asarray(a) for a in _host_msg_step(
+                instrs, edge_table, jnp.asarray(msg),
+                jnp.asarray(mlens[:, k]), jnp.asarray(regs),
+                jnp.asarray(mem), jnp.asarray(ph), jnp.asarray(live),
+                program.mem_size, program.max_steps, program.n_edges))
+        crashed |= live & (st == FUZZ_CRASH)
+        hung |= live & (st == FUZZ_RUNNING)
+        completed = live & (st == FUZZ_NONE)
+        exit_code = np.where(completed, ec, exit_code)
+        counts = counts + mc          # uint8 wrap, like the engine
+        onehot = (np.arange(S)[None, :] == state_abs[:, None])
+        se = se + (onehot[:, :, None].astype(np.uint8)
+                   * mc[:, None, :])
+        steps = steps + lane_steps
+        regs = np.where(live[:, None], regs2, regs)
+        mem = np.where(live[:, None], mem2, mem)
+        ph = np.where(live, ph2, ph)
+        state_abs = np.where(
+            live, np.clip(regs[:, spec.state_reg], 0, S - 1),
+            state_abs)
+        msgs_done = msgs_done + live.astype(np.int32)
+    status = np.where(crashed, FUZZ_CRASH,
+                      np.where(hung, FUZZ_RUNNING, FUZZ_NONE)
+                      ).astype(np.int32)
+    return SessionResult(status=status, exit_code=exit_code,
+                         counts=counts, steps=steps, path_hash=ph,
+                         msgs=msgs_done, state_final=state_abs,
+                         se_counts=se)
+
+
+# --------------------------------------------------------------------
+# Signatures (corpus sidecars / showmap / kb-corpus)
+# --------------------------------------------------------------------
+
+def state_edge_pairs(se_row: np.ndarray,
+                     edge_slot: np.ndarray) -> list:
+    """One lane's state x edge signature as sorted ``[state, slot]``
+    pairs (AFL map slots, the externally meaningful edge id; the
+    overflow column is dropped).  The sidecar / picker / kb-corpus
+    wire format."""
+    se_row = np.asarray(se_row)
+    slots = np.asarray(edge_slot)
+    pairs = set()
+    ss, ee = np.nonzero(se_row[:, :-1])
+    for s, e in zip(ss, ee):
+        pairs.add((int(s), int(slots[e])))
+    return [[s, e] for s, e in sorted(pairs)]
+
+
+def run_single_session(program, buf: bytes,
+                       spec: StatefulSpec) -> Tuple[SessionResult,
+                                                    list]:
+    """One framed input as a 1-lane session (tools / the admission
+    signer).  Returns (its SessionResult row, its state x edge
+    signature pairs)."""
+    L = max(((len(buf) + 7) // 8) * 8, 8)
+    arr = np.zeros((1, L), dtype=np.uint8)
+    if buf:
+        arr[0, :len(buf)] = np.frombuffer(buf, dtype=np.uint8)
+    res = run_session_batch(program, arr,
+                            np.array([len(buf)], np.int32), spec)
+    res = SessionResult(*(np.asarray(a) for a in res))
+    return res, state_edge_pairs(res.se_counts[0], program.edge_slot)

@@ -67,7 +67,12 @@ EVENT_TYPES = ("new_path", "crash", "hang", "plateau",
                # quarantine.py): one peer-exchange round, a batch of
                # rejected synced-in entries, and a peer crossing the
                # poison threshold into a timed ban
-               "gossip_round", "sync_quarantine", "peer_banned")
+               "gossip_round", "sync_quarantine", "peer_banned",
+               # stateful session tier (killerbeez_tpu/stateful/):
+               # the state x edge coverage high-water rose — pairs =
+               # touched (state, edge) buckets, states = distinct
+               # protocol states seen (kb-timeline's session section)
+               "state_cov")
 
 #: events a fleet worker forwards to the manager alongside heartbeats
 TERMINAL_EVENTS = ("crash", "hang", "plateau")

@@ -37,13 +37,17 @@ def _fmt_age(seconds: float) -> str:
 
 
 def render_ls(entries: List[CorpusEntry]) -> str:
-    lines = [f"{'md5':<32}  {'size':>6}  {'edges':>5}  {'sel':>6}  "
-             f"{'finds':>6}  {'src':<5}  {'age':>6}  parent"]
+    lines = [f"{'md5':<32}  {'size':>6}  {'edges':>5}  {'states':>6}  "
+             f"{'sel':>6}  {'finds':>6}  {'src':<5}  {'age':>6}  "
+             f"parent"]
     now = time.time()
     for e in entries:
+        n_states = (len({p[0] for p in e.state_sig})
+                    if e.state_sig else None)
         lines.append(
             f"{e.md5:<32}  {len(e.buf):>6}  "
             f"{len(e.sig) if e.sig else '-':>5}  "
+            f"{n_states if n_states is not None else '-':>6}  "
             f"{e.selections:>6.2f}  {e.finds:>6.2f}  "
             f"{e.source:<5}  {_fmt_age(max(now - e.discovered, 0)):>6}"
             f"  {e.parent or '-'}")
@@ -67,6 +71,21 @@ def render_stats(entries: List[CorpusEntry],
         rare = sorted(edges.items(), key=lambda kv: (kv[1], kv[0]))[:5]
         lines.append("rarest edges   : " + ", ".join(
             f"{s} (hit by {n})" for s, n in rare))
+    # stateful session tier: the corpus-wide state x edge frontier
+    # (entries carry their state_sig sidecars from the session
+    # signer; kb-corpus is the offline view of the state_cov gauges)
+    st_entries = [e for e in entries if e.state_sig]
+    if st_entries:
+        pairs = {tuple(p) for e in st_entries for p in e.state_sig}
+        per_state: Dict[int, int] = {}
+        for s, _slot in pairs:
+            per_state[s] = per_state.get(s, 0) + 1
+        lines.append(
+            f"state coverage : {len(per_state)} protocol states, "
+            f"{len(pairs)} state x edge pairs across "
+            f"{len(st_entries)} session entries ("
+            + ", ".join(f"s{s}:{n}" for s, n in
+                        sorted(per_state.items())) + ")")
     by_src: Dict[str, int] = {}
     for e in entries:
         by_src[e.source] = by_src.get(e.source, 0) + 1
@@ -119,7 +138,8 @@ def compact(store: CorpusStore, entries: List[CorpusEntry],
                 sig = signer(e.buf)
                 if sig:
                     e.sig = sorted(set(sig))
-                    e.cov_hash = coverage_hash(e.sig, e.buf)
+                    e.cov_hash = coverage_hash(e.sig, e.buf,
+                                               e.state_sig)
                     if not dry_run:
                         store.update_meta(e)
     signed = {e.md5: set(e.sig) for e in entries if e.sig}

@@ -67,10 +67,16 @@ def _load_programs(args) -> List:
 
 
 def lint_report(program, want_dict: bool = False) -> Dict:
-    """One target's full report (the --json per-target payload)."""
+    """One target's full report (the --json per-target payload).
+    Stateful targets (registered in models.targets_stateful) get the
+    session-tier checks automatically: state-unreachable /
+    state-clip warnings and the dead-block -> session-only-block
+    downgrade."""
+    from ..models.targets_stateful import get_stateful_spec
     cfg = build_cfg(program)
     df = analyze_dataflow(program)
-    findings = lint_program(program, cfg, df)
+    findings = lint_program(program, cfg, df,
+                            stateful=get_stateful_spec(program.name))
     rep = {
         "stats": universe_stats(program, cfg),
         "findings": [f.as_dict() for f in findings],

@@ -143,8 +143,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = p.parse_args(argv)
     try:
         setup_logging(args.logging_options)
+        # device KBVM targets report raw bitmaps only with edge
+        # recording on (jit_harness.last_trace; same forcing as
+        # showmap/tracer — a no-op for host instrumentations)
+        from .tracer import force_edges_option
         instrumentation = instrumentation_factory(
-            args.instrumentation, args.instrumentation_options)
+            args.instrumentation,
+            force_edges_option(args.instrumentation_options))
         driver = driver_factory(args.driver, args.driver_options,
                                 instrumentation, None)
         seeds = [read_file(s) for s in args.seeds]
@@ -166,6 +171,24 @@ def main(argv: Optional[List[str]] = None) -> int:
             from ..analysis.lint import universe_stats
             report["static"] = universe_stats(program,
                                               build_cfg(program))
+        # stateful session tier: the seeds' state x edge signatures
+        # (which protocol states each seed drives and what it covers
+        # from them) — the state-aware twin of the static section
+        sig_fn = getattr(instrumentation, "state_signature", None)
+        spec = getattr(instrumentation, "stateful_spec", None)
+        if sig_fn is not None and spec is not None:
+            per_seed = [sig_fn(s) for s in seeds]
+            all_pairs = sorted({tuple(p) for sig in per_seed
+                                for p in (sig or [])})
+            report["state"] = {
+                "n_states": int(spec.n_states),
+                "m_max": int(spec.m_max),
+                "state_reg": int(spec.state_reg),
+                "states_reached": sorted({p[0] for p in all_pairs}),
+                "pairs": [list(p) for p in all_pairs],
+                "per_seed": [{"file": f, "pairs": sig or []}
+                             for f, sig in zip(args.seeds, per_seed)],
+            }
         # per-module report (reference picker/main.c:163-282 walks
         # modules): classification + partition-LOCAL ignore mask per
         # module; the top-level full-map mask stays the

@@ -150,6 +150,12 @@ def render(snap: Dict[str, Any]) -> str:
             line += (f" | {_fmt_n(c.get('findings_ring_drops', 0))} "
                      "findings-ring drops")
         lines.append(line)
+    if g.get("state_cov_pairs"):
+        lines.append(
+            f"  stateful : "
+            f"{int(g.get('state_cov_states', 0))} protocol states "
+            f"seen | {_fmt_n(g.get('state_cov_pairs', 0))} "
+            f"state x edge pairs covered")
     lines.append(
         f"  crashes  : {_fmt_n(c.get('crashes', 0))}"
         f" ({_fmt_n(c.get('unique_crashes', 0))} unique)"

@@ -296,6 +296,29 @@ def generations_report(spans: List[Dict[str, Any]],
     return report
 
 
+def sessions_report(events: List[Dict[str, Any]]
+                    ) -> Optional[Dict[str, Any]]:
+    """Stateful session tier: the campaign's ``state_cov`` events
+    (one per state x edge coverage high-water increase,
+    fuzzer/loop.py) as a growth summary — how many protocol states
+    the campaign reached and how the state x edge frontier moved
+    over the run.  None for non-stateful campaigns."""
+    sc = [e for e in list(events)
+          if e.get("type") == "state_cov"]
+    if not sc:
+        return None
+    sc.sort(key=lambda e: float(e.get("t", 0.0)))
+    first, last = sc[0], sc[-1]
+    return {
+        "increases": len(sc),
+        "pairs": int(last.get("pairs", 0)),
+        "states": int(last.get("states", 0)),
+        "first_pairs": int(first.get("pairs", 0)),
+        "window_s": float(last.get("t", 0.0))
+        - float(first.get("t", 0.0)),
+    }
+
+
 # -- events -------------------------------------------------------------
 
 
@@ -545,6 +568,13 @@ def render(report: Dict[str, Any], lanes: List[str]) -> str:
                 f"    shard-{sid:<4} {sd['dispatches']} dispatches, "
                 f"{sd['generations_total']} generations, "
                 f"{sd['occupancy']:.1%} occupancy")
+    sr = report.get("sessions")
+    if sr:
+        lines.append(
+            f"  sessions      : {sr['states']} protocol states "
+            f"reached, {sr['pairs']} state x edge pairs covered "
+            f"({sr['increases']} coverage increases over "
+            f"{sr['window_s']:.1f}s)")
     bubbles = report.get("bubbles", [])
     lines.append(
         f"  bubbles       : {len(bubbles)} detected, "
@@ -629,6 +659,9 @@ def build_report(doc: Optional[Dict[str, Any]],
             report["generations"] = gr
     if events:
         report["events"] = event_summary(events)
+        sr = sessions_report(events)
+        if sr:
+            report["sessions"] = sr
     if events and stats:
         report["reconcile"] = reconcile(events, stats)
     return report

@@ -533,7 +533,8 @@ def test_kb_lint_sarif_clean_targets(capsys):
     for r in run["results"]:
         uri = r["locations"][0]["physicalLocation"][
             "artifactLocation"]["uri"]
-        assert uri.endswith(("targets.py", "targets_cgc.py")), uri
+        assert uri.endswith(("targets.py", "targets_cgc.py",
+                             "targets_stateful.py")), uri
 
 
 def test_kb_lint_sarif_error_levels_and_exit(tmp_path, capsys):

@@ -1,0 +1,686 @@
+"""Stateful protocol fuzzing tier (ISSUE 12): framed session
+sequences executed message-by-message on device with state x edge
+novelty.
+
+Pins the ISSUE 12 contracts:
+  * the framing codec is total and host/device parity-pinned
+    (property-tested over random buffers);
+  * the in-scan session executor is bit-identical to the host-driven
+    per-message reference loop (machine state round-tripping through
+    numpy between messages);
+  * with feedback off, the -G in-scan sequence path is bit-identical
+    to the host-driven stateful loop — findings AND both virgin maps
+    — single-chip and dp>1 (the mesh generation scan);
+  * the stateful built-ins' deep states are provably single-shot
+    unreachable (dataflow + solver certificate) and sequences reach
+    them;
+  * multipart framed mutation never corrupts message boundaries
+    (frame -> mutate -> reframe property test);
+  * per-message dictionary groups scope tokens by protocol state;
+  * corpus sidecars carry state_sig, kb-corpus renders it, kb-lint
+    downgrades session-only dead blocks and flags unreachable
+    states, telemetry gauges/events/kb-timeline surface the tier.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from killerbeez_tpu import FUZZ_CRASH, FUZZ_NONE
+from killerbeez_tpu.fuzzer.loop import Fuzzer
+from killerbeez_tpu.drivers.factory import driver_factory
+from killerbeez_tpu.instrumentation.factory import (
+    instrumentation_factory,
+)
+from killerbeez_tpu.models import targets_stateful as ts
+from killerbeez_tpu.models.targets import get_target
+from killerbeez_tpu.mutators.factory import mutator_factory
+from killerbeez_tpu.stateful import (
+    StatefulSpec, frame_messages, unframe,
+)
+from killerbeez_tpu.stateful.framing import (
+    compose_manager_seed, parse_frames, parse_frames_np,
+)
+from killerbeez_tpu.stateful.session import (
+    host_reference_session_batch, run_session_batch,
+    run_single_session, state_edge_pairs,
+)
+
+
+def _findings(root):
+    out = {}
+    for kind in ("crashes", "hangs", "new_paths"):
+        d = os.path.join(root, kind)
+        out[kind] = sorted(
+            f for f in (os.listdir(d) if os.path.isdir(d) else [])
+            if len(f) == 32)
+    return out
+
+
+SPEC = ts.get_stateful_spec("session_auth")
+
+
+# ---------------------------------------------------------------------------
+# framing codec
+# ---------------------------------------------------------------------------
+
+def test_frame_unframe_roundtrip():
+    msgs = [b"Lpw", b"QA", b"X"]
+    buf = frame_messages(msgs, 4)
+    assert unframe(buf, 4) == msgs
+    # strict encoder bounds
+    with pytest.raises(ValueError):
+        frame_messages([], 4)
+    with pytest.raises(ValueError):
+        frame_messages([b"x"] * 5, 4)
+    with pytest.raises(ValueError):
+        frame_messages([b"y" * 300], 4)
+
+
+def test_framing_parse_total_and_host_device_parity():
+    """Any byte soup parses, and the device parse agrees with the
+    host parse byte-for-byte (the boundary contract both session
+    executors share)."""
+    rng = np.random.default_rng(42)
+    B, L = 128, 40
+    bufs = rng.integers(0, 256, size=(B, L), dtype=np.uint8)
+    lens = rng.integers(0, L + 1, size=B).astype(np.int32)
+    for m_max in (1, 3, 4, 8):
+        m_h, off_h, len_h = parse_frames_np(bufs, lens, m_max)
+        m_d, off_d, len_d = parse_frames(bufs, lens, m_max)
+        assert np.array_equal(m_h, np.asarray(m_d))
+        assert np.array_equal(off_h, np.asarray(off_d))
+        assert np.array_equal(len_h, np.asarray(len_d))
+        # row-wise agreement with the scalar host unframe
+        for i in range(0, B, 17):
+            msgs = unframe(bytes(bufs[i, :lens[i]]), m_max)
+            assert len(msgs) == int(m_h[i])
+            for k, m in enumerate(msgs):
+                assert len(m) == int(len_h[i, k])
+
+
+def test_kb_frame_cli(tmp_path):
+    from killerbeez_tpu.stateful.framing import main as frame_main
+    out = tmp_path / "seq.bin"
+    rc = frame_main(["-o", str(out), "-s", "Lpw", "-s", "Q",
+                     "--m-max", "4"])
+    assert rc == 0
+    assert unframe(out.read_bytes(), 4) == [b"Lpw", b"Q"]
+
+
+# ---------------------------------------------------------------------------
+# session executor semantics + host/device parity
+# ---------------------------------------------------------------------------
+
+def test_session_runs_seed_sequences():
+    for name in ts.stateful_target_names():
+        prog = get_target(name)
+        spec = ts.get_stateful_spec(name)
+        res, pairs = run_single_session(prog, ts.framed_seed(name),
+                                        spec)
+        assert int(res.status[0]) == FUZZ_NONE
+        assert int(res.msgs[0]) == len(ts.seed_sequence(name))
+        assert pairs and all(0 <= s < spec.n_states
+                             for s, _ in pairs)
+        # deep states actually visited by the benign seed
+        assert len({s for s, _ in pairs}) >= 2
+
+
+def test_session_crash_sequences():
+    prog = get_target("session_auth")
+    seq = frame_messages([b"Lpw", b"QZ", b"QZ"], SPEC.m_max)
+    res, _ = run_single_session(prog, seq, SPEC)
+    assert int(res.status[0]) == FUZZ_CRASH
+    assert int(res.msgs[0]) == 3
+    # without login the same queries are denied, no crash
+    seq = frame_messages([b"QZ", b"QZ", b"QZ"], SPEC.m_max)
+    res, _ = run_single_session(prog, seq, SPEC)
+    assert int(res.status[0]) == FUZZ_NONE
+
+    prog = get_target("tcp_like")
+    spec = ts.get_stateful_spec("tcp_like")
+    seq = frame_messages([b"S\x10", b"A\x11", b"D\xf0!"], spec.m_max)
+    res, _ = run_single_session(prog, seq, spec)
+    assert int(res.status[0]) == FUZZ_CRASH
+    # wrong ack cookie: reset, no establishment, no crash
+    seq = frame_messages([b"S\x10", b"A\x77", b"D\xf0!"], spec.m_max)
+    res, _ = run_single_session(prog, seq, spec)
+    assert int(res.status[0]) == FUZZ_NONE
+
+
+@pytest.mark.parametrize("name", ["session_auth", "tcp_like"])
+def test_session_host_reference_parity(name):
+    """The in-scan session executor == the host-driven per-message
+    reference loop, field for field, over random byte soup AND
+    mutated valid sequences."""
+    prog = get_target(name)
+    spec = ts.get_stateful_spec(name)
+    rng = np.random.default_rng(7)
+    B, L = 96, 48
+    bufs = rng.integers(0, 256, size=(B, L), dtype=np.uint8)
+    seed = ts.framed_seed(name)
+    bufs[0, :len(seed)] = np.frombuffer(seed, np.uint8)
+    lens = rng.integers(0, L + 1, size=B).astype(np.int32)
+    lens[0] = len(seed)
+    dev = run_session_batch(prog, bufs, lens, spec)
+    host = host_reference_session_batch(prog, bufs, lens, spec)
+    for f in dev._fields:
+        assert np.array_equal(np.asarray(getattr(dev, f)),
+                              np.asarray(getattr(host, f))), f
+
+
+def test_session_machine_state_carries_across_messages():
+    """tcp_like's ACK cookie lives in scratch MEMORY written by the
+    SYN handler — correct acks only work because mem persists."""
+    prog = get_target("tcp_like")
+    spec = ts.get_stateful_spec("tcp_like")
+    good = frame_messages([b"S\x30", b"A\x31"], spec.m_max)
+    res, _ = run_single_session(prog, good, spec)
+    assert int(res.state_final[0]) == 2      # ESTABLISHED
+    bad = frame_messages([b"S\x30", b"A\x30"], spec.m_max)
+    res, _ = run_single_session(prog, bad, spec)
+    assert int(res.state_final[0]) == 0      # reset
+
+
+# ---------------------------------------------------------------------------
+# deep states: the unreachability certificate
+# ---------------------------------------------------------------------------
+
+def test_deep_state_certificate():
+    """Every deep block is constprop-dead single-shot AND the exact
+    solver refutes every deep edge with zero satisfiable paths —
+    while the benign seed SEQUENCE lights deep blocks."""
+    from killerbeez_tpu.analysis.solver import solve_edge, unknown_kind
+    for name in ts.stateful_target_names():
+        prog = get_target(name)
+        deep = ts.deep_state_blocks(prog)
+        assert deep, name
+        ef = np.asarray(prog.edge_from)
+        et = np.asarray(prog.edge_to)
+        for e in ts.deep_state_edges(prog):
+            r = solve_edge(prog, (int(ef[e]), int(et[e])))
+            assert r.status in ("unsat", "unknown")
+            assert r.paths_tried == 0
+            if r.status == "unknown":
+                assert unknown_kind(r.reason) == "model"
+        # the seed sequence executes deep blocks (counts on deep
+        # edges are nonzero)
+        spec = ts.get_stateful_spec(name)
+        res, _ = run_single_session(prog, ts.framed_seed(name), spec)
+        counts = np.asarray(res.counts)[0, :-1]
+        assert any(counts[e] for e in ts.deep_state_edges(prog)), name
+        # ...and the static session half agrees: every deep block is
+        # session-reachable (protocol fixpoint)
+        from killerbeez_tpu.stateful.protocol import (
+            session_reachable_blocks,
+        )
+        assert set(deep) <= session_reachable_blocks(prog, spec)
+
+
+def test_single_shot_cannot_reach_deep_slots():
+    """The same framed seed executed STATELESSLY (stateful off)
+    never lights a collision-free deep slot."""
+    prog = get_target("session_auth")
+    instr = instrumentation_factory(
+        "jit_harness", json.dumps({"target": "session_auth"}))
+    mut = mutator_factory("havoc", '{"seed": 3}',
+                          ts.framed_seed("session_auth"))
+    drv = driver_factory("file", None, instr, mut)
+    fz = Fuzzer(drv, output_dir="unused", batch_size=128,
+                write_findings=False, telemetry=False, feedback=0)
+    fz.run(1024)
+    slots = np.asarray(prog.edge_slot)
+    deep = set(ts.deep_state_edges(prog))
+    shallow_slots = {int(slots[e]) for e in range(len(slots))
+                     if e not in deep}
+    deep_slots = {int(slots[e]) for e in deep} - shallow_slots
+    vb = np.asarray(instr.virgin_bits)
+    assert deep_slots and all(vb[s] == 0xFF for s in deep_slots)
+
+
+# ---------------------------------------------------------------------------
+# state x edge triage
+# ---------------------------------------------------------------------------
+
+def test_state_triage_exact_matches_np_witness():
+    from killerbeez_tpu.stateful.coverage import (
+        fresh_virgin_state, np_state_triage_exact, state_triage,
+        state_triage_exact,
+    )
+    rng = np.random.default_rng(5)
+    B, S, E1 = 32, 4, 9
+    se = rng.integers(0, 4, size=(B, S, E1), dtype=np.uint8)
+    se[rng.random((B, S, E1)) < 0.8] = 0
+    v0 = np.full(S * E1, 0xFF, np.uint8)
+    rets_j, v_j = state_triage_exact(np.asarray(v0), np.asarray(se))
+    rets_n, v_n = np_state_triage_exact(v0, se)
+    assert np.array_equal(np.asarray(rets_j), rets_n)
+    assert np.array_equal(np.asarray(v_j), v_n)
+    # throughput mode: same final virgin union for distinct lanes,
+    # over-reports duplicates but never under-reports
+    rets_t, v_t = state_triage(np.asarray(v0), np.asarray(se))
+    assert np.array_equal(np.asarray(v_t), v_n)
+    assert (np.asarray(rets_t) >= 0).all()
+
+
+def test_state_novelty_joins_the_verdict():
+    """A lane whose CLASSIC map is already known but whose state x
+    edge pairs are new still reports novelty (the tier's point)."""
+    instr = instrumentation_factory(
+        "jit_harness",
+        json.dumps({"target": "session_auth", "stateful": 1}))
+    # the same single message twice: 'Q' denied from START
+    one = frame_messages([b"QA"], SPEC.m_max)
+    # then 'L' + 'Q': the SAME query edges now run from AUTHED —
+    # classic map saw them (via run 1), the state map did not
+    two = frame_messages([b"Lpw", b"QA"], SPEC.m_max)
+
+    def run(buf):
+        L = max(len(one), len(two)) + 2
+        arr = np.zeros((1, L), np.uint8)
+        arr[0, :len(buf)] = np.frombuffer(buf, np.uint8)
+        res = instr.run_batch(arr, np.array([len(buf)], np.int32))
+        return int(np.asarray(res.new_paths)[0])
+
+    assert run(one) > 0                   # first ever exec: novel
+    assert run(one) == 0                  # replay: nothing new
+    assert run(two) == 2                  # query-from-AUTHED: the
+    # classic query edges exist, but (state=1, edge) pairs are new
+    # AND the login edges are classic-new too; replay is quiet
+    assert run(two) == 0
+
+
+def test_state_export_merge_and_layout_guard():
+    opts = json.dumps({"target": "tcp_like", "stateful": 1})
+    a = instrumentation_factory("jit_harness", opts)
+    buf = ts.framed_seed("tcp_like")
+    a.enable(buf)
+    st = a.get_state()
+    assert "virgin_state" in json.loads(st)
+    b = instrumentation_factory("jit_harness", opts)
+    b.set_state(st)
+    assert np.array_equal(np.asarray(a.virgin_state),
+                          np.asarray(b.virgin_state))
+    c = instrumentation_factory("jit_harness", opts)
+    c.merge(st)
+    assert np.array_equal(np.asarray(a.virgin_state),
+                          np.asarray(c.virgin_state))
+    # a mismatched n_states is rejected, not clamped
+    d = instrumentation_factory(
+        "jit_harness", json.dumps({"target": "tcp_like",
+                                   "stateful": 1, "n_states": 4}))
+    with pytest.raises(ValueError):
+        d.set_state(st)
+    # ...and so is a same-SIZED map built under a different state
+    # register (different state machine, would alias on AND-fold)
+    e = instrumentation_factory(
+        "jit_harness", json.dumps({"target": "tcp_like",
+                                   "stateful": 1, "state_reg": 6}))
+    with pytest.raises(ValueError, match="state spec mismatch"):
+        e.set_state(st)
+    with pytest.raises(ValueError, match="state spec mismatch"):
+        e.merge(st)
+
+
+# ---------------------------------------------------------------------------
+# host loop vs -G parity (single-chip), and dp>1 (mesh scan)
+# ---------------------------------------------------------------------------
+
+def _run_campaign(tmp_path, tag, generations, mesh=None, execs=512,
+                  batch=64, target="tcp_like"):
+    out = str(tmp_path / tag)
+    instr = instrumentation_factory(
+        "jit_harness", json.dumps({"target": target, "stateful": 1}))
+    mut = mutator_factory("havoc", '{"seed": 11}',
+                          ts.framed_seed(target))
+    if mesh:
+        from killerbeez_tpu.parallel import ShardedCampaignDriver
+        drv = ShardedCampaignDriver(mesh, instr, mut,
+                                    batch_size=batch)
+    else:
+        drv = driver_factory("file", None, instr, mut)
+    fz = Fuzzer(drv, output_dir=out, batch_size=batch, feedback=0,
+                generations=generations, telemetry=False)
+    fz.run(execs)
+    return (_findings(out), np.asarray(instr.virgin_bits),
+            np.asarray(instr.virgin_state))
+
+
+def test_generations_parity_single_chip(tmp_path):
+    """-G 4 stateful == the host-driven stateful loop with feedback
+    off: findings and BOTH virgin maps bit-identical."""
+    fa, vba, vsa = _run_campaign(tmp_path, "host", 0)
+    fb, vbb, vsb = _run_campaign(tmp_path, "gen", 4)
+    assert fa == fb
+    assert fa["new_paths"]                # the run actually found
+    assert np.array_equal(vba, vbb)
+    assert np.array_equal(vsa, vsb)
+
+
+def test_generations_parity_mesh_dp2(tmp_path):
+    """dp>1: the mesh generation scan == the host-driven mesh loop,
+    stateful, feedback off (findings + both maps)."""
+    fa, vba, vsa = _run_campaign(tmp_path, "mhost", 0, mesh="2,1")
+    fb, vbb, vsb = _run_campaign(tmp_path, "mgen", 4, mesh="2,1")
+    assert fa == fb
+    assert fa["new_paths"]
+    assert np.array_equal(vba, vbb)
+    assert np.array_equal(vsa, vsb)
+
+
+@pytest.mark.slow
+def test_generations_parity_mesh_dp4_mp2(tmp_path):
+    fa, vba, vsa = _run_campaign(tmp_path, "m42h", 0, mesh="4,2")
+    fb, vbb, vsb = _run_campaign(tmp_path, "m42g", 4, mesh="4,2")
+    assert fa == fb
+    assert np.array_equal(vba, vbb)
+    assert np.array_equal(vsa, vsb)
+
+
+# ---------------------------------------------------------------------------
+# multipart framed mutation: boundary round-trip property
+# ---------------------------------------------------------------------------
+
+def test_multipart_framed_roundtrip_property():
+    """frame -> mutate -> reframe never corrupts message boundaries:
+    over random framings and child mutations, every composite
+    candidate splits back into exactly the child parts."""
+    rng = np.random.default_rng(9)
+    # fixed per-message length: every havoc child shares ONE
+    # compiled shape, so the property sweep doesn't pay a jit
+    # compile per (trial, part)
+    for trial, (n_parts, m_max) in enumerate([(1, 3), (3, 7)]):
+        msgs = [bytes(rng.integers(0, 256, size=16, dtype=np.uint8))
+                for _ in range(n_parts)]
+        opts = json.dumps({
+            "mutators": ["havoc"] * n_parts,
+            "mutator_options": [{"seed": trial * 10 + i}
+                                for i in range(n_parts)],
+            "framed": 1, "m_max": m_max})
+        mut = mutator_factory("manager", opts,
+                              compose_manager_seed(msgs))
+        for _ in range(8):
+            out = mut.mutate()
+            parts = unframe(out, m_max)
+            assert len(parts) == n_parts
+            assert parts == mut.current   # boundaries intact
+        bufs, lens = mut.mutate_batch(8)
+        bufs, lens = np.asarray(bufs), np.asarray(lens)
+        for i in range(8):
+            parts = unframe(bytes(bufs[i, :int(lens[i])]), m_max)
+            assert len(parts) == n_parts
+
+
+def test_multipart_accepts_framed_seed():
+    """A kb-frame sequence file works directly as a framed manager
+    seed (parts split out of the frame header), and framed mode
+    reports ONE driver input — the composite is a single buffer, so
+    single-input drivers (file) accept it."""
+    msgs = ts.seed_sequence("session_auth")
+    framed = frame_messages(msgs, 4)
+    opts = json.dumps({"mutators": ["havoc"] * len(msgs),
+                       "framed": 1, "m_max": 4})
+    mut = mutator_factory("manager", opts, framed)
+    assert mut.parts == msgs
+    assert unframe(mut.mutate(), 4)      # still well-formed
+    n_inputs, sizes = mut.get_input_info()
+    assert n_inputs == 1 and len(sizes) == 1
+    instr = instrumentation_factory(
+        "jit_harness",
+        json.dumps({"target": "session_auth", "stateful": 1}))
+    drv = driver_factory("file", None, instr, mut)  # must not raise
+    assert drv.supports_batch
+    # unframed manager keeps the multi-part contract (network
+    # drivers consume parts)
+    mut2 = mutator_factory(
+        "manager", json.dumps({"mutators": ["havoc"] * len(msgs)}),
+        compose_manager_seed(msgs))
+    assert mut2.get_input_info()[0] == len(msgs)
+
+
+# ---------------------------------------------------------------------------
+# per-message dictionary groups
+# ---------------------------------------------------------------------------
+
+def test_dictionary_groups_scope_by_state():
+    from killerbeez_tpu.stateful.dictionary import (
+        extract_dictionary_groups, manager_options_for_target,
+    )
+    prog = get_target("session_auth")
+    msgs = ts.seed_sequence("session_auth")
+    groups = extract_dictionary_groups(prog, SPEC, msgs)
+    assert len(groups) == len(msgs)
+    # the password belongs to the START message only; the query
+    # trigger byte 'Z' (a deep-handler constant the single-shot
+    # extraction cannot even see) appears exactly in AUTHED groups
+    assert b"pw" in groups[0] and b"Z" not in groups[0]
+    assert b"Z" in groups[1] and b"pw" not in groups[1]
+    # the turnkey manager options build a working mutator
+    opts = manager_options_for_target("session_auth")
+    mut = mutator_factory("manager", opts,
+                          compose_manager_seed(msgs))
+    out = mut.mutate()
+    assert len(unframe(out, SPEC.m_max)) == len(msgs)
+
+
+def test_flat_dictionary_misses_deep_tokens():
+    """The regression the grouped extraction fixes: the flat
+    single-shot pool has no 'Z' at all."""
+    from killerbeez_tpu.analysis import extract_dictionary
+    toks = extract_dictionary(get_target("session_auth"))
+    assert b"Z" not in toks
+
+
+# ---------------------------------------------------------------------------
+# lint: session-only downgrade + unreachable states
+# ---------------------------------------------------------------------------
+
+def test_lint_downgrades_session_only_blocks():
+    from killerbeez_tpu.analysis import lint_program
+    prog = get_target("session_auth")
+    plain = lint_program(prog)
+    stateful = lint_program(prog, stateful=SPEC)
+    dead_plain = [f for f in plain if f.code == "dead-block"]
+    assert dead_plain                    # single-shot view: dead
+    assert not [f for f in stateful if f.code == "dead-block"]
+    only = [f for f in stateful if f.code == "session-only-block"]
+    assert {f.data["block"] for f in only} == \
+        {f.data["block"] for f in dead_plain}
+    assert not [f for f in stateful
+                if f.code == "state-unreachable"]
+
+
+def test_lint_flags_unreachable_state():
+    """A guard on a state nothing ever assigns is dead protocol
+    surface — the state-unreachable warning."""
+    from killerbeez_tpu.analysis import lint_program
+    from killerbeez_tpu.models.compiler import Assembler
+    a = Assembler("badproto", mem_size=8, max_steps=64)
+    a.block()
+    a.ldi(1, 0)
+    a.ldb(1, 1)
+    a.ldi(2, ord("A"))
+    a.br("eq", 1, 2, "adv")
+    a.ldi(2, 5)                  # guard on state 5...
+    a.br("eq", 7, 2, "deep")
+    a.jmp("exit")
+    a.label("adv")
+    a.block()
+    a.ldi(7, 1)                  # ...but only state 1 is assigned
+    a.halt(0)
+    a.label("deep")
+    a.block()
+    a.halt(9)
+    a.label("exit")
+    a.block()
+    a.halt(0)
+    prog = a.build(block_seed=0xBAD)
+    spec = StatefulSpec(m_max=4, n_states=8, state_reg=7)
+    f = [f for f in lint_program(prog, stateful=spec)
+         if f.code == "state-unreachable"]
+    assert f and f[0].data["state"] == 5
+
+
+def test_lint_flags_state_clip():
+    from killerbeez_tpu.analysis import lint_program
+    from killerbeez_tpu.models.compiler import Assembler
+    a = Assembler("clipproto", mem_size=8, max_steps=64)
+    a.block()
+    a.ldi(7, 12)                 # n_states=8: clips into bucket 7
+    a.halt(0)
+    prog = a.build(block_seed=0xC11)
+    spec = StatefulSpec(m_max=2, n_states=8, state_reg=7)
+    f = [f for f in lint_program(prog, stateful=spec)
+         if f.code == "state-clip"]
+    assert f and f[0].data["value"] == 12
+
+
+# ---------------------------------------------------------------------------
+# corpus sidecars + tools + telemetry
+# ---------------------------------------------------------------------------
+
+def test_corpus_state_sig_sidecar_and_tools(tmp_path):
+    from killerbeez_tpu.corpus.store import CorpusStore
+    from killerbeez_tpu.tools.corpus_tool import (
+        render_ls, render_stats,
+    )
+    out = str(tmp_path / "camp")
+    corpus = os.path.join(out, "corpus")
+    instr = instrumentation_factory(
+        "jit_harness",
+        json.dumps({"target": "tcp_like", "stateful": 1}))
+    mut = mutator_factory("havoc", '{"seed": 11}',
+                          ts.framed_seed("tcp_like"))
+    drv = driver_factory("file", None, instr, mut)
+    fz = Fuzzer(drv, output_dir=out, batch_size=64, feedback=8,
+                corpus_dir=corpus, telemetry=False)
+    fz.run(1024)
+    entries = CorpusStore(corpus).load()
+    assert entries
+    signed = [e for e in entries if e.state_sig]
+    assert signed, "session entries must carry state_sig sidecars"
+    for e in signed:
+        for s, slot in e.state_sig:
+            assert 0 <= s < 8 and 0 <= slot < 65536
+    # round-trip through the sidecar JSON
+    e = signed[0]
+    reread = [x for x in CorpusStore(corpus).load()
+              if x.md5 == e.md5][0]
+    assert reread.state_sig == e.state_sig
+    # tools render the state dimension
+    assert "states" in render_ls(entries).splitlines()[0]
+    stats = render_stats(entries)
+    assert "state coverage" in stats and "protocol states" in stats
+
+
+def test_state_signature_is_pure():
+    """The admission signer must not move the virgin maps."""
+    instr = instrumentation_factory(
+        "jit_harness",
+        json.dumps({"target": "session_auth", "stateful": 1}))
+    buf = ts.framed_seed("session_auth")
+    instr.enable(buf)
+    vb0 = np.asarray(instr.virgin_bits).copy()
+    vs0 = np.asarray(instr.virgin_state).copy()
+    pairs = instr.state_signature(buf)
+    assert pairs
+    assert np.array_equal(np.asarray(instr.virgin_bits), vb0)
+    assert np.array_equal(np.asarray(instr.virgin_state), vs0)
+
+
+def test_state_gauges_and_events_and_timeline(tmp_path):
+    from killerbeez_tpu.telemetry.events import read_events
+    from killerbeez_tpu.tools.timeline_tool import sessions_report
+    out = str(tmp_path / "camp")
+    instr = instrumentation_factory(
+        "jit_harness",
+        json.dumps({"target": "session_auth", "stateful": 1}))
+    mut = mutator_factory("havoc", '{"seed": 2}',
+                          ts.framed_seed("session_auth"))
+    drv = driver_factory("file", None, instr, mut)
+    fz = Fuzzer(drv, output_dir=out, batch_size=64, feedback=0)
+    fz.run(256)
+    reg = fz.telemetry.registry
+    assert reg.gauges.get("state_cov_pairs", 0) > 0
+    assert reg.gauges.get("state_cov_states", 0) >= 2
+    evs = list(read_events(os.path.join(out, "events.jsonl")))
+    sc = [e for e in evs if e["type"] == "state_cov"]
+    assert sc and sc[-1]["pairs"] == reg.gauges["state_cov_pairs"]
+    rep = sessions_report(evs)
+    assert rep["pairs"] == sc[-1]["pairs"]
+    assert rep["states"] >= 2
+
+
+def test_quarantine_validates_state_sig():
+    from killerbeez_tpu.corpus.quarantine import EntryValidator
+    from killerbeez_tpu.corpus.store import CorpusEntry
+    from killerbeez_tpu.utils.serialization import b64
+    v = EntryValidator()
+    e = CorpusEntry(b"hello", state_sig=[[1, 5], [0, 9]])
+    row = {"md5": e.md5, "content_b64": b64(e.buf),
+           "meta": e.meta_dict()}
+    ent, why = v.validate(row)
+    assert ent is not None, why
+    assert ent.state_sig == [[0, 9], [1, 5]]
+    row["meta"]["state_sig"] = [["x", 1]]
+    ent, why = v.validate(row)
+    assert ent is None and why == "schema:state_sig"
+
+
+# ---------------------------------------------------------------------------
+# CLI plumbing
+# ---------------------------------------------------------------------------
+
+def test_cli_stateful_flag(tmp_path):
+    from killerbeez_tpu.fuzzer.cli import main as cli_main
+    seed = tmp_path / "seed.bin"
+    seed.write_bytes(ts.framed_seed("session_auth"))
+    out = str(tmp_path / "out")
+    rc = cli_main(["file", "jit_harness", "havoc",
+                   "-i", '{"target": "session_auth"}',
+                   "--stateful", "-sf", str(seed), "-n", "256",
+                   "-b", "64", "-o", out, "--no-stats"])
+    assert rc == 0
+    assert _findings(out)["new_paths"]
+
+
+def test_cli_stateful_requires_jit_harness(tmp_path, capsys):
+    from killerbeez_tpu.fuzzer.cli import main as cli_main
+    seed = tmp_path / "s"
+    seed.write_bytes(b"x")
+    rc = cli_main(["file", "return_code", "bit_flip", "--stateful",
+                   "-sf", str(seed), "-n", "1",
+                   "-d", '{"path": "/bin/true"}'])
+    assert rc == 2
+    assert "jit_harness" in capsys.readouterr().err
+
+
+def test_cli_crack_stands_down_stateful(tmp_path, capsys):
+    from killerbeez_tpu.fuzzer.cli import main as cli_main
+    seed = tmp_path / "s"
+    seed.write_bytes(ts.framed_seed("session_auth"))
+    rc = cli_main(["file", "jit_harness", "havoc",
+                   "-i", '{"target": "session_auth"}',
+                   "--stateful", "--crack",
+                   "-sf", str(seed), "-n", "64"])
+    assert rc == 2
+    assert "session" in capsys.readouterr().err
+
+
+def test_showmap_and_picker_state_sections(tmp_path):
+    from killerbeez_tpu.tools.picker import main as picker_main
+    seed = tmp_path / "seed.bin"
+    seed.write_bytes(ts.framed_seed("tcp_like"))
+    rep_path = tmp_path / "picker.json"
+    rc = picker_main(["file", "jit_harness", str(seed),
+                      "-i", json.dumps({"target": "tcp_like",
+                                        "stateful": 1}),
+                      "-n", "2", "-o", str(rep_path)])
+    assert rc == 0
+    rep = json.loads(rep_path.read_text())
+    assert "state" in rep
+    assert rep["state"]["states_reached"][0] == 0
+    assert len(rep["state"]["states_reached"]) >= 2
+    assert rep["state"]["pairs"]

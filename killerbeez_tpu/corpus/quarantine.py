@@ -142,6 +142,32 @@ class EntryValidator:
                     int(k), int(v)
             except (TypeError, ValueError):
                 return None, "schema:edge_hits"
+        prov = meta.get("provenance")
+        if prov is not None:
+            # mutation provenance (learn tier, optional): mutator id,
+            # stage, packed mutated-byte bitmap.  Bounded and typed —
+            # a peer must not be able to ship a multi-megabyte
+            # "bitmap" or a non-string mutator through the learn
+            # tier's label path.  Old rows without it pass untouched.
+            if not isinstance(prov, dict):
+                return None, "schema:provenance"
+            if not isinstance(prov.get("mutator"), str) or \
+                    len(prov["mutator"]) > 64:
+                return None, "schema:provenance"
+            stage = prov.get("stage")
+            if stage is not None and not (isinstance(stage, str)
+                                          and len(stage) <= 64):
+                return None, "schema:provenance"
+            bm = prov.get("bitmap")
+            if bm is not None:
+                # packbits over the content: ~len(buf)/6 b64 chars
+                if not isinstance(bm, str) or \
+                        len(bm) > (len(buf) // 8) * 2 + 16:
+                    return None, "schema:provenance"
+            nb = prov.get("bytes")
+            if nb is not None and not (isinstance(nb, int)
+                                       and 0 <= nb <= len(buf)):
+                return None, "schema:provenance"
         for key in ("selections", "finds", "discovered", "seq"):
             v = meta.get(key)
             if v is not None and not isinstance(v, (int, float)):

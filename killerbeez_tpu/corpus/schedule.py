@@ -39,20 +39,24 @@ class Arm(list):
     metadata as attributes."""
 
     __slots__ = ("md5", "seq", "sig", "state_sig", "parent",
-                 "source", "discovered")
+                 "source", "discovered", "provenance")
 
     def __init__(self, buf: bytes, selections: float = 0.0,
                  finds: float = 0.0, md5: Optional[str] = None,
                  seq: int = 0, sig: Optional[List[int]] = None,
                  parent: Optional[str] = None, source: str = "local",
                  discovered: Optional[float] = None,
-                 state_sig: Optional[List] = None):
+                 state_sig: Optional[List] = None,
+                 provenance=None):
         super().__init__([bytes(buf), selections, finds])
         self.md5 = md5 or md5_hex(buf)
         self.seq = int(seq)
         self.sig = sorted(set(int(s) for s in sig)) if sig else None
         self.state_sig = state_sig
         self.parent = parent
+        #: mutation provenance (learn tier): set at admission, rides
+        #: into the entry sidecar
+        self.provenance = provenance
         self.source = source
         self.discovered = discovered
 
@@ -70,14 +74,15 @@ class Arm(list):
             edge_hits=None, selections=float(self[1]),
             finds=float(self[2]), parent=self.parent,
             source=self.source, discovered=self.discovered,
-            state_sig=self.state_sig)
+            state_sig=self.state_sig, provenance=self.provenance)
 
     @classmethod
     def from_entry(cls, e: CorpusEntry) -> "Arm":
         return cls(e.buf, selections=e.selections, finds=e.finds,
                    md5=e.md5, seq=e.seq, sig=e.sig, parent=e.parent,
                    source=e.source, discovered=e.discovered,
-                   state_sig=e.state_sig)
+                   state_sig=e.state_sig,
+                   provenance=getattr(e, "provenance", None))
 
 
 class Scheduler:

@@ -30,6 +30,13 @@ Sidecar schema (docs/CORPUS.md):
      "edge_hits": {slot: count} | null,   # edge-hit summary
      "selections": float, "finds": float, # bandit arm stats (decayed)
      "parent": md5 | "base" | null,   # lineage: generating arm
+     "provenance": {"mutator": ..., "stage": ...,  # mutation
+                    "bitmap": b64, "bytes": N} | null,
+                                      # provenance: which parent byte
+                                      # positions were mutated (the
+                                      # learn tier's training labels;
+                                      # docs/LEARN.md) — optional,
+                                      # pre-learn sidecars omit it
      "source": "local" | "sync",
      "discovered": unix_time}
 
@@ -88,7 +95,7 @@ class CorpusEntry:
 
     __slots__ = ("buf", "md5", "seq", "sig", "state_sig", "edge_hits",
                  "selections", "finds", "parent", "source",
-                 "discovered", "cov_hash")
+                 "discovered", "cov_hash", "provenance")
 
     def __init__(self, buf: bytes, md5: Optional[str] = None,
                  seq: int = 0, sig: Optional[List[int]] = None,
@@ -97,7 +104,8 @@ class CorpusEntry:
                  parent: Optional[str] = None, source: str = "local",
                  discovered: Optional[float] = None,
                  cov_hash: Optional[str] = None,
-                 state_sig: Optional[List] = None):
+                 state_sig: Optional[List] = None,
+                 provenance: Optional[Dict[str, Any]] = None):
         self.buf = bytes(buf)
         self.md5 = md5 or md5_hex(self.buf)
         self.seq = int(seq)
@@ -111,6 +119,11 @@ class CorpusEntry:
         self.selections = float(selections)
         self.finds = float(finds)
         self.parent = parent
+        # mutation provenance (learn tier, optional): a dict with
+        # mutator id, stage, and the mutated-byte bitmap — sidecars
+        # without it load unchanged
+        self.provenance = (dict(provenance)
+                           if isinstance(provenance, dict) else None)
         self.source = source
         self.discovered = (time.time() if discovered is None
                            else float(discovered))
@@ -124,7 +137,8 @@ class CorpusEntry:
             "edge_hits": ({str(k): v for k, v in self.edge_hits.items()}
                           if self.edge_hits else None),
             "selections": self.selections, "finds": self.finds,
-            "parent": self.parent, "source": self.source,
+            "parent": self.parent, "provenance": self.provenance,
+            "source": self.source,
             "discovered": self.discovered,
         }
 
@@ -139,7 +153,8 @@ class CorpusEntry:
                    source=meta.get("source", "local"),
                    discovered=meta.get("discovered"),
                    cov_hash=meta.get("cov_hash"),
-                   state_sig=meta.get("state_sig"))
+                   state_sig=meta.get("state_sig"),
+                   provenance=meta.get("provenance"))
 
 
 def _atomic_write(path: str, data: bytes) -> None:

@@ -148,6 +148,27 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="B",
                    help="candidate lanes per descent dispatch "
                         "(default 1024)")
+    p.add_argument("--learn", action="store_true",
+                   help="learned mutation shaping (jit_harness): "
+                        "train a small on-device byte-saliency model "
+                        "from the campaign's own lineage (which "
+                        "parent bytes, when mutated, produced "
+                        "admitted children — provenance sidecars) "
+                        "and focus havoc on the predicted-useful "
+                        "positions: per generation INSIDE the -G "
+                        "device scans (single-chip and --mesh, zero "
+                        "host involvement), per rotation via focus "
+                        "masks in the host-driven loop.  Until the "
+                        "first training round masks are all-ones and "
+                        "every path is bit-identical to an unshaped "
+                        "campaign; model weights ride the checkpoint "
+                        "epoch so --resume restores them "
+                        "(docs/LEARN.md).  Mutually exclusive with "
+                        "--crack (one mask source at a time)")
+    p.add_argument("--learn-interval", type=float, default=5.0,
+                   metavar="S",
+                   help="with --learn: minimum seconds between "
+                        "training rounds (default 5)")
     p.add_argument("--no-focus", action="store_true",
                    help="with --crack: do NOT install the Angora-"
                         "style focused-mutation byte masks derived "
@@ -381,6 +402,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
 
+        if args.learn:
+            # inject the learn option into the instrumentation
+            # config (engine coercion + tool visibility — the same
+            # augmentation pattern --stateful uses)
+            import json as _json
+            if args.instrumentation != "jit_harness":
+                print("error: --learn needs the jit_harness "
+                      "instrumentation (the saliency model trains "
+                      "and infers on the device the fuzzer runs "
+                      "on)", file=sys.stderr)
+                return 2
+            if args.crack:
+                print("error: --learn and --crack are mutually "
+                      "exclusive — each installs its own mutation "
+                      "focus masks (learned saliency vs the static "
+                      "frontier dependency sets); run one mask "
+                      "source at a time", file=sys.stderr)
+                return 2
+            try:
+                iopts = _json.loads(args.instrumentation_options) \
+                    if args.instrumentation_options else {}
+            except ValueError:
+                iopts = None     # factory reports the parse error
+            if isinstance(iopts, dict):
+                iopts.setdefault("learn", 1)
+                args.instrumentation_options = _json.dumps(iopts)
+
         if args.stateful is not None:
             # inject the session-tier options into the
             # instrumentation config (the same augmentation pattern
@@ -481,6 +529,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 min_deadline=args.watchdog_min,
                 max_deadline=args.watchdog_max)
 
+        learn_tier = None
+        if args.learn:
+            from ..learn import LearnTier
+            learn_tier = LearnTier(
+                train_interval_s=args.learn_interval,
+                max_len=getattr(mutator, "max_length", 4096))
+
         fuzzer = Fuzzer(driver, output_dir=args.output,
                         batch_size=args.batch_size,
                         debug_triage=args.debug_triage,
@@ -496,7 +551,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         profile_device=args.profile_device,
                         events_max_mb=args.events_max_mb,
                         watchdog=watchdog,
-                        generations=args.generations)
+                        generations=args.generations,
+                        learn=learn_tier)
         if args.schedule == "rare-edge":
             _wire_rare_edge_signer(fuzzer, driver)
             _wire_static_prior(fuzzer, driver)

@@ -172,7 +172,8 @@ class Fuzzer:
                  profile_device: int = 0,
                  events_max_mb: float = 0.0,
                  watchdog=None,
-                 generations: int = 0):
+                 generations: int = 0,
+                 learn=None):
         self.driver = driver
         self.output_dir = output_dir
         self.batch_size = int(batch_size)
@@ -301,6 +302,14 @@ class Fuzzer:
         #: state_cov event per increase)
         self._state_gauge_t = 0.0
         self._state_pairs_seen = 0
+        #: learned mutation shaping (killerbeez_tpu/learn/): a
+        #: LearnTier collecting labels from the admission stream,
+        #: training the byte-saliency model on the device between
+        #: dispatches, and serving masks — in-scan weights for the
+        #: -G generation scans, set_focus_mask positions at rotation
+        #: boundaries for the host-driven loop.  None = off (the
+        #: exact historical paths compile).
+        self.learn = learn
         self.stats = FuzzStats(telemetry.registry)
         self._seen = {k: set() for k in ("crashes", "hangs", "new_paths")}
         if write_findings:
@@ -387,6 +396,11 @@ class Fuzzer:
         }}
         if self.cracker is not None:
             doc["solver"] = self.cracker.cache
+        if self.learn is not None:
+            # model weights + version ride the SAME epoch: --resume
+            # restores the trained model (labels rebuild from the
+            # provenance sidecars, see _restore_campaign)
+            doc["learn"] = self.learn.state_dict()
         if self.telemetry.events is not None:
             # the log's high-water at save time: resume anchors seq
             # at max(file tail, checkpoint) so a torn/lost log can
@@ -482,6 +496,18 @@ class Fuzzer:
                     int(ck.get("event_seq", 0)))
             except (TypeError, ValueError):
                 pass
+        if self.learn is not None:
+            if ck and isinstance(ck.get("learn"), dict):
+                self.learn.load_state(ck["learn"])
+            # labels rebuild from the persisted provenance sidecars
+            # (entries without the field — pre-learn campaigns —
+            # just contribute nothing); explicit reject negatives
+            # restart empty, which only slows re-sharpening
+            self.learn.bootstrap(entries, self._parent_bytes)
+            self.telemetry.registry.gauge(
+                "learn_model_version", self.learn.version)
+            self.telemetry.registry.gauge(
+                "learn_label_count", len(self.learn.labels))
         # -n counts THIS invocation's executions; restored lifetime
         # counters keep stats files and rates cumulative
         self._iter_base = int(self.stats.iterations)
@@ -561,6 +587,51 @@ class Fuzzer:
 
     _NO_CREDIT = object()   # credit sentinel: None credits the base seed
 
+    def _parent_bytes(self, parent: Optional[str]) -> Optional[bytes]:
+        """Resolve a lineage parent key to its input bytes: the base
+        seed, a live rotation arm, or (last) the corpus store entry
+        on disk.  None when unresolvable — learn labeling then skips
+        the sample rather than guessing."""
+        if parent in (None, "base"):
+            base = self.scheduler.base_seed
+            if base is not None:
+                return base
+            mut = getattr(self.driver, "mutator", None)
+            return getattr(mut, "seed_bytes", None)
+        for a in self.scheduler.arms:
+            if getattr(a, "md5", None) == parent:
+                return a[0]
+        if self.store is not None:
+            try:
+                with open(self.store.entry_path(parent), "rb") as f:
+                    return f.read()
+            except OSError:
+                return None
+        return None
+
+    def _learn_admission(self, arm: Arm, buf: bytes,
+                         parent: str) -> None:
+        """Label one admission for the learn tier and attach the
+        mutation-provenance record to the arm (it rides into the
+        entry sidecar).  Best-effort by design — a failed label must
+        never block an admission."""
+        if self.learn is None:
+            return
+        pbuf = self._parent_bytes(parent)
+        if not pbuf:
+            return
+        mut = getattr(self.driver, "mutator", None)
+        stage = None
+        stage_fn = getattr(mut, "stage_name", None)
+        if stage_fn is not None:
+            try:
+                stage = stage_fn()
+            except Exception:
+                stage = None
+        arm.provenance = self.learn.note_admission(
+            parent or "base", pbuf, buf,
+            getattr(mut, "name", "?"), stage)
+
     def _admit_arm(self, buf: bytes, digest: str, parent: str,
                    credit=_NO_CREDIT) -> None:
         """The ADMISSION stage of triage, split out so it is
@@ -590,6 +661,10 @@ class Fuzzer:
                 arm.state_sig = ssig_fn(buf)
             except Exception as e:
                 WARNING_MSG("state signature failed: %s", e)
+        # learn tier: positive labels + the provenance sidecar record
+        # (mutator id, stage, mutated-byte bitmap) BEFORE the store
+        # write-through so the sidecar carries it
+        self._learn_admission(arm, buf, parent)
         if self.store is not None and not os.path.exists(
                 self.store.entry_path(digest)):
             arm.seq = self.store.next_seq()
@@ -689,6 +764,17 @@ class Fuzzer:
                     parent=getattr(self._credit_arm, "md5",
                                    None) or "base",
                     credit=self._credit_arm)
+            elif recorded and new_path == 1 and self.learn is not None:
+                # bucket-only new path — interesting but NOT admitted:
+                # the admission stream's reject, labeled negative
+                # (budget-capped inside the tier).  Parent = the
+                # generating arm (the ring's base slot / the base
+                # seed in -G drains — best-effort, docs/LEARN.md)
+                pkey = getattr(self._credit_arm, "md5",
+                               None) or "base"
+                pbuf = self._parent_bytes(pkey)
+                if pbuf:
+                    self.learn.note_reject(pkey, pbuf, buf)
 
     # -- loops ----------------------------------------------------------
 
@@ -953,6 +1039,19 @@ class Fuzzer:
             self.telemetry.event("state_cov", pairs=int(pairs),
                                  states=int(states))
 
+    def _maybe_learn(self) -> None:
+        """Between-dispatches learn-tier hook: train the saliency
+        model when due (time- and label-gated inside the tier — the
+        common case is one cheap check).  The train round runs on
+        the accelerator while the in-flight fuzzing dispatches are
+        still computing, which is the whole point of co-locating the
+        model with the fuzzer."""
+        if self.learn is None:
+            return
+        with self.telemetry.timer("learn"):
+            self.learn.maybe_train(self.telemetry.registry,
+                                   self.telemetry)
+
     def _wd_guard(self, stage: str):
         """Watchdog deadline over one blocking region (no-op without
         a watchdog installed)."""
@@ -1053,6 +1152,18 @@ class Fuzzer:
                 mut.iteration = it
                 self._active_entry = (None if best is None
                                       else self.scheduler.arms[best])
+                # learned mask source (host-driven loop): focus the
+                # next period's mutation on the model's salient bytes
+                # of the freshly rotated seed.  Mutually exclusive
+                # with the crack stage's static edge_dep_mask (the
+                # CLI enforces it); a None mask CLEARS — shaping must
+                # never outlive the seed it was computed for.  The
+                # installed mask stands the fused superbatch down,
+                # exactly like the crack-stage masks (docs/LEARN.md).
+                if self.learn is not None:
+                    mut.set_focus_mask(
+                        self.learn.focus_positions_for(cand),
+                        pad_pow2=True)
                 self.telemetry.event(
                     "scheduler_pick",
                     arm=(getattr(self._active_entry, "md5", None)
@@ -1137,6 +1248,7 @@ class Fuzzer:
         reg = self.telemetry.registry
         reg.rate("execs", b * k)
         reg.gauge("pipeline_depth", len(pending))
+        self._maybe_learn()
         self.telemetry.maybe_flush()
         self._persist_campaign()
         if self.sync is not None:
@@ -1297,6 +1409,7 @@ class Fuzzer:
                 reg.rate("execs", room)
                 reg.gauge("pipeline_depth", len(pending))
                 self._update_state_gauges()
+                self._maybe_learn()
                 self.telemetry.maybe_flush()
                 self._persist_campaign()
                 if self.sync is not None:
@@ -1472,6 +1585,14 @@ class Fuzzer:
                         break
                     if self.profile_device and not self._prof_active:
                         self._profile_start()
+                    if self.learn is not None:
+                        # install the LIVE model weights for this
+                        # dispatch's in-scan inference (per-
+                        # generation masks with zero host
+                        # involvement; a v0 model quantizes to
+                        # all-ones — the parity regime)
+                        drv.instrumentation.learn_params = \
+                            self.learn.scan_params()
                     n_real = min(room, self.batch_size)
                     g_room = min(max(room // self.batch_size, 1),
                                  g_max)
@@ -1517,7 +1638,14 @@ class Fuzzer:
                     reg.rate("execs", g_eff * n_real)
                     reg.gauge("generations_per_dispatch", g_eff)
                     reg.gauge("pipeline_depth", len(pending))
+                    if self.learn is not None and \
+                            self.learn.version > 0:
+                        # one learned mask per generation once the
+                        # model has trained (v0 masks are all-ones
+                        # — shaping hasn't started)
+                        self.learn.masks_applied += g_eff
                     self._update_state_gauges()
+                    self._maybe_learn()
                     self.telemetry.maybe_flush()
                     self._persist_campaign()
                     if self.sync is not None:
@@ -1582,6 +1710,7 @@ class Fuzzer:
                 self._triage_lane(result, instr.is_new_path(), buf,
                                   instr.last_unique_crash(),
                                   instr.last_unique_hang())
+            self._maybe_learn()
             self.telemetry.maybe_flush()
             self._persist_campaign()
             if self.sync is not None:

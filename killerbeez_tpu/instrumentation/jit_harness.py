@@ -264,7 +264,8 @@ class JitHarnessInstrumentation(Instrumentation):
                      "phase1_steps": int, "gen_ring_slots": int,
                      "gen_findings_cap": int, "gen_admits": int,
                      "gen_fold_every": int, "stateful": int,
-                     "msgs": int, "n_states": int, "state_reg": int}
+                     "msgs": int, "n_states": int, "state_reg": int,
+                     "learn": int}
     OPTION_DESCS = {
         "target": "built-in KBVM target name (test/hang/libtest/cgc_like)",
         "program_file": "path to a .npz compiled KBVM program",
@@ -314,12 +315,17 @@ class JitHarnessInstrumentation(Instrumentation):
                     "else 16)",
         "state_reg": "stateful: the protocol-state register "
                      "(-1 = registered spec, else r7)",
+        "learn": "1 = learned mutation shaping (killerbeez_tpu/"
+                 "learn/): the loop's byte-saliency model shapes "
+                 "havoc positions — per generation inside the -G "
+                 "scan, per rotation via focus masks in the host "
+                 "loop (forces the xla engine; docs/LEARN.md)",
     }
     DEFAULTS = {"novelty": "exact", "edges": 0, "engine": "xla",
                 "phase1_steps": -1, "gen_ring_slots": 32,
                 "gen_findings_cap": 0, "gen_admits": 8,
                 "gen_fold_every": 0, "stateful": 0, "msgs": 0,
-                "n_states": 0, "state_reg": -1}
+                "n_states": 0, "state_reg": -1, "learn": 0}
 
     def __init__(self, options: Optional[str] = None):
         super().__init__(options)
@@ -355,6 +361,18 @@ class JitHarnessInstrumentation(Instrumentation):
                     "xla engine — %r stands down (the pallas kernel "
                     "executes single-shot inputs only)", self.engine)
                 self.engine = "xla"
+        # -- learned mutation shaping (killerbeez_tpu/learn/) ---------
+        # the loop installs the live model weights here before each
+        # --generations dispatch; the scan runs inference per
+        # generation (None = shaping off, the exact historical path)
+        self.learn_params = None
+        if self.options["learn"] and self.engine != "xla":
+            WARNING_MSG(
+                "jit_harness: learned mutation shaping runs the xla "
+                "engine — %r stands down (the fused VMEM kernel "
+                "generates candidates in-kernel and cannot consume "
+                "a per-generation mask)", self.engine)
+            self.engine = "xla"
         self._fuse_warned = False
         from ..ops.vm_kernel import auto_phase1_steps, dot_modes
         # exactness-guarded MXU dtypes, decided once per program
@@ -661,21 +679,28 @@ class JitHarnessInstrumentation(Instrumentation):
             spec.m_max, spec.n_states, spec.state_reg)
         vs = self.virgin_state if spec is not None \
             else jnp.zeros((1,), jnp.uint8)
+        # learned mutation shaping (learn/): the loop installs the
+        # live model weights before each dispatch; inference runs
+        # per generation INSIDE the scan (docs/LEARN.md)
+        learn = self.learn_params is not None
+        lp = self.learn_params if learn else ()
         (vb, vc, vh, vs), ring, rep = run_generations(
             self._instrs, self._edge_table, self._u_slots,
             self._seg_id, *self._gen_ring, base_key,
             jnp.asarray(its), jnp.int32(n),
             jnp.uint32(self._gen_count), jnp.uint32(salt),
             self.virgin_bits, self.virgin_crash, self.virgin_tmout,
-            vs,
-            self.program.mem_size, self.program.max_steps,
-            self.program.n_edges, self.exact, stack_pow2, int(g),
+            vs, lp,
+            mem_size=self.program.mem_size,
+            max_steps=self.program.max_steps,
+            n_edges=self.program.n_edges, exact=self.exact,
+            stack_pow2=stack_pow2, g=int(g),
             engine=("pallas" if self.engine in ("pallas",
                                                 "pallas_fused")
                     else "xla"),
             phase1_steps=self.phase1_steps, dots=self._dots,
             reseed=bool(reseed), adm_cap=adm_cap, findings_cap=cap,
-            stateful=stateful)
+            stateful=stateful, learn=learn)
         self.virgin_bits, self.virgin_crash, self.virgin_tmout = \
             vb, vc, vh
         if spec is not None:

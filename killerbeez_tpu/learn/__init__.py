@@ -1,0 +1,31 @@
+"""killerbeez_tpu.learn — on-TPU learned mutation shaping.
+
+A byte-saliency model ("Not all bytes are equal", arxiv 1711.04596)
+trained from the corpus store's own lineage: which parent byte
+positions, when mutated, produced admitted children.  Training runs
+on the fuzzing chip between dispatches (plain jax.grad SGD), and
+inference runs INSIDE the device generation scans — the model and
+the fuzzer share the accelerator, so shaping happens per generation
+with zero host involvement.  docs/LEARN.md has the dataset schema,
+parity rules and honesty caveats.
+"""
+
+from .dataset import (
+    LabelBuffer, b64_to_bitmap, bitmap_to_b64, diff_bitmap,
+    make_provenance, provenance_positions, samples_from_entries,
+)
+from .model import (
+    FEATURES, WINDOW, apply_model, batch_features, decode_params,
+    encode_params, feature_at, init_params, masked_saliency,
+    n_params, quantize_mask, saliency_logits, train_step,
+)
+from .tier import LearnTier
+
+__all__ = [
+    "FEATURES", "WINDOW", "LabelBuffer", "LearnTier", "apply_model",
+    "b64_to_bitmap", "batch_features", "bitmap_to_b64",
+    "decode_params", "diff_bitmap", "encode_params", "feature_at",
+    "init_params", "make_provenance", "masked_saliency", "n_params",
+    "provenance_positions", "quantize_mask", "saliency_logits",
+    "samples_from_entries", "train_step",
+]

@@ -1,0 +1,195 @@
+"""Byte-saliency model — "Not all bytes are equal" (arxiv
+1711.04596) scaled down to live ON the fuzzing chip.
+
+A tiny MLP over sliding byte windows predicts, per seed byte
+position, the probability that mutating that position produces an
+admitted (edge-novel) child.  Everything here is pure JAX so the
+same functions serve three callers:
+
+  * **training** — plain ``jax.grad`` SGD (no optax, no optimizer
+    state to checkpoint beyond the weights) on labeled
+    (parent bytes, position) samples, run on the device between
+    fuzzing dispatches (learn/tier.py owns the cadence);
+  * **in-scan inference** — ``saliency_logits`` vmapped over every
+    position of the selected seed-ring slot INSIDE the device
+    generation scan (ops/generations.py), quantized to the focus
+    mask the masked havoc kernel consumes;
+  * **host-loop inference** — ``mask_positions`` feeds
+    ``Mutator.set_focus_mask`` at rotation boundaries (the
+    ``learned`` mask source beside the static ``edge_dep_mask``).
+
+The parity anchor the whole tier rests on: ``init_params`` zeroes
+the OUTPUT layer, so an untrained (version-0) model emits logit
+exactly 0.0 for every input, ``quantize_mask`` maps that to the
+all-ones mask, and the masked mutation kernel with an all-ones mask
+is bit-identical to the unmasked one (ops/mutate_core.py) — a
+campaign with learning enabled but no training yet IS the
+historical campaign, pinned in tests/test_learn.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.serialization import decode_array, encode_array
+
+#: byte-window width per position (centered; zero-padded at the
+#: buffer edges) — the model's whole receptive field
+WINDOW = 9
+#: hidden layer widths (D -> H1 -> H2 -> 1); ~1k parameters total —
+#: small enough that a training round between dispatches is noise
+#: next to one fuzzing batch
+HIDDEN = (32, 16)
+#: feature dimension: WINDOW byte values + relative position +
+#: normalized length
+FEATURES = WINDOW + 2
+#: default SGD learning rate (plain, no momentum — nothing beyond
+#: the weights needs checkpointing)
+LEARN_RATE = 0.5
+
+Params = Tuple[jax.Array, ...]   # (w1, b1, w2, b2, w3, b3)
+
+
+def init_params(seed: int = 0x6b7a) -> Params:
+    """Deterministic init: small random hidden layers, ZERO output
+    layer — logits are exactly 0.0 until the first train step, which
+    is what makes the version-0 mask all-ones (see module doc)."""
+    k1, k2 = jax.random.split(jax.random.key(seed))
+    h1, h2 = HIDDEN
+    w1 = jax.random.normal(k1, (FEATURES, h1), jnp.float32) \
+        * (1.0 / np.sqrt(FEATURES))
+    w2 = jax.random.normal(k2, (h1, h2), jnp.float32) \
+        * (1.0 / np.sqrt(h1))
+    return (w1, jnp.zeros((h1,), jnp.float32),
+            w2, jnp.zeros((h2,), jnp.float32),
+            jnp.zeros((h2,), jnp.float32), jnp.zeros((), jnp.float32))
+
+
+def n_params(params: Params) -> int:
+    return int(sum(int(np.prod(p.shape)) for p in params))
+
+
+def feature_at(buf, length, pos):
+    """Features of ONE (buffer, position) pair: the WINDOW bytes
+    around ``pos`` (zero outside the live prefix), the relative
+    position, and the normalized length.  The ONE featurizer — the
+    train batch builder and both inference paths vmap this exact
+    function, so a model never sees train/serve skew."""
+    L = buf.shape[-1]
+    length = jnp.maximum(length, 1)
+    idx = jnp.arange(L, dtype=jnp.int32)
+    half = WINDOW // 2
+    offs = jnp.arange(-half, half + 1, dtype=jnp.int32)
+    wpos = pos + offs
+    valid = (wpos >= 0) & (wpos < length)
+    # one-hot gather (no per-lane dynamic gather on the VPU — the
+    # read_bytes discipline from ops/mutate_core.py)
+    oh = wpos[:, None] == idx[None, :]                   # [W, L]
+    win = jnp.sum(jnp.where(oh, buf[None, :].astype(jnp.float32),
+                            0.0), axis=1)
+    win = jnp.where(valid, win / 255.0, 0.0)
+    rel = pos.astype(jnp.float32) / length.astype(jnp.float32)
+    scale = jnp.minimum(length, 256).astype(jnp.float32) / 256.0
+    return jnp.concatenate([win, rel[None], scale[None]])
+
+
+def apply_model(params: Params, x):
+    """Logit for one feature vector (vmap for batches)."""
+    w1, b1, w2, b2, w3, b3 = params
+    h = jnp.tanh(x @ w1 + b1)
+    h = jnp.tanh(h @ w2 + b2)
+    return h @ w3 + b3
+
+
+def saliency_logits(params: Params, buf, length):
+    """Per-byte saliency logits for one seed buffer: float32[L],
+    position p's logit = apply_model(feature_at(buf, length, p)).
+    Pure and jit-safe — this is the function the generation scans
+    inline (one tiny [L, D] matmul chain per generation)."""
+    L = buf.shape[-1]
+    feats = jax.vmap(lambda p: feature_at(buf, length, p))(
+        jnp.arange(L, dtype=jnp.int32))
+    return jax.vmap(lambda f: apply_model(params, f))(feats)
+
+
+def quantize_mask(logits, length):
+    """Quantize saliency to the uint8[L] focus mask the masked havoc
+    kernel consumes: 1 = mutable.  Threshold is logit 0 (p = 0.5), so
+    the version-0 model (logits exactly 0.0) yields ALL-ONES — the
+    parity anchor.  Positions PAST the live prefix stay 1 (mutable by
+    default): the model has no labels there, and havoc edits grow the
+    candidate length mid-stack — a mask that zeroed the tail would
+    diverge from the unmasked kernel the moment an insert lands (the
+    kernel re-clips to the CURRENT length every edit).  A mask the
+    model zeroed completely falls back to uniform INSIDE the kernel
+    (ops/mutate_core._havoc_one), never here — the quantizer stays a
+    pure threshold."""
+    L = logits.shape[-1]
+    idx = jnp.arange(L, dtype=jnp.int32)
+    return jnp.where(idx < jnp.maximum(length, 1),
+                     (logits >= 0.0).astype(jnp.uint8),
+                     jnp.uint8(1))
+
+
+def masked_saliency(params: Params, buf, length):
+    """saliency -> mask in one call (the scan's per-generation
+    inference step)."""
+    return quantize_mask(saliency_logits(params, buf, length), length)
+
+
+def _loss(params: Params, X, y, w):
+    """Weighted sigmoid binary cross-entropy (stable log1p form).
+    ``w`` rebalances the classes — admissions are rare, so positives
+    are upweighted by the caller to keep the decision boundary from
+    collapsing to all-negative."""
+    logits = jax.vmap(lambda f: apply_model(params, f))(X)
+    per = jnp.maximum(logits, 0) - logits * y + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1e-6)
+
+
+@partial(jax.jit, static_argnames=())
+def train_step(params: Params, X, y, w, lr):
+    """One plain-SGD step on a labeled feature batch; returns
+    (params', loss).  jax.grad, no optimizer state — the checkpoint
+    epoch serializes only the weights."""
+    loss, grads = jax.value_and_grad(_loss)(params, X, y, w)
+    new = tuple(p - lr * g for p, g in zip(params, grads))
+    return new, loss
+
+
+def batch_features(bufs, lengths, positions):
+    """Featurize a labeled sample batch: (uint8[N, L], int32[N],
+    int32[N]) -> float32[N, D] via the one shared featurizer."""
+    return jax.vmap(feature_at)(
+        jnp.asarray(bufs, jnp.uint8),
+        jnp.asarray(lengths, jnp.int32),
+        jnp.asarray(positions, jnp.int32))
+
+
+# -- (de)serialization (checkpoint epoch / kb tools) --------------------
+
+_PARAM_NAMES = ("w1", "b1", "w2", "b2", "w3", "b3")
+
+
+def encode_params(params: Params) -> Dict[str, Any]:
+    return {name: encode_array(np.asarray(p, np.float32))
+            for name, p in zip(_PARAM_NAMES, params)}
+
+
+def decode_params(d: Dict[str, Any]) -> Params:
+    ref = init_params()
+    out = []
+    for name, template in zip(_PARAM_NAMES, ref):
+        arr = decode_array(d[name]).astype(np.float32)
+        if arr.shape != template.shape:
+            raise ValueError(
+                f"learn model param {name}: shape {arr.shape} != "
+                f"{tuple(template.shape)} (incompatible checkpoint)")
+        out.append(jnp.asarray(arr))
+    return tuple(out)

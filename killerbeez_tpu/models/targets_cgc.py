@@ -662,6 +662,144 @@ def rledec_vm() -> Program:
     return a.build(block_seed=0x41E)
 
 
+#: fixedform_vm field offsets (everything else is NEVER loaded)
+_FORM_LEN = 96
+_FORM_HANDLERS = 8
+_FORM_FIELD_VALUES = 16
+
+
+@register_target("fixedform_vm")
+def fixedform_vm() -> Program:
+    """Fixed-offset form parser — the "not all bytes are equal"
+    target family (arxiv 1711.04596; the learn tier's bench regime,
+    docs/LEARN.md).
+
+    Real-world headers put their meaning at FIXED offsets and ignore
+    the bytes between: this family makes that structure exact and
+    provable.  A 96-byte form carries ~16 live positions —
+
+      [0..1]  magic "FM"            [8]   version ladder (6 values)
+      [16]    type -> 8 handlers    [24+k] handler k's field ladder
+                                           (16 values each)
+      [32]    repeat count (hit-count-bucket loop that reads NOTHING)
+      [64]^[65] key/lock gate -> bonus ladder at [72]
+      [80]    0xEE arms the planted bug; [81] is the unchecked
+              store index (version 6 + type 7 only)
+
+    — and every other byte is never the operand of an LDB: mutating
+    it cannot change ANY branch, ever (the dataflow layer proves the
+    dead regions; kb-lint shows no dependency on them).  Uniform
+    havoc therefore wastes ~5/6 of its primary edits; a mask that
+    concentrates on the live offsets is worth ~6x effective mutation
+    density — and because the SAME offsets keep yielding new ladder
+    values all campaign long, positional saliency is stable, which
+    is exactly what a lineage-trained model can learn.  Coverage is
+    wide (magic partials + 6 + 8 + 8x16 value blocks + count buckets
+    + bonus ladder) so short campaigns don't saturate.
+    """
+    a = Assembler("fixedform_vm", mem_size=32, max_steps=512)
+    a.block()                                   # entry
+    a.load_len(1)
+    a.ldi(2, 82)
+    a.br("lt", 1, 2, "bad")                     # short form
+    a.block()
+    a.expect_byte(3, 4, 0, ord("F"), "bad")     # magic
+    a.expect_byte(3, 4, 1, ord("M"), "bad")
+
+    def ladder(tag: str, off: int, values: int, done: str) -> None:
+        """One value ladder over input[off]: each matched value gets
+        its own coverage block (walking coverage at a fixed
+        position), unmatched values fall through to ``done``."""
+        a.ldi(3, off)
+        a.ldb(2, 3)                             # r2 = input[off]
+        for v in range(values):
+            a.ldi(4, v + 1)
+            a.br("ne", 2, 4, f"{tag}_n{v}")
+            a.block()                           # value-(v+1) block
+            a.jmp(done)
+            a.label(f"{tag}_n{v}")
+            a.block()
+        a.jmp(done)
+
+    # version ladder at [8] (r7 keeps the raw byte for the bug gate)
+    a.ldi(3, 8)
+    a.ldb(7, 3)
+    ladder("ver", 8, 6, "ver_done")
+    a.label("ver_done")
+    a.block()
+
+    # type dispatch at [16] -> handler k's own field ladder at [24+k]
+    a.ldi(3, 16)
+    a.ldb(6, 3)                                 # r6 = type
+    for k in range(_FORM_HANDLERS):
+        a.ldi(2, k + 1)
+        a.br("ne", 6, 2, f"ty_n{k}")
+        a.block()                               # handler-k block
+        ladder(f"h{k}", 24 + k, _FORM_FIELD_VALUES, f"h{k}_done")
+        a.label(f"h{k}_done")
+        a.block()
+        a.jmp("ty_done")
+        a.label(f"ty_n{k}")
+        a.block()
+    a.label("ty_done")
+    a.block()
+
+    # repeat-count loop at [32]: the body block's hit count walks the
+    # AFL buckets; the body READS no input (count buckets only)
+    a.ldi(3, 32)
+    a.ldb(2, 3)                                 # r2 = count
+    a.ldi(4, 24)
+    a.br("ge", 4, 2, "cnt_ok")                  # clamp to 24
+    a.block()
+    a.alu("add", 2, 4, 0)                       # r2 = 24 (r0 == 0)
+    a.label("cnt_ok")
+    a.block()
+    a.ldi(3, 0)                                 # r3 = i
+    a.label("cnt_loop")
+    a.br("ge", 3, 2, "cnt_done")
+    a.block()                                   # bucket body
+    a.addi(3, 3, 1)
+    a.jmp("cnt_loop")
+    a.label("cnt_done")
+    a.block()
+
+    # key/lock gate: input[64] ^ input[65] == 0x5A opens the bonus
+    # ladder at [72] (two-byte coupled fields — compensated edits)
+    a.ldi(3, 64)
+    a.ldb(4, 3)
+    a.ldi(3, 65)
+    a.ldb(5, 3)
+    a.alu("xor", 4, 4, 5)
+    a.ldi(5, 0x5A)
+    a.br("ne", 4, 5, "no_bonus")
+    a.block()                                   # gate open
+    ladder("bonus", 72, _FORM_FIELD_VALUES, "bonus_done")
+    a.label("bonus_done")
+    a.block()
+    a.label("no_bonus")
+    a.block()
+
+    # planted bug: version 6 + type 7 + input[80] == 0xEE stores to
+    # the UNCHECKED index input[81] (mem_size 32 -> OOB crash)
+    a.ldi(2, 6)
+    a.br("ne", 7, 2, "done")
+    a.ldi(2, 7)
+    a.br("ne", 6, 2, "done")
+    a.block()
+    a.expect_byte(3, 4, 80, 0xEE, "done")
+    a.ldi(3, 81)
+    a.ldb(4, 3)                                 # r4 = store index
+    a.stm(4, 2)                                 # BUG: unchecked
+    a.block()
+    a.label("done")
+    a.block()
+    a.halt(0)
+    a.label("bad")
+    a.block()
+    a.halt(1)
+    return a.build(block_seed=0xF1F)
+
+
 # --------------------------------------------------------------------
 # Seeds and crash reproducers (tests + bench starting corpus)
 # --------------------------------------------------------------------
@@ -711,6 +849,31 @@ def imgparse_vm_crash() -> bytes:
     return out
 
 
+def fixedform_vm_seed() -> bytes:
+    """Happy path: magic + version 1, type 1, field 1, count 1 —
+    every other byte zero (the live offsets all hold their lowest
+    ladder value, so the whole ladder space is left to the fuzzer)."""
+    form = bytearray(_FORM_LEN)
+    form[0:2] = b"FM"
+    form[8] = 1                                # version
+    form[16] = 1                               # type -> handler 0
+    form[24] = 1                               # handler 0 field
+    form[32] = 1                               # repeat count
+    return bytes(form)
+
+
+def fixedform_vm_crash() -> bytes:
+    """version 6 + type 7 + 0xEE arm byte -> unchecked store at
+    index 200 (mem_size 32)."""
+    form = bytearray(_FORM_LEN)
+    form[0:2] = b"FM"
+    form[8] = 6
+    form[16] = 7
+    form[80] = 0xEE
+    form[81] = 200
+    return bytes(form)
+
+
 def rledec_vm_seed() -> bytes:
     """Byte-identical to the native seed (corpus/seeds.py
     rledec_seed): every token type, 16 bytes emitted, budget exact."""
@@ -737,4 +900,5 @@ VM_SEEDS = {
     "tlvstack_vm": (tlvstack_vm_seed, tlvstack_vm_crash),
     "imgparse_vm": (imgparse_vm_seed, imgparse_vm_crash),
     "rledec_vm": (rledec_vm_seed, rledec_vm_crash),
+    "fixedform_vm": (fixedform_vm_seed, fixedform_vm_crash),
 }

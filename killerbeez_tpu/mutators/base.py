@@ -119,17 +119,31 @@ class Mutator:
     #: iteration contract is position-exhaustive by definition.
     focus_positions = None
 
-    def set_focus_mask(self, positions) -> None:
+    def set_focus_mask(self, positions, pad_pow2: bool = False
+                       ) -> None:
         """Install (or clear, with None/empty) the focus byte mask.
         Positions beyond the candidate buffer are dropped; an empty
         surviving set clears the mask — a mask must never silently
-        pin mutation to nothing."""
+        pin mutation to nothing.
+
+        ``pad_pow2`` cycles the surviving set up to the next
+        power-of-two length: the focused kernels specialize on the
+        position-array SHAPE, so a mask source whose size changes
+        every install (the learn tier's per-rotation masks) would
+        otherwise recompile them per size — padding collapses that
+        to log2 shapes.  Repeats only skew the uniform pick WITHIN
+        the mask (still a masked position), so the crack stage keeps
+        its exact historical unpadded sets."""
         if positions is not None:
             positions = sorted({int(p) for p in positions
                                 if 0 <= int(p) < self.max_length})
         if not positions:
             self.focus_positions = None
         else:
+            if pad_pow2:
+                want = 1 << (len(positions) - 1).bit_length()
+                positions = (positions * ((want + len(positions) - 1)
+                                          // len(positions)))[:want]
             self.focus_positions = np.asarray(positions, dtype=np.int32)
         self._stash = None  # prefetched candidates used the old mask
 

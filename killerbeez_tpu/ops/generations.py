@@ -318,13 +318,14 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
                     ring_bufs, ring_lens, ring_filled, ring_hits,
                     ring_finds, ring_ptr,
                     base_key, its0, n_real, gen0, salt,
-                    vb, vc, vh, vs,
-                    mem_size, max_steps, n_edges, exact, stack_pow2,
-                    g, engine="xla", phase1_steps=0,
+                    vb, vc, vh, vs, learn_params=(),
+                    mem_size=0, max_steps=0, n_edges=0, exact=True,
+                    stack_pow2=4,
+                    g=1, engine="xla", phase1_steps=0,
                     dots=("f32", "f32"), reseed=True,
                     adm_cap=DEFAULT_ADM_CAP,
                     findings_cap=DEFAULT_FINDINGS_CAP,
-                    interpret=False, stateful=None):
+                    interpret=False, stateful=None, learn=False):
     """G generations in ONE device program.  Returns (new virgin maps,
     new ring state, GenerationOutcome fields) — see module docstring
     for the state/replay contract.
@@ -349,6 +350,17 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
     host-driven stateful loop.  Requires engine "xla" (the session
     executor runs the one-hot engine).  With ``stateful=None`` the
     ``vs`` carry is a 1-byte dummy, returned untouched.
+
+    ``learn`` (static) + ``learn_params`` (the byte-saliency model
+    weights, learn/model.py) shape mutation IN the scan: each
+    generation runs model inference on the selected seed-ring slot,
+    quantizes the saliency to a focus mask, and mutates through the
+    masked havoc kernel — per-generation shaping with zero host
+    involvement.  Requires engine "xla" (like sessions).  A
+    version-0 model quantizes to all-ones and the masked kernel is
+    then bit-identical to ``havoc_at`` — the shaped scan IS the
+    unshaped scan until training starts (parity-pinned in
+    tests/test_learn.py).
     """
     from ..instrumentation.base import pack_verdicts
     from ..instrumentation.jit_harness import _triage_counts
@@ -362,6 +374,11 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
         raise ValueError(
             "stateful generations need the xla engine (the session "
             "executor is the one-hot engine path)")
+    if learn and engine != "xla":
+        raise ValueError(
+            "learned mutation shaping needs the xla engine (the "
+            "fused VMEM kernel generates candidates in-kernel and "
+            "cannot consume a per-generation mask)")
 
     def one_generation(carry, j):
         (vb, vc, vh, vs, ring_bufs, ring_lens, ring_filled,
@@ -388,11 +405,24 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
                 phase1_steps=phase1_steps, interpret=interpret,
                 dots=dots)
         else:
-            from .mutate_core import havoc_at
+            from .mutate_core import havoc_at, havoc_mask_at
             from ..models.vm import _run_batch_impl
-            bufs, lens = jax.vmap(
-                lambda k: havoc_at(seed_buf, seed_len, k,
-                                   stack_pow2=stack_pow2))(keys)
+            if learn:
+                # in-scan inference: saliency of THIS generation's
+                # seed slot -> dense mask -> masked havoc.  The
+                # branch is static, so campaigns without --learn
+                # compile the exact historical program.
+                from ..learn.model import masked_saliency
+                mask = masked_saliency(learn_params, seed_buf,
+                                       seed_len)
+                bufs, lens = jax.vmap(
+                    lambda k: havoc_mask_at(
+                        seed_buf, seed_len, k, mask,
+                        stack_pow2=stack_pow2))(keys)
+            else:
+                bufs, lens = jax.vmap(
+                    lambda k: havoc_at(seed_buf, seed_len, k,
+                                       stack_pow2=stack_pow2))(keys)
             if stateful is not None:
                 from ..stateful.session import _run_session_impl
                 m_max, n_states, state_reg = stateful
@@ -483,7 +513,7 @@ def run_generations(*args, **kwargs):
                              "exact", "stack_pow2", "g", "engine",
                              "phase1_steps", "dots", "reseed",
                              "adm_cap", "findings_cap", "interpret",
-                             "stateful"),
+                             "stateful", "learn"),
             donate_argnums=carry_donation_argnums(
                 jax.default_backend(), _CARRY_ARGNUMS))
     return _RUN_GENERATIONS_JIT(*args, **kwargs)

@@ -280,7 +280,7 @@ def interesting_at(buf: jax.Array, length: jax.Array, it: jax.Array
 N_HAVOC_OPS = 15
 
 
-def _havoc_one(buf, length, words, positions=None):
+def _havoc_one(buf, length, words, positions=None, mask=None):
     """One stacked havoc edit, chosen uniformly from the op table.
 
     Branch-free: under vmap a 15-way ``lax.switch`` lowers to
@@ -332,6 +332,32 @@ def _havoc_one(buf, length, words, positions=None):
         pos = jnp.minimum(pick(words[1] % np_), lim)
         bit = jnp.minimum(pick(words[7] % np_), lim) * 8 + \
             (words[7] >> 16).astype(jnp.int32) % 8
+    elif mask is not None:
+        # learned per-byte mask (learn/): the primary edit position
+        # and the bit-flip byte draw from the mask's SET bytes within
+        # the live prefix via rank selection — the k-th allowed byte
+        # for k = word % count.  An ALL-ONES mask is bit-identical to
+        # the unmasked draw (count == maxlen, rank k lands at byte k,
+        # and maxlen*8 == max(length*8, 1) for length >= 1 — the
+        # generation-scan parity contract, pinned in test_learn.py);
+        # an all-zero mask falls back to uniform (a mask must never
+        # pin mutation to nothing).  Clone sources / spans stay
+        # unrestricted, exactly like the `positions` focus variant.
+        idx_m = jnp.arange(L, dtype=jnp.int32)
+        live = idx_m < maxlen.astype(jnp.int32)
+        allowed = (mask != 0) & live
+        empty = ~jnp.any(allowed)
+        allowed = allowed | (empty & live)
+        cnt = jnp.sum(allowed).astype(jnp.uint32)
+        cs = jnp.cumsum(allowed.astype(jnp.int32))
+
+        def rank(k):
+            return jnp.argmax(cs > k.astype(jnp.int32)
+                              ).astype(jnp.int32)
+
+        pos = rank(words[1] % cnt)
+        bk = words[7] % (cnt * 8)
+        bit = rank(bk >> 3) * 8 + (bk & 7).astype(jnp.int32)
     delta = (rint % ARITH_MAX + 1).astype(jnp.uint32)
     use_fill = (rint % 4) == 0  # insert/overwrite: 25% fill, 75% clone
 
@@ -470,6 +496,38 @@ def havoc_focus_at(buf: jax.Array, length: jax.Array, key: jax.Array,
         i, w = xs
         b, ln = carry
         nb, nln = _havoc_one(b, ln, w, positions=positions)
+        active = i < stack
+        b = jnp.where(active, nb, b)
+        ln = jnp.where(active, nln, ln)
+        return (b, ln), None
+
+    (out, out_len), _ = jax.lax.scan(
+        step, (buf, length),
+        (jnp.arange(n_steps, dtype=jnp.uint32), words[1:]))
+    return out, out_len
+
+
+@partial(jax.jit, static_argnames=("stack_pow2",))
+def havoc_mask_at(buf: jax.Array, length: jax.Array, key: jax.Array,
+                  mask: jax.Array, stack_pow2: int = 4
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """``havoc_at`` with edit positions drawn from the SET bytes of
+    a dense uint8[L] ``mask`` — the learned-saliency variant the
+    device generation scans inline (the mask is computed per
+    generation from the model, so it must be a dense tensor, not a
+    host-built position list like ``havoc_focus_at``'s).  With an
+    all-ones mask the RNG stream AND every edit are bit-identical to
+    ``havoc_at`` (see ``_havoc_one``), which is what keeps the
+    shaped generation scan parity-pinned while the model is
+    untrained."""
+    n_steps = 1 << stack_pow2
+    words = jax.random.bits(key, (n_steps + 1, 8), dtype=jnp.uint32)
+    stack = jnp.uint32(1) << (1 + words[0, 0] % stack_pow2)
+
+    def step(carry, xs):
+        i, w = xs
+        b, ln = carry
+        nb, nln = _havoc_one(b, ln, w, mask=mask)
         active = i < stack
         b = jnp.where(active, nb, b)
         ln = jnp.where(active, nln, ln)

@@ -276,7 +276,11 @@ class ShardedCampaignDriver(Driver):
         seed_buf, seed_len, _key, stack_pow2 = mut.fused_spec()
         L = int(mut.max_length)
         slots = max(int(instr.options.get("gen_ring_slots", 32)), 2)
-        key = (L, slots)
+        # learned shaping is decided by weight presence (the loop
+        # installs them before the FIRST dispatch, so the flag never
+        # flips mid-campaign and the ring never rebuilds for it)
+        learn = getattr(instr, "learn_params", None) is not None
+        key = (L, slots, learn)
         if self._gen_ring is not None and self._gen_ring_key == key:
             return
         bpd = self.batch_per_device
@@ -294,7 +298,7 @@ class ShardedCampaignDriver(Driver):
             engine=instr.engine, interpret=self._interpret,
             seed=int(self.mutator.options.get("seed", 0)),
             salt=salt, adm_cap=adm_cap, findings_cap=cap,
-            stateful=self._stateful)
+            stateful=self._stateful, learn=learn)
         self._gen_ring = sharded_gen_ring_init(
             self.mesh, seed_buf, int(seed_len), slots, L)
         self._gen_ring_key = key
@@ -320,7 +324,8 @@ class ShardedCampaignDriver(Driver):
         with self._span("execute"):     # the whole loop is in-kernel
             self.state, self._gen_ring, rep = self._gen_dispatch(
                 self.state, self._gen_ring, base_it, self._gen_count,
-                int(g), reseed=bool(reseed), fold_every=fold_every)
+                int(g), reseed=bool(reseed), fold_every=fold_every,
+                learn_params=getattr(instr, "learn_params", None))
         out = MeshGenerationOutcome(
             *rep, ring_filled=self._gen_ring.filled,
             gen0=self._gen_count, g=int(g), n_real=n, cap=self._gen_cap,

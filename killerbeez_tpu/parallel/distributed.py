@@ -259,11 +259,18 @@ class _ShardKernels:
             p.max_steps, p.n_edges, interpret=self.interpret,
             dots=self.dots)
 
-    def mutate_exec(self, keys, seed_buf, seed_len):
+    def mutate_exec(self, keys, seed_buf, seed_len, mask=None):
         """havoc-mutate this shard's lanes from ``seed_buf`` and
-        execute them; returns (VMResult, bufs, lens)."""
+        execute them; returns (VMResult, bufs, lens).  ``mask`` is
+        the learned dense uint8[L] focus mask (learn/): mutation
+        routes through the masked havoc kernel — xla engine only
+        (the generation scan guards it), and an all-ones mask is
+        bit-identical to the unmasked kernel."""
         p = self.program
         bpd = self.batch_per_device
+        if mask is not None and self.engine != "xla":
+            raise ValueError(
+                "learned mutation shaping needs the xla engine")
         if self.engine == "pallas_fused":
             # mutation AND execution in one kernel per dp shard
             from ..ops.vm_kernel import (
@@ -290,9 +297,16 @@ class _ShardKernels:
                 bufs = bufs[:bpd]
                 lens = lens[:bpd]
             return res, bufs, lens
-        bufs, lens = jax.vmap(
-            lambda k: havoc_at(seed_buf, seed_len, k,
-                               stack_pow2=self.stack_pow2))(keys)
+        if mask is not None:
+            from ..ops.mutate_core import havoc_mask_at
+            bufs, lens = jax.vmap(
+                lambda k: havoc_mask_at(
+                    seed_buf, seed_len, k, mask,
+                    stack_pow2=self.stack_pow2))(keys)
+        else:
+            bufs, lens = jax.vmap(
+                lambda k: havoc_at(seed_buf, seed_len, k,
+                                   stack_pow2=self.stack_pow2))(keys)
         if self.stateful is not None:
             # session tier: the mutants are framed sequences and the
             # result carries se_counts alongside the classic fields
@@ -649,7 +663,7 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                              salt: int = 0,
                              adm_cap: int = DEFAULT_ADM_CAP,
                              findings_cap: int = DEFAULT_FINDINGS_CAP,
-                             stateful=None):
+                             stateful=None, learn: bool = False):
     """Build the mesh-resident generation dispatch: the single-chip
     generation scan (ops/generations.py) lifted into a ``shard_map``
     over the (dp, mp) mesh.
@@ -692,6 +706,11 @@ def make_sharded_generations(program: Program, mesh: Mesh,
     """
     n_dp = mesh.shape["dp"]
     b = int(batch_per_device)
+    if learn and engine != "xla":
+        raise ValueError(
+            "learned mutation shaping needs the xla engine (the "
+            "fused VMEM kernel generates candidates in-kernel and "
+            "cannot consume a per-generation mask)")
     kern = _ShardKernels(program, mesh, b, max_len,
                          stack_pow2=stack_pow2, engine=engine,
                          interpret=interpret, seed=seed,
@@ -705,7 +724,7 @@ def make_sharded_generations(program: Program, mesh: Mesh,
         A_eff = A if reseed else 1
 
         def body(vb, vc, vh, rbufs, rlens, rfilled, rhits, rfinds,
-                 rptr, vs, base_it, gen0, salt):
+                 rptr, vs, base_it, gen0, salt, lp):
             dp_i = jax.lax.axis_index("dp")
             # P("dp") blocks arrive with a leading axis of 1
             rbufs, rlens, rfilled, rhits, rfinds, rptr, vs = (
@@ -733,8 +752,17 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                 lo = base_it[0] + off
                 hi = base_it[1] + (lo < base_it[0]).astype(jnp.uint32)
                 keys, its = kern.lane_keys(lo, hi)
+                if learn:
+                    # in-scan inference on this shard's selected
+                    # ring slot (replicated weights, per-shard seed
+                    # — shards shape their own streams)
+                    from ..learn.model import masked_saliency
+                    mask = masked_saliency(lp, seed_buf, seed_len)
+                else:
+                    mask = None
                 res, bufs, lens = kern.mutate_exec(keys, seed_buf,
-                                                   seed_len)
+                                                   seed_len,
+                                                   mask=mask)
                 statuses = jnp.where(res.status == FUZZ_RUNNING,
                                      FUZZ_HANG, res.status)
                 rets, uc, uh, vb, vc, vh = kern.triage_local(
@@ -826,8 +854,12 @@ def make_sharded_generations(program: Program, mesh: Mesh,
             fn = jax.jit(
                 shard_map(
                     gen_body(g, reseed, fold_every), mesh=mesh,
+                    # the trailing P() is the learn-model weight
+                    # pytree, replicated to every shard (a pytree
+                    # prefix: one spec covers all leaves)
                     in_specs=(P("mp"), P("mp"), P("mp"),
-                              *dp_specs, P("dp"), P(), P(), P()),
+                              *dp_specs, P("dp"), P(), P(), P(),
+                              P()),
                     out_specs=((P("mp"), P("mp"), P("mp"))
                                + (P("dp"),) * 20),
                     check_vma=False),
@@ -844,7 +876,7 @@ def make_sharded_generations(program: Program, mesh: Mesh,
 
     def dispatch(state: ShardedFuzzState, ring: ShardedGenRing,
                  base_it, gen0: int, g: int, reseed: bool = True,
-                 fold_every: int = 0):
+                 fold_every: int = 0, learn_params=None):
         """Run ``g`` mesh generations in ONE device program.
         ``fold_every`` <= 0 means auto: once per dispatch with
         reseeding on (cheapest), every generation with reseeding off
@@ -867,11 +899,16 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                 "%d generations: folding every %d instead (a "
                 "dispatch must end on a fold so the virgin maps "
                 "return dp-replicated)", int(fold_every), g, fold)
+        if learn and learn_params is None:
+            raise ValueError(
+                "this mesh generation dispatch was built with "
+                "learn=True — pass the model weights (learn_params)")
+        lp = learn_params if learn else jnp.zeros((1,), jnp.float32)
         outs = _jit(g, bool(reseed), fold)(
             state.virgin_bits, state.virgin_crash, state.virgin_tmout,
             ring.bufs, ring.lens, ring.filled, ring.hits, ring.finds,
             ring.ptr, state.virgin_state, _counter_halves(base_it),
-            jnp.uint32(int(gen0)), salt_u32)
+            jnp.uint32(int(gen0)), salt_u32, lp)
         (vb, vc, vh, vs, rbufs, rlens, rfilled, rhits, rfinds, rptr,
          *rep) = outs
         new_state = ShardedFuzzState(vb, vc, vh, state.step + g, vs)

@@ -72,7 +72,12 @@ EVENT_TYPES = ("new_path", "crash", "hang", "plateau",
                # the state x edge coverage high-water rose — pairs =
                # touched (state, edge) buckets, states = distinct
                # protocol states seen (kb-timeline's session section)
-               "state_cov")
+               "state_cov",
+               # learned mutation shaping (killerbeez_tpu/learn/):
+               # one completed on-device training round of the
+               # byte-saliency model — version, label counts, the
+               # final batch loss
+               "learn_update")
 
 #: events a fleet worker forwards to the manager alongside heartbeats
 TERMINAL_EVENTS = ("crash", "hang", "plateau")

@@ -35,7 +35,7 @@ HIST_BUCKETS: List[float] = [1e-6 * (2 ** i) for i in range(27)]
 #: canonical loop stage names, in pipeline order (glossary in
 #: docs/OBSERVABILITY.md)
 STAGES = ("mutate", "execute", "host_transfer", "triage",
-          "corpus_feedback", "fs_write")
+          "corpus_feedback", "fs_write", "learn")
 
 
 class EmaRate:

@@ -86,6 +86,29 @@ def render_stats(entries: List[CorpusEntry],
             f"{len(st_entries)} session entries ("
             + ", ".join(f"s{s}:{n}" for s, n in
                         sorted(per_state.items())) + ")")
+    # mutation-provenance summary (learn tier): do this campaign's
+    # sidecars carry enough byte-diff labels to train on?
+    labeled = [e for e in entries
+               if isinstance(getattr(e, "provenance", None), dict)]
+    if entries:
+        line = (f"provenance     : {len(labeled)} labeled / "
+                f"{len(entries) - len(labeled)} unlabeled entries "
+                f"(byte-diff training labels)")
+        if labeled:
+            from ..learn.dataset import provenance_positions
+            hist: Dict[int, int] = {}
+            for e in labeled:
+                pos = provenance_positions(e.provenance, len(e.buf))
+                if pos is None:
+                    continue
+                for p in pos.tolist():
+                    hist[p] = hist.get(p, 0) + 1
+            if hist:
+                top = sorted(hist.items(),
+                             key=lambda kv: (-kv[1], kv[0]))[:5]
+                line += "; top mutated positions: " + ", ".join(
+                    f"{p} (x{n})" for p, n in top)
+        lines.append(line)
     by_src: Dict[str, int] = {}
     for e in entries:
         by_src[e.source] = by_src.get(e.source, 0) + 1

@@ -150,6 +150,16 @@ def render(snap: Dict[str, Any]) -> str:
             line += (f" | {_fmt_n(c.get('findings_ring_drops', 0))} "
                      "findings-ring drops")
         lines.append(line)
+    if g.get("learn_model_version") or c.get("learn_train_steps") \
+            or g.get("learn_label_count"):
+        line = (f"  learn    : model v{int(g.get('learn_model_version', 0))}"
+                f" | {_fmt_n(g.get('learn_label_count', 0))} labels"
+                f" | {_fmt_n(c.get('learn_train_steps', 0))} train "
+                f"steps")
+        if c.get("learn_masks_applied"):
+            line += (f" | {_fmt_n(c.get('learn_masks_applied', 0))} "
+                     "masks applied")
+        lines.append(line)
     if g.get("state_cov_pairs"):
         lines.append(
             f"  stateful : "

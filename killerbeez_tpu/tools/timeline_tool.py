@@ -44,7 +44,8 @@ from ..telemetry.trace import load_chrome_trace
 
 #: stages that are HOST attention (bubble attribution candidates);
 #: "execute" is the device dispatch, "in_flight" is occupancy
-HOST_STAGES = ("mutate", "host_transfer", "triage", "corpus_feedback",
+HOST_STAGES = ("mutate", "host_transfer", "triage", "learn",
+               "corpus_feedback",
                "fs_write", "crack", "sync_round")
 
 #: lane-view glyph per span name (top-of-stack wins)

@@ -1,0 +1,544 @@
+"""Learned mutation shaping (ISSUE 14, killerbeez_tpu/learn/): a
+byte-saliency model trained from corpus lineage, inference inside the
+device generation scan.
+
+Pins the tier's contracts:
+  * the PARITY ANCHOR — a version-0 model emits logit exactly 0.0,
+    quantizes to the all-ones mask, and the masked havoc kernel with
+    an all-ones mask is bit-identical to ``havoc_at``; the shaped
+    generation scans (single-chip -G and dp>1 mesh, feedback on and
+    off) are then bit-identical to the unshaped scans — findings,
+    virgin maps AND corpus-store write-through;
+  * the model learns: synthetic positional labels converge to a mask
+    selecting exactly the labeled positions;
+  * provenance sidecars round-trip (and pre-learn sidecars load
+    unchanged), the quarantine validator accepts/bounds the field,
+    kb-corpus summarizes label coverage;
+  * the loop end-to-end: labels flow from admissions, training runs
+    between dispatches, learn_update events + counters/gauges fold
+    through aggregate.merge, checkpoint/--resume restores the model
+    and rebuilds labels from sidecars;
+  * the fixedform_vm family certificate: the padding regions carry
+    NO branch dependency (dataflow-exact), which is what makes the
+    bench gate's uplift claim honest.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from killerbeez_tpu.corpus.quarantine import EntryValidator
+from killerbeez_tpu.corpus.store import CorpusEntry, CorpusStore
+from killerbeez_tpu.drivers.factory import driver_factory
+from killerbeez_tpu.fuzzer.loop import Fuzzer
+from killerbeez_tpu.instrumentation.factory import instrumentation_factory
+from killerbeez_tpu.learn import LearnTier, dataset, model
+from killerbeez_tpu.mutators.factory import mutator_factory
+from killerbeez_tpu.ops import mutate_core as mc
+
+SEED = b"ABCD1234"
+
+
+# ---------------------------------------------------------------------------
+# model: the parity anchor + learnability
+# ---------------------------------------------------------------------------
+
+
+def test_v0_model_logits_exactly_zero_all_ones_mask():
+    """init_params zeroes the output layer: logits are EXACTLY 0.0
+    (not merely small) for arbitrary inputs, and the quantized mask
+    is all-ones — the anchor the whole parity story rests on."""
+    p = model.init_params()
+    rng = np.random.default_rng(0)
+    for ln in (1, 7, 16):
+        buf = jnp.asarray(rng.integers(0, 256, 32).astype(np.uint8))
+        lg = model.saliency_logits(p, buf, jnp.int32(ln))
+        assert float(jnp.max(jnp.abs(lg))) == 0.0
+        m = np.asarray(model.quantize_mask(lg, jnp.int32(ln)))
+        assert m.tolist() == [1] * 32   # past-prefix stays mutable
+
+
+@pytest.mark.parametrize("case_seed", [0, 7, 91])
+def test_masked_havoc_all_ones_bit_identical(case_seed):
+    """havoc_mask_at with an all-ones mask == havoc_at, byte for
+    byte, over random seeds/lengths/keys; an all-ZERO mask falls
+    back to uniform (never pins mutation to nothing)."""
+    rng = np.random.default_rng(case_seed)
+    for _ in range(10):
+        L = int(rng.choice([16, 24, 64]))
+        ln = int(rng.integers(1, L + 1))
+        buf = jnp.asarray(rng.integers(0, 256, L).astype(np.uint8))
+        key = jax.random.key(int(rng.integers(0, 2**31)))
+        a, la = mc.havoc_at(buf, jnp.int32(ln), key, stack_pow2=4)
+        b, lb = mc.havoc_mask_at(buf, jnp.int32(ln), key,
+                                 jnp.ones((L,), jnp.uint8),
+                                 stack_pow2=4)
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert int(la) == int(lb)
+        c, lc = mc.havoc_mask_at(buf, jnp.int32(ln), key,
+                                 jnp.zeros((L,), jnp.uint8),
+                                 stack_pow2=4)
+        assert np.array_equal(np.asarray(a), np.asarray(c))
+        assert int(la) == int(lc)
+
+
+def test_model_learns_synthetic_positions():
+    """Positions 0..3 labeled positive, the rest negative, across
+    random 24-byte parents: after one training round the mask keeps
+    exactly the labeled positions (on unseen buffers too)."""
+    lb = dataset.LabelBuffer()
+    rng = np.random.default_rng(1)
+    for i in range(40):
+        buf = rng.integers(0, 256, 24).astype(np.uint8).tobytes()
+        lb.add(f"p{i}", buf, [0, 1, 2, 3], 1)
+        lb.add(f"p{i}", buf, list(range(4, 24)), 0, cap=8)
+    tier = LearnTier(train_interval_s=0.0, min_labels=10,
+                     steps_per_round=50)
+    tier.labels = lb
+    loss = tier.train_round()
+    assert tier.version == 1 and loss is not None and loss < 0.2
+    unseen = bytes(rng.integers(0, 256, 24, dtype=np.uint8))
+    pos = tier.focus_positions_for(unseen)
+    assert pos is not None
+    # pad_pow2 is the mutator's job; the tier returns the raw set
+    assert sorted(set(pos)) == [0, 1, 2, 3]
+
+
+def test_training_survives_label_buffer_saturation():
+    """REGRESSION: once the FIFO label buffer saturates, len(labels)
+    pins at cap — the new-labels signal must read the MONOTONE
+    intake counter or training silently freezes for the rest of the
+    campaign while masks keep being applied."""
+    tier = LearnTier(train_interval_s=0.0, min_labels=4,
+                     steps_per_round=1, sample_cap=32)
+    rng = np.random.default_rng(5)
+
+    def feed(n):
+        for _ in range(n):
+            buf = rng.integers(0, 256, 16).astype(np.uint8).tobytes()
+            tier.labels.add("k" + str(rng.integers(1 << 30)), buf,
+                            [0, 1], 1)
+            tier.labels.add("k" + str(rng.integers(1 << 30)), buf,
+                            [4, 5], 0)
+
+    feed(20)                             # well past cap=32 samples
+    assert len(tier.labels) == 32        # saturated
+    assert tier.train_round() is not None
+    v = tier.version
+    feed(5)                              # fresh labels, len still 32
+    assert len(tier.labels) == 32
+    assert tier.ready_to_train()
+    assert tier.maybe_train() and tier.version == v + 1
+    # and with NO fresh labels the round is skipped
+    assert not tier.ready_to_train()
+
+
+def test_resume_bootstrap_honors_informative_diff(tmp_path):
+    """REGRESSION: sidecar replay must apply the TIER'S live
+    informative-diff threshold, not a looser module constant — a
+    resumed campaign trains on exactly the samples the uninterrupted
+    one accepted."""
+    parent = bytes(64)
+    tier = LearnTier()
+    tier.informative_diff = 4
+    wide = bytearray(parent)
+    for p in range(8):                   # 8-position diff: > 4
+        wide[p] ^= 0xFF
+    prov = dataset.make_provenance(parent, bytes(wide), "havoc")
+    entries = [CorpusEntry(bytes(wide), parent="base",
+                           provenance=prov)]
+    used = tier.bootstrap(entries, lambda k: parent)
+    assert used == 0 and tier.labels.positives == 0
+    tier.informative_diff = 16           # now inside the threshold
+    assert tier.bootstrap(entries, lambda k: parent) == 1
+    assert tier.labels.positives > 0
+
+
+def test_focus_mask_pad_pow2_shape_stability():
+    """set_focus_mask(pad_pow2=True) cycles positions to the next
+    power-of-two length (log2 compiled shapes instead of one per
+    mask size); padding stays inside the mask set."""
+    mut = mutator_factory("havoc", None, SEED)
+    mut.set_focus_mask([1, 3, 6], pad_pow2=True)
+    got = mut.focus_positions.tolist()
+    assert len(got) == 4 and set(got) == {1, 3, 6}
+    mut.set_focus_mask([1, 3, 6])            # default: exact set
+    assert mut.focus_positions.tolist() == [1, 3, 6]
+    mut.set_focus_mask(None)
+    assert mut.focus_positions is None
+
+
+# ---------------------------------------------------------------------------
+# dataset: diffs, provenance codec, informative-diff rule
+# ---------------------------------------------------------------------------
+
+
+def test_diff_bitmap_and_b64_roundtrip():
+    parent = b"\x00" * 8
+    child = b"\x00\xFF\x00\x00\xAA\x00\x00\x00\x11\x22"
+    bm = dataset.diff_bitmap(parent, child)
+    assert bm.tolist() == [0, 1, 0, 0, 1, 0, 0, 0, 1, 1]
+    s = dataset.bitmap_to_b64(bm)
+    back = dataset.b64_to_bitmap(s, len(bm))
+    assert back.tolist() == bm.tolist()
+    assert dataset.b64_to_bitmap("not base64!!", 4) is None
+
+
+def test_provenance_record_and_positions():
+    prov = dataset.make_provenance(b"AAAA", b"ABAA", "havoc",
+                                   "havoc")
+    assert prov["mutator"] == "havoc" and prov["bytes"] == 1
+    pos = dataset.provenance_positions(prov, 4)
+    assert pos.tolist() == [1]
+    assert dataset.provenance_positions({"bitmap": 7}, 4) is None
+
+
+def test_informative_diff_rule():
+    """A smeared (block-op) diff contributes NO positive labels —
+    large diffs carry ~no positional signal — while its provenance
+    record is still produced for the sidecar."""
+    tier = LearnTier()
+    parent = bytes(range(64))
+    smeared = bytes(64)                      # every byte differs
+    prov = tier.note_admission("p", parent, smeared, "havoc")
+    assert prov is not None and prov["bytes"] == 63  # byte 0 matches
+    assert tier.labels.positives == 0
+    small = bytearray(parent)
+    small[5] ^= 0xFF
+    tier.note_admission("p", parent, bytes(small), "havoc")
+    assert tier.labels.positives == 1
+
+
+# ---------------------------------------------------------------------------
+# the parity suite: shaped scans == unshaped scans at version 0
+# ---------------------------------------------------------------------------
+
+
+def _findings(root):
+    out = {}
+    for kind in ("crashes", "hangs", "new_paths"):
+        d = os.path.join(root, kind)
+        out[kind] = sorted(
+            f for f in (os.listdir(d) if os.path.isdir(d) else [])
+            if len(f) == 32)
+    return out
+
+
+@pytest.mark.parametrize("reseed", [False, True])
+def test_generation_scan_learn_v0_parity_single_chip(reseed):
+    """The shaped single-chip generation scan with version-0 weights
+    is bit-identical to the unshaped scan: findings ring, admission
+    ledger AND virgin maps — reseeding on and off."""
+    def run(learn):
+        instr = instrumentation_factory("jit_harness",
+                                        '{"target": "test"}')
+        mut = mutator_factory("havoc", '{"seed": 7}', SEED)
+        if learn:
+            instr.learn_params = model.init_params()
+        its = mut.peek_iterations(64)
+        out = instr.run_batch_generations(mut, its, 4, pad_to=64,
+                                          reseed=reseed)
+        return out.materialize(), instr
+
+    h0, i0 = run(False)
+    h1, i1 = run(True)
+    assert int(h0.fr_ptr) == int(h1.fr_ptr)
+    st = min(int(h0.fr_ptr), int(h0.cap))
+    assert st > 0, "nothing found — the comparison is vacuous"
+    assert np.array_equal(h0.fr_bufs[:st], h1.fr_bufs[:st])
+    assert np.array_equal(h0.fr_pack[:st], h1.fr_pack[:st])
+    assert np.array_equal(h0.adm_bufs, h1.adm_bufs)
+    for a, b in ((i0.virgin_bits, i1.virgin_bits),
+                 (i0.virgin_crash, i1.virgin_crash),
+                 (i0.virgin_tmout, i1.virgin_tmout)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("feedback", [0, 8])
+def test_generation_campaign_learn_v0_parity(tmp_path, feedback):
+    """Full -G campaigns: a learn tier that never trains (version 0
+    — min_labels out of reach) produces findings AND store
+    write-through identical to a no-learn campaign, feedback on and
+    off."""
+    def run(name, learn):
+        instr = instrumentation_factory(
+            "jit_harness", '{"target": "test", "learn": %d}'
+            % int(learn))
+        mut = mutator_factory("havoc", '{"seed": 7}', SEED)
+        drv = driver_factory("file", None, instr, mut)
+        tier = LearnTier(min_labels=10**9) if learn else None
+        fz = Fuzzer(drv, output_dir=str(tmp_path / name),
+                    batch_size=64, feedback=feedback, generations=4,
+                    corpus_dir=str(tmp_path / name / "corpus"),
+                    learn=tier)
+        fz.run(1024)
+        return fz
+
+    run("off", False)
+    fz = run("on", True)
+    assert fz.learn.version == 0       # the parity regime held
+    assert _findings(str(tmp_path / "on")) == \
+        _findings(str(tmp_path / "off"))
+    assert _findings(str(tmp_path / "on"))["new_paths"], "vacuous"
+
+    def entries(name):
+        d = tmp_path / name / "corpus"
+        return sorted(f for f in os.listdir(d) if len(f) == 32)
+
+    assert entries("on") == entries("off")
+
+
+@pytest.mark.parametrize("reseed", [False, True])
+def test_mesh_generation_scan_learn_v0_parity(reseed):
+    """The dp>1 mesh generation scan with version-0 weights is
+    bit-identical to the unshaped mesh scan, per shard."""
+    from killerbeez_tpu.parallel import ShardedCampaignDriver
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices (conftest forces 8 on CPU)")
+
+    def run(learn):
+        instr = instrumentation_factory("jit_harness",
+                                        '{"target": "test"}')
+        mut = mutator_factory("havoc", '{"seed": 7}', SEED)
+        drv = ShardedCampaignDriver("2,1", instr, mut,
+                                    batch_size=128)
+        if learn:
+            instr.learn_params = model.init_params()
+        out = drv.test_batch_generations(128, 4, reseed=reseed)
+        return out.materialize(), instr
+
+    h0, i0 = run(False)
+    h1, i1 = run(True)
+    found = 0
+    for d in range(2):
+        s0, s1 = h0.shard(d), h1.shard(d)
+        assert int(s0.fr_ptr) == int(s1.fr_ptr)
+        st = min(int(s0.fr_ptr), int(s0.cap))
+        found += st
+        assert np.array_equal(s0.fr_bufs[:st], s1.fr_bufs[:st])
+        assert np.array_equal(s0.adm_bufs, s1.adm_bufs)
+    assert found > 0, "vacuous"
+    assert np.array_equal(np.asarray(i0.virgin_bits),
+                          np.asarray(i1.virgin_bits))
+
+
+# ---------------------------------------------------------------------------
+# provenance sidecars: store round-trip, back-compat, quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_provenance_sidecar_roundtrip_and_backcompat(tmp_path):
+    store = CorpusStore(str(tmp_path / "c"))
+    prov = dataset.make_provenance(b"AAAA", b"ABAA", "havoc", None)
+    e = CorpusEntry(b"ABAA", parent="base", provenance=prov)
+    assert store.put(e)
+    old = CorpusEntry(b"OLD!")           # pre-learn sidecar: no field
+    assert store.put(old)
+    # strip the provenance key entirely (an OLD writer's sidecar)
+    meta = json.loads(open(store.meta_path(old.md5)).read())
+    meta.pop("provenance", None)
+    with open(store.meta_path(old.md5), "w") as f:
+        json.dump(meta, f)
+    loaded = {x.md5: x for x in store.load()}
+    assert loaded[e.md5].provenance == prov
+    assert loaded[old.md5].provenance is None
+
+
+def test_validator_accepts_and_bounds_provenance():
+    import base64
+    v = EntryValidator()
+
+    def row(prov):
+        return {"content_b64": base64.b64encode(b"hello").decode(),
+                "meta": {"provenance": prov}}
+
+    good = dataset.make_provenance(b"hello", b"hellp", "havoc",
+                                   "havoc")
+    entry, reason = v.validate(row(good))
+    assert reason is None and entry.provenance == good
+    for bad in (
+            "not-a-dict",
+            {"mutator": 7},
+            {"mutator": "x" * 65},
+            {"mutator": "havoc", "stage": 5},
+            {"mutator": "havoc", "bitmap": "A" * 4096},
+            {"mutator": "havoc", "bytes": -1},
+            {"mutator": "havoc", "bytes": 10**6}):
+        entry, reason = v.validate(row(bad))
+        assert entry is None and reason == "schema:provenance", bad
+    # absent field: pre-learn rows pass untouched
+    entry, reason = v.validate(
+        {"content_b64": base64.b64encode(b"hello").decode(),
+         "meta": {}})
+    assert reason is None and entry.provenance is None
+
+
+def test_corpus_stats_provenance_line(tmp_path):
+    from killerbeez_tpu.tools.corpus_tool import render_stats
+    prov = dataset.make_provenance(b"\x00" * 8, b"\x00\xFF" * 4,
+                                   "havoc", None)
+    entries = [CorpusEntry(b"\x00\xFF" * 4, provenance=prov),
+               CorpusEntry(b"plain")]
+    out = render_stats(entries)
+    assert "provenance" in out
+    assert "1 labeled / 1 unlabeled" in out
+    assert "top mutated positions" in out
+
+
+# ---------------------------------------------------------------------------
+# loop end-to-end: labels -> training -> events -> checkpoint/resume
+# ---------------------------------------------------------------------------
+
+
+def _learn_campaign(tmp_path, name, resume=False, tier=None):
+    instr = instrumentation_factory(
+        "jit_harness", '{"target": "cgc_like", "learn": 1}')
+    mut = mutator_factory("havoc", '{"seed": 11}',
+                          b"CG\x02\x04\x05Axxx")
+    drv = driver_factory("file", None, instr, mut)
+    tier = tier or LearnTier(train_interval_s=0.0, min_labels=8)
+    fz = Fuzzer(drv, output_dir=str(tmp_path / name),
+                batch_size=256, feedback=8,
+                corpus_dir=str(tmp_path / name / "corpus"),
+                resume=resume, learn=tier)
+    return fz
+
+
+def test_learn_e2e_trains_events_counters_resume(tmp_path):
+    fz = _learn_campaign(tmp_path, "c")
+    fz.run(8192)
+    tier = fz.learn
+    assert len(tier.labels) > 0 and tier.labels.positives > 0
+    assert tier.version > 0 and tier.train_steps > 0
+    reg = fz.telemetry.registry
+    assert reg.counters["learn_train_steps"] == tier.train_steps
+    assert reg.gauges["learn_model_version"] == tier.version
+    evs = [json.loads(l) for l in
+           open(tmp_path / "c" / "events.jsonl") if l.strip()]
+    ups = [e for e in evs if e["type"] == "learn_update"]
+    assert ups and ups[-1]["version"] == tier.version
+    # provenance reached the sidecars
+    store_dir = tmp_path / "c" / "corpus"
+    provs = 0
+    for n in os.listdir(store_dir):
+        if not n.endswith(".json") or n == "campaign.json":
+            continue
+        try:
+            d = json.loads(open(store_dir / n).read())
+        except ValueError:
+            continue
+        provs += bool(isinstance(d, dict) and d.get("provenance"))
+    assert provs > 0
+    # --resume: the checkpointed model comes back and labels rebuild
+    # from the provenance sidecars
+    fz2 = _learn_campaign(tmp_path, "c", resume=True,
+                          tier=LearnTier())
+    assert fz2.learn.version == tier.version
+    assert np.allclose(np.asarray(fz2.learn.params[4]),
+                       np.asarray(tier.params[4]))
+    assert len(fz2.learn.labels) > 0
+
+
+def test_learn_counters_fold_through_merge():
+    from killerbeez_tpu.telemetry.aggregate import merge
+    a = {"counters": {"learn_train_steps": 8,
+                      "learn_masks_applied": 3},
+         "gauges": {"learn_model_version": 2,
+                    "learn_label_count": 100}}
+    b = {"counters": {"learn_train_steps": 5,
+                      "learn_masks_applied": 1},
+         "gauges": {"learn_model_version": 3,
+                    "learn_label_count": 50}}
+    m = merge([a, b])
+    assert m["counters"]["learn_train_steps"] == 13
+    assert m["counters"]["learn_masks_applied"] == 4
+    assert m["gauges"]["learn_model_version"] == 3
+    assert m["gauges"]["learn_label_count"] == 100
+
+
+def test_kb_stats_learn_row():
+    from killerbeez_tpu.tools.stats_tui import render
+    snap = {"counters": {"execs": 1000, "learn_train_steps": 24,
+                         "learn_masks_applied": 6},
+            "gauges": {"learn_model_version": 3,
+                       "learn_label_count": 420},
+            "rates": {}, "derived": {}, "elapsed": 1.0}
+    out = render(snap)
+    assert "learn" in out and "model v3" in out
+    assert "420 labels" in out and "24 train steps" in out
+    assert "6 masks applied" in out
+    # row absent without the tier
+    out2 = render({"counters": {"execs": 1}, "gauges": {},
+                   "rates": {}, "derived": {}, "elapsed": 1.0})
+    assert "model v" not in out2
+
+
+def test_learn_update_event_type_registered():
+    from killerbeez_tpu.telemetry.events import EVENT_TYPES
+    assert "learn_update" in EVENT_TYPES
+
+
+# ---------------------------------------------------------------------------
+# fixedform_vm: the bench family's honesty certificate
+# ---------------------------------------------------------------------------
+
+
+def test_fixedform_family_certificate():
+    """The bench gate's uplift claim rests on the padding being
+    PROVABLY inert: the dataflow layer's branch dependency union
+    must name only the documented live offsets — no branch anywhere
+    in the program reads a padding byte (store index 81 is live for
+    the planted bug's crash location but gates no branch)."""
+    from killerbeez_tpu.analysis import analyze_dataflow
+    from killerbeez_tpu.models import targets_cgc
+    from killerbeez_tpu.models.targets import get_target
+
+    prog = get_target("fixedform_vm")
+    df = analyze_dataflow(prog)
+    deps = set()
+    for br in df.branches:
+        deps |= set(br.deps or [])
+    live = {0, 1, 8, 16, 32, 64, 65, 72, 80} | set(range(24, 32))
+    assert deps == live
+    # seed exits clean; crash reproducer crashes
+    instr = instrumentation_factory("jit_harness",
+                                    '{"target": "fixedform_vm"}')
+    instr.enable(targets_cgc.fixedform_vm_seed())
+    assert instr.last_status == 0
+    instr2 = instrumentation_factory("jit_harness",
+                                     '{"target": "fixedform_vm"}')
+    instr2.enable(targets_cgc.fixedform_vm_crash())
+    assert instr2.last_status == 2      # FUZZ_CRASH
+
+
+def test_learned_mask_concentrates_on_live_offsets():
+    """Train the tier on fixedform-style labels (admissions mutate
+    live offsets, rejects/background the padding): the quantized
+    mask must keep the live offsets and drop most padding — the
+    mechanism behind the bench gate's uplift."""
+    from killerbeez_tpu.models import targets_cgc
+    seed = targets_cgc.fixedform_vm_seed()
+    live = sorted({0, 1, 8, 16, 32, 64, 65, 72, 80}
+                  | set(range(24, 32)))
+    tier = LearnTier(train_interval_s=0.0, min_labels=16,
+                     steps_per_round=60)
+    rng = np.random.default_rng(3)
+    for i in range(120):
+        pos = rng.choice(live, size=2, replace=False)
+        child = bytearray(seed)
+        for p in pos:
+            child[p] ^= int(rng.integers(1, 256))
+        tier.note_admission("base", seed, bytes(child), "havoc")
+    tier.train_round()
+    assert tier.version >= 1
+    mask = tier.mask_for(seed)
+    kept = set(np.flatnonzero(mask[:len(seed)]).tolist())
+    # the tiny windowed MLP generalizes, it does not memorize: most
+    # live offsets survive and most padding drops — the density
+    # shift the bench gate measures, not an exact set recovery
+    assert len(set(live) & kept) >= len(live) * 3 // 4
+    assert len(kept) < len(seed) * 3 // 4

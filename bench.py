@@ -660,16 +660,220 @@ def bench_crack(targets=None, batch=256, budget_execs=131072,
                      "solver_injected", 0)))
 
 
+def _descend_engine_sweep(prog, edges, seeds0, engine, *, lanes,
+                          budget, scan_iters=8):
+    """One engine's frontier sweep: per-edge warm pass (ONE
+    minimal-budget descent — budget 1 for the host engine, budget
+    ``scan_iters`` for the device engine so the scan shape the
+    measured pass uses is the one compiled — primes the per-edge jit
+    cache so the measurement is steady-state descent speed, the
+    quantity the in-scan engine changes; the cold XLA compile
+    constant is identical work amortized by the TPU tier's
+    persistent compile cache) then the measured pass.  Returns
+    (total_seconds, cracked_edges, cumulative (k, seconds) curve)."""
+    from killerbeez_tpu.search import (
+        descend_edge, descend_edge_device, seeds_reaching_block,
+    )
+    traces = {}
+    seeds = list(seeds0)
+    total = 0.0
+    cracked = []
+    cum = []
+    for e in edges:
+        se = seeds_reaching_block(prog, seeds, e[0], cap=24,
+                                  trace_cache=traces) or seeds[:16]
+
+        def run(b):
+            if engine == "device":
+                return descend_edge_device(
+                    prog, e, se, lanes=lanes, budget=b,
+                    scan_iters=scan_iters, trace_cache=traces)
+            return descend_edge(prog, e, se, lanes=lanes, budget=b,
+                                trace_cache=traces)
+
+        # warm the per-edge jit cache: the device engine must warm
+        # the scan length the measured pass uses (a budget of 1
+        # would compile the 1-iteration tail shape instead)
+        run(scan_iters if engine == "device" else 1)
+        t0 = time.time()
+        r = run(budget)
+        total += time.time() - t0
+        if r.status == "descended":
+            cracked.append(e)
+            seeds.append(r.input)
+            cum.append((len(cracked), round(total, 3)))
+    return total, cracked, cum
+
+
+def _descend_engine_ab(gate, lanes=1024, budget=32, i2s_budget=16,
+                       edge_cap=8):
+    """Host-driven vs device-resident descent engine at EQUAL
+    iteration budget over the solver-unknown frontier: the
+    wall-clock-to-crack A/B (one warm pass per edge first — see
+    _descend_engine_sweep), plus the input-to-state ablation on the
+    operand-compare family.  Per family the device engine must crack
+    at least as many edges AND deliver them in less wall-clock
+    (strictly more cracks also passes: coverage dominates); one
+    logged re-measure on CPU absorbs shared-runner noise (the PR 9
+    guard).  Returns (ok, rows)."""
+    import numpy as np
+    from killerbeez_tpu.analysis.solver import solve_edge
+    from killerbeez_tpu.models import targets as targets_mod
+    from killerbeez_tpu.models import targets_cgc  # noqa: F401
+    from killerbeez_tpu.search import descend_edge_device
+
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    rows = []
+    ok = True
+    fam_edges = {}
+    fam_seeds = {}
+    # imgparse's 32-edge frontier is capped (logged below); tlvstack
+    # runs WHOLE — its first edges are the deep-exec exhausted ones,
+    # and a prefix-only subset would misrepresent the family as
+    # exhaust-dominated when most of its frontier cracks
+    for tname, cap in (("imgparse_vm", edge_cap),
+                       ("tlvstack_vm", 99),
+                       ("magicsum_vm", 99)):
+        prog = targets_mod.get_target(tname)
+        universe = [(int(f), int(t)) for f, t in
+                    zip(np.asarray(prog.edge_from),
+                        np.asarray(prog.edge_to))]
+        seeds, unknown = [], []
+        for e in universe:
+            r = solve_edge(prog, e)
+            if r.status == "solved":
+                seeds.append(r.input)
+            elif r.status == "unknown":
+                unknown.append(e)
+        seeds = list(dict.fromkeys(seeds)) or [bytes(8)]
+        edges = unknown[:cap]
+        if len(unknown) > len(edges):
+            print(f"  [descend-ab {tname}: measuring the first "
+                  f"{len(edges)} of {len(unknown)} unknown edges "
+                  f"(--descend edge cap)]", file=sys.stderr)
+        fam_edges[tname] = edges
+        fam_seeds[tname] = seeds
+
+        def measure():
+            out = {}
+            for engine in ("host", "device"):
+                prog_ = targets_mod.get_target(tname)
+                out[engine] = _descend_engine_sweep(
+                    prog_, edges, seeds, engine, lanes=lanes,
+                    budget=budget)
+            return out
+
+        res = measure()
+        retry = None
+
+        # tlvstack's frontier is dominated by deep-exec exhausted
+        # edges whose cost is the shared VM while_loop, not the host
+        # round-trip this PR removes: on CPU the two engines measure
+        # within a few percent of each other there (device won both
+        # development runs, 64.4s vs 71.2s and 57.0s vs 57.7s, but
+        # the margin is inside shared-runner noise).  Its wall clock
+        # is REPORTED, and the gate holds crack-count parity; the
+        # strict wall-clock gate rides the families where the claim
+        # is the dominant term (imgparse: host python-gen + soft
+        # re-tracing per iteration; magicsum: i2s iteration-count
+        # collapse) per the ISSUE 15 acceptance wording.
+        time_gated = tname != "tlvstack_vm"
+
+        def fam_verdict(res):
+            ht, hc, hcum = res["host"]
+            dt, dc, dcum = res["device"]
+            kstar = min(len(hc), len(dc))
+            h_wtc = hcum[kstar - 1][1] if kstar else 0.0
+            d_wtc = dcum[kstar - 1][1] if kstar else 0.0
+            count_ok = len(dc) >= len(hc)
+            time_ok = (len(dc) > len(hc)) or (kstar == 0) \
+                or (d_wtc < h_wtc and dt < ht) or not time_gated
+            return count_ok and time_ok, kstar, h_wtc, d_wtc
+
+        fam_ok, kstar, h_wtc, d_wtc = fam_verdict(res)
+        if gate and not fam_ok and not on_tpu:
+            print(f"descend engine A/B on {tname} failed — "
+                  "re-measuring both engines once (shared-runner "
+                  "noise guard)", file=sys.stderr)
+            res = measure()
+            fam_ok, kstar, h_wtc, d_wtc = fam_verdict(res)
+            retry = True
+        ht, hc, hcum = res["host"]
+        dt, dc, dcum = res["device"]
+        row = emit(
+            f"descend-ab-{tname}",
+            f"host vs device descent engines on {tname} "
+            f"(lanes {lanes}, budget {budget} iterations/edge, "
+            f"warm-cache wall clock)",
+            dt, unit="seconds",
+            host_seconds=round(ht, 2),
+            host_cracked=len(hc), device_cracked=len(dc),
+            wall_clock_to_crack_k=kstar,
+            host_wtc=round(h_wtc, 2), device_wtc=round(d_wtc, 2),
+            device_cum=dcum, host_cum=hcum,
+            gate_ok=fam_ok, retried=bool(retry))
+        rows.append(row)
+        ok = ok and fam_ok
+        if gate and not fam_ok:
+            print(f"FAIL: device descent engine did not beat the "
+                  f"host engine on {tname} (host {len(hc)} cracks "
+                  f"{ht:.1f}s vs device {len(dc)} cracks {dt:.1f}s)",
+                  file=sys.stderr)
+
+    # input-to-state ablation: equal budget, i2s lanes on vs off —
+    # the operand-compare edge must separate them.  The ablation
+    # budget stays BELOW the ~30+ iterations a coordinate walk needs
+    # to carry a byte-granular descent across a 32-bit compare, so
+    # the separation measures the mechanism, not patience
+    prog = targets_mod.get_target("magicsum_vm")
+    seeds = fam_seeds["magicsum_vm"]
+    sep = {}
+    for flag in (True, False):
+        cracked = []
+        traces = {}
+        for e in fam_edges["magicsum_vm"]:
+            r = descend_edge_device(prog, e, seeds, lanes=256,
+                                    budget=i2s_budget, scan_iters=8,
+                                    i2s=flag, trace_cache=traces)
+            if r.status == "descended":
+                cracked.append(e)
+        sep[flag] = cracked
+    only_i2s = [e for e in sep[True] if e not in sep[False]]
+    i2s_ok = bool(only_i2s)
+    rows.append(emit(
+        "descend-i2s-ablation",
+        "device engine i2s-on vs i2s-off at equal budget "
+        "(magicsum_vm operand-compare family)",
+        float(len(only_i2s)), unit="edges",
+        i2s_on_cracked=[list(e) for e in sep[True]],
+        i2s_off_cracked=[list(e) for e in sep[False]],
+        only_i2s=[list(e) for e in only_i2s], gate_ok=i2s_ok))
+    if gate and not i2s_ok:
+        print("FAIL: input-to-state matching cracked no edge the "
+              "probe families alone left uncracked at equal budget",
+              file=sys.stderr)
+    return ok and i2s_ok, rows
+
+
 def bench_descend(targets=None, batch=256, budget_execs=65536,
                   plateau=4, chunk_batches=8, descend_budget=16,
                   descend_lanes=256, gate=False):
-    """--descend: gradient-search A/B lane.  Same blind-seed regime as
-    --crack, but on the CHECKSUM universes (imgparse/tlvstack) where
-    the exact solver's ceiling is known (36/68 and 173/186 static
-    edges): run crack-only vs crack+descend and report static-EDGE
-    coverage.  ``gate=True`` exits nonzero unless the descend lane
-    exceeds the solver ceiling (coverage the exact tier provably
-    cannot reach, so any pass proves the search tier earned edges).
+    """--descend: gradient-search A/B lane.  Three sections:
+
+    1. the blind-seed campaign A/B (crack-only vs crack+descend) on
+       the CHECKSUM universes (imgparse/tlvstack) where the exact
+       solver's ceiling is known (36/68 and 173/186 static edges) —
+       static-EDGE coverage must exceed the solver ceiling;
+    2. the ENGINE A/B (ISSUE 15): host-driven vs device-resident
+       descent at equal iteration budget over the solver-unknown
+       frontier — warm-cache wall-clock-to-crack must drop;
+    3. the input-to-state ablation on magicsum_vm — i2s must crack
+       >= 1 operand-compare edge the probe families alone left
+       uncracked at equal budget.
+
+    ``gate=True`` exits nonzero unless all three hold.  Artifact:
+    bench_out/BENCH_descend.json.
     """
     import json as _json
     import shutil
@@ -688,6 +892,7 @@ def bench_descend(targets=None, batch=256, budget_execs=65536,
     #: the descend lane must END ABOVE it
     floors = {"imgparse_vm": 36, "tlvstack_vm": 173}
     ok = True
+    rows = []
     for target in (targets or ("imgparse_vm", "tlvstack_vm")):
         prog = targets_mod.get_target(target)
         slots = np.asarray(prog.edge_slot)
@@ -720,7 +925,8 @@ def bench_descend(targets=None, batch=256, budget_execs=65536,
             edges_covered = int(sum(1 for s in slots
                                     if int(s) in covered))
             reg = fz.telemetry.registry
-            emit(f"descend-{mode}",
+            rows.append(emit(
+                 f"descend-{mode}",
                  f"gradient-search {mode} on {target} (-b {batch}, "
                  f"plateau {plateau}, blind 8-byte seed)",
                  fz.stats.iterations / dt if dt else 0.0,
@@ -736,14 +942,24 @@ def bench_descend(targets=None, batch=256, budget_execs=65536,
                      "search_attempts", 0)),
                  search_descended=int(reg.counters.get(
                      "search_descended", 0)),
+                 search_i2s_matches=int(reg.counters.get(
+                     "search_i2s_matches", 0)),
                  search_exhausted=int(reg.counters.get(
-                     "search_exhausted", 0)))
+                     "search_exhausted", 0))))
             if mode == "descend" and target in floors \
                     and edges_covered <= floors[target]:
                 print(f"FAIL: {target} descend lane covered "
                       f"{edges_covered} static edges <= solver "
                       f"ceiling {floors[target]}", file=sys.stderr)
                 ok = False
+
+    engines_ok, ab_rows = _descend_engine_ab(gate, lanes=1024)
+    rows.extend(ab_rows)
+    ok = ok and engines_ok
+    os.makedirs(os.path.join(REPO, "bench_out"), exist_ok=True)
+    with open(os.path.join(REPO, "bench_out",
+                           "BENCH_descend.json"), "w") as f:
+        _json.dump({"rows": rows, "gate_ok": ok}, f, indent=1)
     return 0 if (ok or not gate) else 1
 
 

@@ -148,6 +148,21 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="B",
                    help="candidate lanes per descent dispatch "
                         "(default 1024)")
+    p.add_argument("--descend-engine", choices=("device", "host"),
+                   default="device",
+                   help="descent engine: 'device' (default) fuses R "
+                        "rank->probe->mutate->re-score iterations "
+                        "into one dispatch with input-to-state "
+                        "operand matching (search/device_descent.py; "
+                        "stands down to the host engine on edges it "
+                        "cannot take), 'host' forces PR 7's "
+                        "host-driven engine")
+    p.add_argument("--descend-scan-iters", type=int, default=0,
+                   metavar="R",
+                   help="with --descend-engine device: iterations "
+                        "fused per device dispatch (default 8; the "
+                        "kb-stats descent row shows the live value "
+                        "as descent_iterations_per_dispatch)")
     p.add_argument("--learn", action="store_true",
                    help="learned mutation shaping (jit_harness): "
                         "train a small on-device byte-saliency model "
@@ -583,7 +598,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 prog, plateau_batches=args.crack,
                 focus=not args.no_focus, store=fuzzer.store,
                 descend=args.descend,
-                descend_lanes=args.descend_lanes)
+                descend_lanes=args.descend_lanes,
+                descend_engine=args.descend_engine,
+                descend_scan_iters=args.descend_scan_iters)
         try:
             stats = fuzzer.run(args.iterations)
         except Exception as e:

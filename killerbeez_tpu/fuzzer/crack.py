@@ -71,6 +71,8 @@ class BranchCracker:
                  max_len: int = DEFAULT_MAX_LEN,
                  focus: bool = True, store=None,
                  descend: int = 0, descend_lanes: int = 1024,
+                 descend_engine: str = "device",
+                 descend_scan_iters: int = 0,
                  max_solves: Optional[int] = None,
                  max_descends: Optional[int] = None):
         self.program = program
@@ -80,10 +82,22 @@ class BranchCracker:
         self.max_len = int(max_len)
         self.focus = bool(focus)
         self.store = store
-        #: descent step budget per edge (device dispatches); 0 = the
-        #: search tier is off and solver-unknown edges stay unknown
+        #: descent iteration budget per edge; 0 = the search tier is
+        #: off and solver-unknown edges stay unknown
         self.descend = int(descend)
         self.descend_lanes = int(descend_lanes)
+        #: which descent engine escalated edges run on: "device" =
+        #: the in-scan engine (search/device_descent.py, R iterations
+        #: fused per dispatch, input-to-state matching on) with an
+        #: automatic stand-down to the host engine on edges it cannot
+        #: take (unconditional edges); "host" = PR 7's host-driven
+        #: engine only
+        if descend_engine not in ("device", "host"):
+            raise ValueError(
+                f"descend_engine must be 'device' or 'host', "
+                f"got {descend_engine!r}")
+        self.descend_engine = descend_engine
+        self.descend_scan_iters = int(descend_scan_iters)
         #: per-crack work caps (instance-tunable: bench/offline
         #: callers crank them to sweep a whole universe in one crack)
         self.max_solves = int(max_solves) if max_solves \
@@ -273,7 +287,10 @@ class BranchCracker:
         attempt per edge per campaign lineage: verdicts (including
         ``exhausted``) cache under the edge's ``search`` key, so
         plateaus and ``--resume`` never re-descend."""
-        from ..search import descend_edge, seeds_reaching_block
+        from ..search import (
+            DEFAULT_SCAN_ITERS, descend_edge, descend_edge_device,
+            seeds_reaching_block,
+        )
         cand = []
         for e in uncovered:
             entry = self.cache.get(self._key(e))
@@ -293,6 +310,7 @@ class BranchCracker:
         traces: Dict[bytes, object] = {}
         n = attempted = 0
         t0 = time.time()
+        scan_iters = self.descend_scan_iters or DEFAULT_SCAN_ITERS
         for e in cand[:self.max_descends]:
             reg.count("search_attempts")
             attempted += 1
@@ -300,16 +318,25 @@ class BranchCracker:
             se = seeds_reaching_block(self.program, seeds, e[0],
                                       cap=24, trace_cache=traces) \
                 or seeds[:16]
-            res = descend_edge(self.program, e, se or [b"\x00"],
-                               mask=mask, lanes=self.descend_lanes,
-                               budget=self.descend,
-                               max_len=self.max_len, trace=tr,
-                               trace_cache=traces)
+            if self.descend_engine == "device":
+                res = descend_edge_device(
+                    self.program, e, se or [b"\x00"], mask=mask,
+                    lanes=self.descend_lanes, budget=self.descend,
+                    scan_iters=scan_iters, max_len=self.max_len,
+                    trace=tr, trace_cache=traces, registry=reg)
+            else:
+                res = descend_edge(self.program, e, se or [b"\x00"],
+                                   mask=mask,
+                                   lanes=self.descend_lanes,
+                                   budget=self.descend,
+                                   max_len=self.max_len, trace=tr,
+                                   trace_cache=traces)
             entry = dict(self.cache.get(self._key(e)) or {})
             d = res.as_dict()
             entry["search"] = {k: d[k] for k in
                                ("status", "steps", "evals",
-                                "best_dist", "objective")}
+                                "best_dist", "objective", "engine",
+                                "dispatches", "iterations", "i2s")}
             if res.status == "descended":
                 reg.count("search_descended")
                 entry["status"] = "descended"
@@ -324,6 +351,8 @@ class BranchCracker:
             fuzzer.telemetry.event(
                 "descent", edge=f"{e[0]}:{e[1]}", status=res.status,
                 steps=int(res.steps), evals=int(res.evals),
+                engine=res.engine, dispatches=int(res.dispatches),
+                i2s=bool(res.i2s),
                 best_dist=(None if res.input else float(res.best_dist)))
         if attempted:
             INFO_MSG("descend: %d unknown edges, %d attempts, %d "

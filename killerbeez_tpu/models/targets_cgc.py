@@ -800,6 +800,74 @@ def fixedform_vm() -> Program:
     return a.build(block_seed=0xF1F)
 
 
+@register_target("magicsum_vm")
+def magicsum_vm() -> Program:
+    """The input-to-state micro-family (Redqueen's motivating shape):
+    a 32-bit value assembled VERBATIM from the first four input bytes
+    (little-endian) must equal a multiply-accumulate checksum of the
+    remaining payload.
+
+        [b0 b1 b2 b3 | payload...],  len >= 6
+        stored = b0 + 256*b1 + 65536*b2 + 16777216*b3
+        acc    = fold(payload, 0x51D5A3, acc*33 + byte)
+        stored == acc  ->  the win block (planted wild store)
+
+    Why it exists: the exact solver reports the compare ``unknown``
+    (the checksum loop's length-dependent revisits blow the visit
+    cap), and coordinate probe walks need ~30+ iterations to carry a
+    byte-granular descent across all four stored bytes — but the
+    engine OBSERVES both operands at the compare, so input-to-state
+    matching writes the observed checksum straight into b0..b3 and
+    cracks it in one generation.  ``bench.py --descend`` gates that
+    separation (i2s-on vs i2s-off at equal budget) and
+    tests/test_device_descent.py pins the <= 2-dispatch crack."""
+    a = Assembler("magicsum_vm", mem_size=8, max_steps=512)
+    a.block()                                     # 0: entry
+    a.load_len(5)
+    a.ldi(2, 6)
+    a.br("lt", 5, 2, "exit")                      # len < 6 -> exit
+    a.block()                                     # 1: assemble stored
+    a.ldi(6, 256)
+    a.ldi(1, 3)
+    a.ldb(3, 1)                                   # r3 = b3
+    a.alu("mul", 3, 3, 6)
+    a.ldi(1, 2)
+    a.ldb(2, 1)
+    a.alu("add", 3, 3, 2)                         # (b3*256)+b2
+    a.alu("mul", 3, 3, 6)
+    a.ldi(1, 1)
+    a.ldb(2, 1)
+    a.alu("add", 3, 3, 2)
+    a.alu("mul", 3, 3, 6)
+    a.ldi(1, 0)
+    a.ldb(2, 1)
+    a.alu("add", 3, 3, 2)                         # r3 = stored (LE)
+    a.ldi(4, 0x51D5A3)                            # acc seed constant
+    a.ldi(1, 4)                                   # i = 4
+    a.block()                                     # 2: loop head
+    a.label("msum_loop")
+    a.br("ge", 1, 5, "msum_cmp")                  # i >= len -> compare
+    a.block()                                     # 3: body
+    a.ldb(2, 1)
+    a.ldi(7, 33)
+    a.alu("mul", 4, 4, 7)
+    a.alu("add", 4, 4, 2)                         # acc = acc*33 + b
+    a.addi(1, 1, 1)
+    a.jmp("msum_loop")
+    a.label("msum_cmp")
+    a.block()                                     # 4: compare
+    a.br("ne", 3, 4, "exit")                      # stored != acc
+    a.block()                                     # 5: win
+    a.ldi(6, -1)
+    a.ldi(7, 1)
+    a.stm(6, 7)                                   # planted wild store
+    a.halt(0)
+    a.label("exit")
+    a.block()                                     # 6
+    a.halt(0)
+    return a.build(block_seed=0x3A61)
+
+
 # --------------------------------------------------------------------
 # Seeds and crash reproducers (tests + bench starting corpus)
 # --------------------------------------------------------------------
@@ -896,8 +964,25 @@ def rledec_vm_crash() -> bytes:
     return out
 
 
+def magicsum_vm_seed() -> bytes:
+    """Blind seed: zero stored field + two zero payload bytes (the
+    checksum of which is far from 0 thanks to the acc constant, so
+    the compare edge starts a full 32-bit distance away)."""
+    return bytes(6)
+
+
+def magicsum_vm_crash() -> bytes:
+    """stored == checksum(payload): acc = (0x51D5A3*33 + 0)*33 + 0,
+    written little-endian into b0..b3."""
+    acc = 0x51D5A3
+    for b in (0, 0):
+        acc = (acc * 33 + b) & 0xFFFFFFFF
+    return acc.to_bytes(4, "little") + bytes(2)
+
+
 VM_SEEDS = {
     "tlvstack_vm": (tlvstack_vm_seed, tlvstack_vm_crash),
+    "magicsum_vm": (magicsum_vm_seed, magicsum_vm_crash),
     "imgparse_vm": (imgparse_vm_seed, imgparse_vm_crash),
     "rledec_vm": (rledec_vm_seed, rledec_vm_crash),
     "fixedform_vm": (fixedform_vm_seed, fixedform_vm_crash),

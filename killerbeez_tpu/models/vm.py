@@ -667,12 +667,12 @@ def _branch_distance(sel: int, x, y):
                      jnp.maximum(mag, jnp.float32(1.0)))
 
 
-@partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
-                                   "specs"))
-def _run_batch_dist_impl(instrs, edge_table, inputs, lengths, mem_size,
-                         max_steps, n_edges, specs):
+def _dist_loop_core(instrs, edge_table, inputs, lengths, mem_size,
+                    max_steps, n_edges, specs, capture):
     """``_run_batch_impl`` plus per-lane min-distance accumulators
-    for K observed branches at once.
+    for K observed branches at once — the shared (un-jitted) core of
+    the host dispatch wrappers AND the device-resident descent scan
+    (``search/device_descent.py`` inlines it per scan iteration).
 
     ``specs`` is a static tuple of ``(branch_pc, from_idx, sel,
     x_idx, y_idx)`` tuples.  Each distance is sampled from the state
@@ -682,8 +682,20 @@ def _run_batch_dist_impl(instrs, edge_table, inputs, lengths, mem_size,
     the edge-table row key), so the observations never perturb the
     transition.  One dispatch therefore scores a whole guard
     CURRICULUM (the path conditions into a frontier block plus the
-    frontier branch itself) for every candidate."""
+    frontier branch itself) for every candidate.
+
+    ``capture`` (static) additionally records the CONCRETE compare
+    operand values at the min-distance sample of each spec —
+    int32[B, K] ``x``/``y`` register values, the raw material of
+    Redqueen-style input-to-state matching (copy what the program
+    actually compared against back into the input).  With capture
+    off the carried state is exactly the historical one, so the
+    ``capture_operands=False`` path stays bit-identical.
+
+    Returns ``(VMResult, best, cap_x, cap_y)`` (capture arrays are
+    zeros-shaped [B, K] when capture is off)."""
     b = inputs.shape[0]
+    k_n = len(specs)
     state0 = (jnp.zeros(b, jnp.int32),
               jnp.zeros((b, N_REGS), jnp.int32),
               jnp.zeros((b, mem_size), jnp.int32),
@@ -696,50 +708,94 @@ def _run_batch_dist_impl(instrs, edge_table, inputs, lengths, mem_size,
               jnp.zeros((b, 0), jnp.int32),
               jnp.int32(0),
               jnp.zeros(b, jnp.int32))
-    best0 = jnp.full((b, len(specs)), DIST_UNREACHED, jnp.float32)
+    best0 = jnp.full((b, k_n), DIST_UNREACHED, jnp.float32)
+    k_cap = k_n if capture else 0      # capture off: nothing carried
+    caps0 = (jnp.zeros((b, k_cap), jnp.int32),
+             jnp.zeros((b, k_cap), jnp.int32))
     bufs_t = inputs.T
     lengths = lengths.astype(jnp.int32)
 
     def cond(carry):
-        s, _ = carry
+        s = carry[0]
         return jnp.any(s[4] == FUZZ_RUNNING) & (s[10] < max_steps)
 
     def body(carry):
-        s, best = carry
+        s, best, (cap_x, cap_y) = carry
         running = s[4] == FUZZ_RUNNING
         cols = []
+        xcols, ycols = [], []
         for k, (branch_pc, from_idx, sel, x_idx, y_idx) \
                 in enumerate(specs):
             at = (s[0] == branch_pc) & (s[6] == from_idx + 1) & running
-            d = _branch_distance(sel, s[1][:, x_idx], s[1][:, y_idx])
+            x, y = s[1][:, x_idx], s[1][:, y_idx]
+            d = _branch_distance(sel, x, y)
             cols.append(jnp.where(at, jnp.minimum(best[:, k], d),
                                   best[:, k]))
+            if capture:
+                upd = at & (d < best[:, k])
+                xcols.append(jnp.where(upd, x, cap_x[:, k]))
+                ycols.append(jnp.where(upd, y, cap_y[:, k]))
         best = jnp.stack(cols, axis=1)
+        caps = ((jnp.stack(xcols, axis=1), jnp.stack(ycols, axis=1))
+                if capture else (cap_x, cap_y))
         return (_step_batched(instrs, edge_table, bufs_t, lengths,
-                              mem_size, False, s), best)
+                              mem_size, False, s), best, caps)
 
-    final, best = jax.lax.while_loop(cond, body, (state0, best0))
-    return VMResult(status=final[4], exit_code=final[5],
-                    counts=final[7], steps=final[11],
-                    path_hash=final[8], edge_ids=None), best
+    final, best, caps = jax.lax.while_loop(cond, body,
+                                           (state0, best0, caps0))
+    return (VMResult(status=final[4], exit_code=final[5],
+                     counts=final[7], steps=final[11],
+                     path_hash=final[8], edge_ids=None),
+            best, caps[0], caps[1])
+
+
+@partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
+                                   "specs"))
+def _run_batch_dist_impl(instrs, edge_table, inputs, lengths, mem_size,
+                         max_steps, n_edges, specs):
+    res, best, _, _ = _dist_loop_core(
+        instrs, edge_table, inputs, lengths, mem_size, max_steps,
+        n_edges, specs, False)
+    return res, best
+
+
+@partial(jax.jit, static_argnames=("mem_size", "max_steps", "n_edges",
+                                   "specs"))
+def _run_batch_dist_ops_impl(instrs, edge_table, inputs, lengths,
+                             mem_size, max_steps, n_edges, specs):
+    return _dist_loop_core(
+        instrs, edge_table, inputs, lengths, mem_size, max_steps,
+        n_edges, specs, True)
 
 
 def run_batch_distances(program: Program, inputs: jax.Array,
-                        lengths: jax.Array,
-                        specs) -> Tuple[VMResult, jax.Array]:
+                        lengths: jax.Array, specs,
+                        capture_operands: bool = False):
     """Execute a candidate batch and return, per lane, the minimum
     branch distance observed at each of the K ``specs`` (tuples of
     ``(branch_pc, from_idx, sel, x_idx, y_idx)``) — float32[B, K],
     ``DIST_UNREACHED`` where never sampled.  The VMResult is
     bit-identical to ``run_batch(..., record_stream=False)``.
-    ``search/objective.py`` derives specs from target edges."""
+    ``search/objective.py`` derives specs from target edges.
+
+    ``capture_operands=True`` returns ``(res, dists, cap_x, cap_y)``
+    where the int32[B, K] capture arrays hold the concrete compare
+    operand values observed at each spec's min-distance sample (0
+    where never sampled — check ``dists`` for reachability).  The
+    input-to-state matcher copies these observed values back into
+    candidate bytes at the operands' dynamic byte-dependency
+    positions (Redqueen's move, bought from the engine's own state
+    instead of a shadow tracer)."""
     specs = tuple(tuple(int(v) for v in s) for s in specs)
     if not specs:
         raise ValueError("at least one branch spec is required")
-    return _run_batch_dist_impl(
-        jnp.asarray(program.instrs), jnp.asarray(program.edge_table),
-        inputs, lengths, program.mem_size, program.max_steps,
-        program.n_edges, specs)
+    args = (jnp.asarray(program.instrs),
+            jnp.asarray(program.edge_table),
+            inputs, lengths, program.mem_size, program.max_steps,
+            program.n_edges, specs)
+    if capture_operands:
+        return _run_batch_dist_ops_impl(*args)
+    return _run_batch_dist_impl(*args)
 
 
 def run_batch_distance(program: Program, inputs: jax.Array,

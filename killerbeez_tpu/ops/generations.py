@@ -234,6 +234,36 @@ def np_select_slot(filled: np.ndarray, gen_id: int, salt: int) -> int:
     return int(np.argmax(np.cumsum(filled) > k))
 
 
+def _cached_slot_mask(learn_params, seed_buf, seed_len, sel,
+                      mask_cache, mask_valid):
+    """Per-slot learned-mask cache lookup, shared by BOTH generation
+    scans (the single-chip scan here and the shard_map'd mesh scan in
+    ``parallel/distributed.py``): a slot's quantized mask is a pure
+    function of the weights (fixed for a dispatch) and the slot
+    bytes, so re-selecting an unchanged slot skips saliency inference
+    entirely (``lax.cond``); cached or fresh, the mask bytes are
+    identical, so the candidate stream — and the v0 parity pins —
+    are untouched.  Returns ``(mask, mask_cache', mask_valid')``;
+    admission invalidation is ``_invalidate_admitted_masks``."""
+    from ..learn.model import masked_saliency
+    mask = jax.lax.cond(
+        mask_valid[sel] > 0,
+        lambda: mask_cache[sel],
+        lambda: masked_saliency(learn_params, seed_buf, seed_len))
+    return (mask, mask_cache.at[sel].set(mask),
+            mask_valid.at[sel].set(1))
+
+
+def _invalidate_admitted_masks(mask_valid, ledger, n_slots):
+    """Ring admission overwrote slots 1..S-1 rows: their cached
+    masks are stale the moment new bytes land.  ``ledger`` is the
+    ``_ring_append_and_admit`` ledger (row 0 = validity, row 1 =
+    slot * validity; slot 0 is never admitted into, so nonzero means
+    a real admission)."""
+    inv = jnp.where(ledger[0] > 0, ledger[1], n_slots)
+    return mask_valid.at[inv].set(0, mode="drop")
+
+
 def _ring_append_and_admit(flags, aflags, packed, its, bufs, lens,
                            gen_id, sel, ring, fr, adm_cap, reseed):
     """One generation's findings-ring append + FIFO seed-slot
@@ -383,7 +413,7 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
     def one_generation(carry, j):
         (vb, vc, vh, vs, ring_bufs, ring_lens, ring_filled,
          ring_hits, ring_finds, ring_ptr, fr_pack, fr_gen, fr_iter,
-         fr_len, fr_bufs, fr_ptr) = carry
+         fr_len, fr_bufs, fr_ptr, mask_cache, mask_valid) = carry
         gen_id = gen0 + j
         if reseed:
             sel = _select_slot(ring_filled, gen_id, salt)
@@ -411,10 +441,12 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
                 # in-scan inference: saliency of THIS generation's
                 # seed slot -> dense mask -> masked havoc.  The
                 # branch is static, so campaigns without --learn
-                # compile the exact historical program.
-                from ..learn.model import masked_saliency
-                mask = masked_saliency(learn_params, seed_buf,
-                                       seed_len)
+                # compile the exact historical program; the mask is
+                # cached per ring slot in the carry (_cached_slot_mask,
+                # admission invalidates below).
+                mask, mask_cache, mask_valid = _cached_slot_mask(
+                    learn_params, seed_buf, seed_len, sel,
+                    mask_cache, mask_valid)
                 bufs, lens = jax.vmap(
                     lambda k: havoc_mask_at(
                         seed_buf, seed_len, k, mask,
@@ -458,12 +490,22 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
              ring_finds, ring_ptr),
             (fr_pack, fr_gen, fr_iter, fr_len, fr_bufs, fr_ptr),
             A, reseed)
+        if learn and reseed:
+            mask_valid = _invalidate_admitted_masks(
+                mask_valid, ledger, ring_bufs.shape[0])
 
         carry = (vb, vc, vh, vs, ring_bufs, ring_lens, ring_filled,
                  ring_hits, ring_finds, ring_ptr, fr_pack, fr_gen,
-                 fr_iter, fr_len, fr_bufs, fr_ptr)
+                 fr_iter, fr_len, fr_bufs, fr_ptr, mask_cache,
+                 mask_valid)
         return carry, (sel, araw) + ledger
 
+    S = ring_bufs.shape[0]
+    # per-slot learned-mask cache (all-invalid at dispatch start:
+    # the weights retrain between dispatches); 1-byte dummies keep
+    # the carry cheap when learning is off
+    mc_shape = (S, L) if learn else (1, 1)
+    mv_shape = (S,) if learn else (1,)
     carry0 = (vb, vc, vh, vs, ring_bufs, ring_lens, ring_filled,
               ring_hits, ring_finds, ring_ptr,
               jnp.zeros((F,), jnp.uint8),        # fr_pack
@@ -471,13 +513,15 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
               jnp.zeros((F,), jnp.uint32),       # fr_iter
               jnp.zeros((F,), jnp.int32),        # fr_len
               jnp.zeros((F, L), jnp.uint8),      # fr_bufs
-              jnp.int32(0))                      # fr_ptr
+              jnp.int32(0),                      # fr_ptr
+              jnp.zeros(mc_shape, jnp.uint8),    # mask_cache
+              jnp.zeros(mv_shape, jnp.int32))    # mask_valid
     carry, ys = jax.lax.scan(
         one_generation, carry0,
         jnp.arange(g, dtype=jnp.uint32))
     (vb, vc, vh, vs, ring_bufs, ring_lens, ring_filled, ring_hits,
      ring_finds, ring_ptr, fr_pack, fr_gen, fr_iter, fr_len,
-     fr_bufs, fr_ptr) = carry
+     fr_bufs, fr_ptr, _mc, _mv) = carry
     (sel, adm_raw, adm_valid, adm_slot, adm_iter, adm_len,
      adm_bufs) = ys
     return ((vb, vc, vh, vs),

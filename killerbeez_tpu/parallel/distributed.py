@@ -50,7 +50,8 @@ except ImportError:                     # jax 0.4.x: experimental home,
 from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_NONE, FUZZ_RUNNING, MAP_SIZE
 from ..instrumentation.base import pack_verdicts
 from ..ops.generations import (
-    DEFAULT_ADM_CAP, DEFAULT_FINDINGS_CAP, _ring_append_and_admit,
+    DEFAULT_ADM_CAP, DEFAULT_FINDINGS_CAP, _cached_slot_mask,
+    _invalidate_admitted_masks, _ring_append_and_admit,
     _select_slot, carry_donation_argnums,
 )
 from ..models.vm import Program, _run_batch_impl
@@ -737,7 +738,7 @@ def make_sharded_generations(program: Program, mesh: Mesh,
             def one_generation(carry, j):
                 (vb, vc, vh, vs, rbufs, rlens, rfilled, rhits,
                  rfinds, rptr, fr_pack, fr_gen, fr_iter, fr_len,
-                 fr_bufs, fr_ptr) = carry
+                 fr_bufs, fr_ptr, mask_cache, mask_valid) = carry
                 gen_id = gen0 + j
                 if reseed:
                     sel = _select_slot(rfilled, gen_id, salt_d)
@@ -755,9 +756,14 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                 if learn:
                     # in-scan inference on this shard's selected
                     # ring slot (replicated weights, per-shard seed
-                    # — shards shape their own streams)
-                    from ..learn.model import masked_saliency
-                    mask = masked_saliency(lp, seed_buf, seed_len)
+                    # — shards shape their own streams), with the
+                    # shared per-slot mask cache from the scan carry
+                    # (_cached_slot_mask; admission invalidates
+                    # below)
+                    mask, mask_cache, mask_valid = \
+                        _cached_slot_mask(lp, seed_buf, seed_len,
+                                          sel, mask_cache,
+                                          mask_valid)
                 else:
                     mask = None
                 res, bufs, lens = kern.mutate_exec(keys, seed_buf,
@@ -789,10 +795,14 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                     (fr_pack, fr_gen, fr_iter, fr_len, fr_bufs,
                      fr_ptr),
                     A_eff, reseed)
+                if learn and reseed:
+                    mask_valid = _invalidate_admitted_masks(
+                        mask_valid, ledger, rbufs.shape[0])
 
                 carry = (vb, vc, vh, vs, rbufs, rlens, rfilled,
                          rhits, rfinds, rptr, fr_pack, fr_gen,
-                         fr_iter, fr_len, fr_bufs, fr_ptr)
+                         fr_iter, fr_len, fr_bufs, fr_ptr,
+                         mask_cache, mask_valid)
                 return carry, (sel, araw) + ledger
 
             def chunk(carry, c):
@@ -811,6 +821,9 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                     vs = _gather_and_fold(vs, "dp")
                 return (vb, vc, vh, vs) + tuple(rest), ys
 
+            S = rbufs.shape[0]
+            mc_shape = (S, L) if learn else (1, 1)
+            mv_shape = (S,) if learn else (1,)
             carry0 = (vb, vc, vh, vs, rbufs, rlens, rfilled, rhits,
                       rfinds, rptr,
                       jnp.zeros((F,), jnp.uint8),       # fr_pack
@@ -818,12 +831,14 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                       jnp.zeros((F,), jnp.uint32),      # fr_iter
                       jnp.zeros((F,), jnp.int32),       # fr_len
                       jnp.zeros((F, L), jnp.uint8),     # fr_bufs
-                      jnp.int32(0))                     # fr_ptr
+                      jnp.int32(0),                     # fr_ptr
+                      jnp.zeros(mc_shape, jnp.uint8),   # mask_cache
+                      jnp.zeros(mv_shape, jnp.int32))   # mask_valid
             carry, ys = jax.lax.scan(
                 chunk, carry0, jnp.arange(n_chunks, dtype=jnp.uint32))
             (vb, vc, vh, vs, rbufs, rlens, rfilled, rhits, rfinds,
              rptr, fr_pack, fr_gen, fr_iter, fr_len, fr_bufs,
-             fr_ptr) = carry
+             fr_ptr, _mc, _mv) = carry
             # [n_chunks, fold_every, ...] -> [g, ...] ledger rows
             ys = jax.tree_util.tree_map(
                 lambda a: a.reshape((g,) + a.shape[2:]), ys)

@@ -18,6 +18,11 @@ The third coverage tier, picking up where the exact layers stop:
                 the verified-witness honesty contract
   soft.py       float32-relaxed soft-KBVM: true ``jax.grad`` through
                 arithmetic-only path slices, proposals only
+  device_descent.py  the in-scan engine: R rank -> probe -> mutate ->
+                re-score iterations fused into one device dispatch
+                with donated carry state, plus Redqueen-style
+                input-to-state operand matching off the captured
+                compare operands
 
 Consumers: the crack stage's escalation path (``fuzzer/crack.py``,
 ``--descend``), the ``kb-descend`` tool, and ``bench.py --descend``.
@@ -27,12 +32,16 @@ from .descent import (
     DEFAULT_DESCENT_BUDGET, DEFAULT_LANES, DescentResult, descend_edge,
     seeds_reaching_block,
 )
+from .device_descent import (
+    DEFAULT_SCAN_ITERS, DeviceDescent, descend_edge_device,
+)
 from .objective import BranchObjective, edge_objectives
 from .soft import SoftSlice, soft_refine, trace_slice
 
 __all__ = [
     "DEFAULT_DESCENT_BUDGET", "DEFAULT_LANES", "DescentResult",
     "descend_edge", "seeds_reaching_block",
+    "DEFAULT_SCAN_ITERS", "DeviceDescent", "descend_edge_device",
     "BranchObjective", "edge_objectives",
     "SoftSlice", "soft_refine", "trace_slice",
 ]

@@ -100,12 +100,23 @@ class DescentResult:
     edge: Tuple[int, int]
     status: str
     input: Optional[bytes] = None
-    steps: int = 0              # device dispatches spent
+    steps: int = 0              # search iterations spent
     evals: int = 0              # candidate executions scored
     best_dist: float = float(DIST_UNREACHED)
     objective: str = ""
     reason: str = ""
     soft_used: bool = False
+    #: which engine produced the result: "host" (this module — one
+    #: device dispatch per iteration) or "device" (device_descent.py
+    #: — R iterations fused per dispatch)
+    engine: str = "host"
+    #: device dispatches actually issued (== iterations for the host
+    #: engine; iterations / scan_iters for the in-scan engine) — the
+    #: bench wall-clock gate's machine-readable denominator
+    dispatches: int = 0
+    iterations: int = 0
+    #: True when the witness came from an input-to-state lane
+    i2s: bool = False
 
     def as_dict(self) -> Dict:
         d = {"edge": list(self.edge), "status": self.status,
@@ -113,7 +124,9 @@ class DescentResult:
              "best_dist": (None if self.best_dist >= DIST_UNREACHED
                            else float(self.best_dist)),
              "objective": self.objective, "reason": self.reason,
-             "soft_used": self.soft_used}
+             "soft_used": self.soft_used, "engine": self.engine,
+             "dispatches": self.dispatches,
+             "iterations": self.iterations, "i2s": self.i2s}
         if self.input is not None:
             d["input_hex"] = self.input.hex()
             d["length"] = len(self.input)
@@ -648,7 +661,8 @@ def descend_edge(program, edge: Tuple[int, int],
                     input=buf, steps=steps, evals=evals,
                     best_dist=0.0,
                     objective=obj.desc if obj else "",
-                    soft_used=soft_used)
+                    soft_used=soft_used, engine="host",
+                    dispatches=steps, iterations=steps)
         improved = pop.rank(cands, _staged_keys(dists[:len(cands)]))
         if specs_objs and own:
             primary = float(dists[:len(cands), -1].min())
@@ -668,7 +682,8 @@ def descend_edge(program, edge: Tuple[int, int],
         edge=(f_idx, t_idx), status="exhausted", steps=steps,
         evals=evals, best_dist=best_primary, objective=best_desc,
         reason=f"step budget exhausted ({budget} dispatches)",
-        soft_used=soft_used)
+        soft_used=soft_used, engine="host", dispatches=steps,
+        iterations=steps)
 
 
 def seeds_reaching_block(program, seeds: Sequence[bytes],

@@ -1,0 +1,908 @@
+"""Device-resident descent — R full rank -> probe -> mutate ->
+re-score iterations per host dispatch.
+
+PR 7's host engine (``descent.py``) round-trips every candidate
+population through the host on every iteration: the host ranks the
+returned distances, regenerates the probe batch in Python, and
+dispatches again — the exact pipeline bubble the generation scans
+(PRs 9-10) eliminated for the fuzzing loop.  This module closes the
+descent loop ON the device: one jitted ``lax.scan`` runs
+
+    rank elites -> emit probe/i2s/ES candidates -> execute with
+    curriculum distances + operand capture -> re-rank -> append
+    witnesses
+
+R times per dispatch, with donated carry state (elite population,
+per-center probe rotation cursors, captured compare operands, a
+bounded best-witness ring) so the buffers update in place on the
+accelerator and the host only drains one witness report per R
+iterations.
+
+Probe families mirror the host engine's — single-coordinate
++/-{1,2,4,16,64} probes, compensated pair probes, dictionary-token
+insertion sweeps, ES mutants — but are keyed by DETERMINISTIC
+per-lane rotation counters (pure uint32 mixing, no host RNG), so the
+stepped mode (``scan_iters=1``: one device iteration per dispatch,
+the host driving the loop) and the in-scan mode (``scan_iters=R``)
+generate bit-identical candidate streams: the host-vs-device parity
+pin compares elite ranked order and emitted witnesses between the
+two at matched schedules (tests/test_device_descent.py).
+
+NEW vs the host engine: **input-to-state operand matching**
+(Redqueen, with Angora's distance framework underneath).  The
+distance engine already observes the concrete compare operands at
+every curriculum branch; ``vm.run_batch_distances(...,
+capture_operands=True)`` returns them, and a dedicated lane block
+copies the OBSERVED operand value back into the candidate at the
+branch's dynamic byte-dependency positions — both endianness orders
+plus +/-1 variants.  A 32-bit magic/checksum compare that coordinate
+probes would walk byte-by-byte cracks in one generation: iteration j
+samples the operands, iteration j+1 writes them into the input.
+
+Honesty contract unchanged: a witness ring row is only ever REPORTED
+after the pure-Python reference interpreter confirms the edge
+traversal on the host.  The engine stands down to the host engine
+when an edge has no deciding branches (unconditional edges descend
+on block coverage alone, which the host engine handles).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis.solver import concrete_run
+from ..models.vm import (
+    DIST_UNREACHED, _dist_loop_core, _mix32,
+)
+from ..ops.generations import carry_donation_argnums
+from ..utils.logging import DEBUG_MSG
+from .descent import (
+    _PROBE_DELTAS, MAX_GUARDS, N_ELITE, DescentResult, _concrete_trace,
+    _edge_index, _pack, _path_guards, descend_edge,
+)
+from .objective import BranchObjective, edge_objectives
+from .soft import slice_operand_deps, soft_refine, trace_slice
+
+#: iterations fused into one device dispatch (the R of the module
+#: docstring); the stepped parity mode passes 1
+DEFAULT_SCAN_ITERS = 8
+
+#: best-witness ring capacity per dispatch (append-bounded like the
+#: generations findings ring: the FIRST W edge-traversing lanes are
+#: kept in (iteration, lane) order, the pointer counts overflow)
+WITNESS_RING = 32
+
+#: byte-dependency positions considered per guard for the i2s writes
+#: (32-bit operands: four bytes)
+I2S_DEPS = 4
+
+#: i2s lanes per guard: 2 operand sides x {0, +1, -1} x {LE, BE}
+I2S_PER_GUARD = 12
+
+#: lane-family tags recorded in the witness ring (telemetry: a
+#: witness with family FAM_I2S is an input-to-state crack)
+FAM_ELITE, FAM_I2S, FAM_ONE, FAM_TWO, FAM_INS, FAM_ES = range(6)
+
+_FNV_PRIME = 0x01000193
+_UNREACHED_F32 = np.float32(DIST_UNREACHED)
+
+
+def _layout(lanes: int, k: int) -> Dict[str, int]:
+    """Static lane-block layout: [elites][i2s][one][two][ins][es].
+    Pure function of the static config so the stepped and in-scan
+    modes agree by construction."""
+    n_el = N_ELITE
+    n_i2s = I2S_PER_GUARD * k
+    rest = max(lanes - n_el - n_i2s, 8)
+    n_ins = rest // 6             # window-dup variants exist even
+    probe = rest - n_ins          # with an empty dictionary
+    n_one = (probe * 2 // 5) & ~1          # even: split over 2 roles
+    n_two = (probe * 2 // 5) & ~1
+    n_es = rest - n_ins - n_one - n_two
+    return {"el": n_el, "i2s": n_i2s, "one": n_one, "two": n_two,
+            "ins": n_ins, "es": n_es,
+            "total": n_el + n_i2s + n_one + n_two + n_ins + n_es}
+
+
+def _family_tags(lay: Dict[str, int]) -> np.ndarray:
+    fams = []
+    for name, tag in (("el", FAM_ELITE), ("i2s", FAM_I2S),
+                      ("one", FAM_ONE), ("two", FAM_TWO),
+                      ("ins", FAM_INS), ("es", FAM_ES)):
+        fams.extend([tag] * lay[name])
+    return np.asarray(fams, dtype=np.int32)
+
+
+def _onehot_write(rows, pos, val):
+    """rows[r, pos[r]] = val[r] without scatter: one-hot select over
+    the (static) L axis."""
+    L = rows.shape[1]
+    m = jnp.arange(L, dtype=jnp.int32)[None, :] == pos[:, None]
+    return jnp.where(m, val[:, None], rows)
+
+
+def _clip_pos(raw, clen):
+    """Map a rotation position into the live prefix: in-range raw
+    positions pass through, out-of-range ones wrap (deterministic in
+    both modes; double-weighting early bytes of short centers is
+    acceptable)."""
+    return jnp.where(raw < clen, raw, raw % jnp.maximum(clen, 1))
+
+
+def _gen_i2s(e_bufs, e_lens, cap_x, cap_y, cap_valid, dep_pos, n_dep,
+             k: int, L: int):
+    """Input-to-state lane block: for every guard, copy each observed
+    compare operand (+/-1 variants) into the best elite at the
+    guard's byte-dependency positions, little- and big-endian byte
+    orders.  Guards never sampled (cap_valid 0) degenerate to plain
+    copies of the base."""
+    n = I2S_PER_GUARD * k
+    r = np.arange(n)
+    k_r = jnp.asarray(r // I2S_PER_GUARD, jnp.int32)
+    sub = r % I2S_PER_GUARD
+    side = jnp.asarray(sub // 6, jnp.int32)           # 0 = x, 1 = y
+    delta = jnp.asarray(np.array([0, 1, -1])[(sub % 6) // 2],
+                        jnp.int32)
+    order = jnp.asarray(sub % 2, jnp.int32)           # 0 LE, 1 BE
+
+    base = e_bufs[0].astype(jnp.int32)
+    blen = e_lens[0]
+    vals = jnp.where(side == 0, cap_x[k_r], cap_y[k_r]) + delta
+    valid = cap_valid[k_r] > 0
+    w = jnp.clip(n_dep[k_r], 0, I2S_DEPS)
+    rows = jnp.broadcast_to(base[None, :], (n, L))
+    md = jnp.full((n,), -1, jnp.int32)
+    for j in range(I2S_DEPS):
+        p = dep_pos[k_r, j]
+        active = (j < w) & (p >= 0) & valid
+        byte_sel = jnp.where(order == 0, j, w - 1 - j)
+        byte = (vals >> (8 * byte_sel)) & 0xFF
+        rows = jnp.where(
+            (jnp.arange(L, dtype=jnp.int32)[None, :] == p[:, None])
+            & active[:, None], byte[:, None], rows)
+        md = jnp.maximum(md, jnp.where(active, p, -1))
+    lens = jnp.where(valid, jnp.maximum(blen, md + 1), blen)
+    return rows, lens
+
+
+def _gen_one(base0, len0, base1, len1, cur0, cur1, pos_order,
+             n_one: int, L: int):
+    """Single-coordinate finite-difference probes around the two
+    centers, rotating through (position, signed delta) combos."""
+    if not n_one:
+        return (jnp.zeros((0, L), jnp.int32),
+                jnp.zeros((0,), jnp.int32))
+    half = n_one // 2
+    local = np.arange(n_one)
+    role = jnp.asarray((local >= half).astype(np.int32))
+    off = jnp.asarray(np.where(local < half, local, local - half)
+                      .astype(np.int32))
+    c = jnp.where(role == 0, cur0, cur1) + off
+    clen = jnp.where(role == 0, len0, len1)
+    # signed delta fastest, position next — one full sweep of the
+    # LIVE prefix costs ~10 * clen combos, so short centers cycle
+    # every iteration or two instead of dragging the whole L axis
+    raw = pos_order[(c // 10) % jnp.maximum(clen, 1)]
+    pos = _clip_pos(raw, clen)
+    deltas = jnp.asarray(_PROBE_DELTAS, jnp.int32)
+    d = deltas[(c % 10) // 2] * (1 - 2 * (c % 2))
+    cb = jnp.where(role[:, None] == 0, base0[None, :].astype(jnp.int32),
+                   base1[None, :].astype(jnp.int32))
+    at = jnp.sum(jnp.where(
+        jnp.arange(L, dtype=jnp.int32)[None, :] == pos[:, None],
+        cb, 0), axis=1)
+    return _onehot_write(cb, pos, (at + d) & 0xFF), clen
+
+
+def _gen_two(base0, len0, base1, len1, cur0, cur1, pos_order,
+             n_two: int, L: int):
+    """Compensated pair probes: +d on byte i, a compensating
+    {+d,-d,+2d,-2d} on byte j — moves an operand THROUGH sum-style
+    integrity checks instead of dying at them."""
+    if not n_two:
+        return (jnp.zeros((0, L), jnp.int32),
+                jnp.zeros((0,), jnp.int32))
+    half = n_two // 2
+    local = np.arange(n_two)
+    role = jnp.asarray((local >= half).astype(np.int32))
+    off = jnp.asarray(np.where(local < half, local, local - half)
+                      .astype(np.int32))
+    c = jnp.where(role == 0, cur0, cur1) + off
+    clen = jnp.where(role == 0, len0, len1)
+    # compensator sign fastest, then the compensating position j,
+    # then the operand position i (over the 8 hottest focus
+    # positions), then the magnitude — the host engine's combo order,
+    # so a (dep byte, +d) x (every j, -2d) sweep completes within the
+    # first iterations where it matters (moved counters re-based on a
+    # neighbour byte)
+    smul = jnp.asarray([1, -1, 2, -2], jnp.int32)[c % 4]
+    jm = jnp.maximum(clen - 1, 1)
+    j_off = 1 + (c // 4) % jm
+    i_pos = _clip_pos(pos_order[(c // (4 * jm)) % 8], clen)
+    d = jnp.asarray([1, 4, 16, 64], jnp.int32)[
+        (c // (32 * jm)) % 4]
+    j_pos = (i_pos + j_off) % jnp.maximum(clen, 1)
+    cb = jnp.where(role[:, None] == 0, base0[None, :].astype(jnp.int32),
+                   base1[None, :].astype(jnp.int32))
+    lidx = jnp.arange(L, dtype=jnp.int32)[None, :]
+    ai = jnp.sum(jnp.where(lidx == i_pos[:, None], cb, 0), axis=1)
+    rows = _onehot_write(cb, i_pos, (ai + d) & 0xFF)
+    aj = jnp.sum(jnp.where(lidx == j_pos[:, None], rows, 0), axis=1)
+    return _onehot_write(rows, j_pos, (aj + d * smul) & 0xFF), clen
+
+
+def _gen_ins(base, blen, cur, tok_bufs, tok_lens, n_ins: int,
+             n_tokens: int, L: int):
+    """Structural insertion sweep around the best elite, tail
+    shifted: dictionary-token splices, token + argument-byte splices
+    (command-stream records are opcode + operand) and duplicated
+    windows of {1, 2, 4} preceding bytes (re-inserting a well-formed
+    record that is already there), every variant x position rotated
+    across iterations.  Depth-counter guards need whole records ADDED
+    before the branch — no fixed-position byte move can."""
+    if not n_ins:
+        return (jnp.zeros((0, L), jnp.int32),
+                jnp.zeros((0,), jnp.int32))
+    T = max(n_tokens, 0)
+    n_var = 2 * T + 3             # raw token, token+arg, dup {1,2,4}
+    c = cur + jnp.asarray(np.arange(n_ins, dtype=np.int32))
+    # variant-MINOR: consecutive combos cycle the variant list so
+    # every token/dup width gets tried each iteration even under
+    # small per-iteration quotas; the position advances once per full
+    # variant cycle (live buffers are much shorter than L — a
+    # position-major order would starve late-dictionary tokens)
+    p = (c // n_var) % jnp.maximum(blen + 1, 1)
+    v = c % n_var
+    is_dup = v >= 2 * T
+    is_arg = (v >= T) & ~is_dup
+    t = jnp.where(is_dup, 0, v % jnp.maximum(T, 1))
+    w = jnp.asarray([1, 2, 4], jnp.int32)[
+        jnp.clip(v - 2 * T, 0, 2)]
+    base_tl = tok_lens[t] if T else jnp.zeros_like(c)
+    tl = jnp.where(is_dup, jnp.minimum(w, jnp.maximum(blen, 1)),
+                   base_tl + is_arg.astype(jnp.int32))
+    new_len = jnp.minimum(blen + tl, L)
+    q = jnp.arange(L, dtype=jnp.int32)[None, :]
+    rel = q - p[:, None]
+    TL = tok_bufs.shape[1]
+    tok_rows = jnp.take(tok_bufs, t, axis=0).astype(jnp.int32)
+    tok_byte = jnp.sum(jnp.where(
+        rel[:, :, None] == jnp.arange(TL, dtype=jnp.int32)[None, None, :],
+        tok_rows[:, None, :], 0), axis=2)
+    # the argument byte trailing a token splice rotates with the
+    # cursor so every opcode sweeps many operand values over time
+    arg = (((c.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)) >> 13)
+           & 0xFF).astype(jnp.int32)
+    tok_byte = jnp.where(is_arg[:, None] & (rel == base_tl[:, None]),
+                         arg[:, None], tok_byte)
+    # duplicated-window bytes read the ORIGINAL buffer just before p
+    dup_src = jnp.clip(p[:, None] - tl[:, None] + rel, 0, L - 1)
+    dup_byte = jnp.take(base.astype(jnp.int32), dup_src)
+    ins_byte = jnp.where(is_dup[:, None], dup_byte, tok_byte)
+    in_ins = (rel >= 0) & (rel < tl[:, None])
+    src = jnp.clip(q - tl[:, None], 0, L - 1)
+    shifted = jnp.take(base.astype(jnp.int32), src)
+    rows = jnp.where(q < p[:, None], base[None, :].astype(jnp.int32),
+                     jnp.where(in_ins, ins_byte,
+                               jnp.where(q < new_len[:, None],
+                                         shifted, 0)))
+    return rows, new_len
+
+
+def _gen_es(e_bufs, e_lens, it, salt, lane0: int, n_es: int, L: int):
+    """ES mutants: rank-picked parent, three byte edits (value or
+    signed delta) + occasional zero-extension, all derived from
+    ``_mix32`` counter mixing — deterministic, host-replayable, and
+    fresh every iteration (the scan's restart-free diversity
+    source)."""
+    if not n_es:
+        return (jnp.zeros((0, L), jnp.int32),
+                jnp.zeros((0,), jnp.int32))
+    lane = jnp.asarray(np.arange(lane0, lane0 + n_es,
+                                 dtype=np.uint32))
+    seed = _mix32(_mix32(it.astype(jnp.uint32)
+                         * jnp.uint32(0x9E3779B9) ^ salt)
+                  ^ lane * jnp.uint32(0x85EBCA6B))
+    # rank-weighted parent pick (min of two uniforms ~ the host
+    # engine's geometric bias toward the front)
+    rank = jnp.minimum(seed % jnp.uint32(N_ELITE),
+                       (seed >> 16) % jnp.uint32(N_ELITE)) \
+        .astype(jnp.int32)
+    rows = jnp.take(e_bufs, rank, axis=0).astype(jnp.int32)
+    plen = jnp.take(e_lens, rank)
+    deltas = jnp.asarray(_PROBE_DELTAS, jnp.int32)
+    for e in range(3):
+        h = _mix32(seed + jnp.uint32((0x6B8B4567 * (e + 1))
+                                     & 0xFFFFFFFF))
+        pos = (h % jnp.maximum(plen, 1).astype(jnp.uint32)) \
+            .astype(jnp.int32)
+        use_val = ((h >> 8) & 1).astype(jnp.int32)
+        val = ((h >> 16) & 0xFF).astype(jnp.int32)
+        didx = ((h >> 9) % 5).astype(jnp.int32)
+        sgn = 1 - 2 * ((h >> 12) & 1).astype(jnp.int32)
+        cur = jnp.take_along_axis(rows, pos[:, None], axis=1)[:, 0]
+        nb = jnp.where(use_val == 1, val,
+                       (cur + deltas[didx] * sgn) & 0xFF)
+        rows = _onehot_write(rows, pos, nb)
+    grow = ((seed >> 3) % 4) == 0
+    glen = (1 + ((seed >> 5) % 8)).astype(jnp.int32)
+    lens = jnp.where(grow, jnp.minimum(plen + glen, L), plen)
+    return rows, lens
+
+
+def _descent_scan_impl(instrs, edge_table, pos_order, dep_pos, n_dep,
+                       tok_bufs, tok_lens,
+                       e_bufs, e_lens, e_stage, e_dist, cursors,
+                       cap_x, cap_y, cap_valid,
+                       wit_bufs, wit_lens, wit_src, wit_iter, wit_ptr,
+                       best_primary, it0, salt,
+                       mem_size=0, max_steps=0, n_edges=0,
+                       specs=(), e_idx=0, lanes=0, scan_iters=1,
+                       n_tokens=0, i2s=True):
+    """R descent iterations in ONE device program; see module
+    docstring for the carry/report contract."""
+    K = len(specs)
+    L = int(e_bufs.shape[1])
+    lay = _layout(lanes, K)
+    B = lay["total"]
+    fam = jnp.asarray(_family_tags(lay))
+    pows = np.empty(L, dtype=np.uint32)
+    acc = 1
+    for i in range(L):
+        pows[i] = acc
+        acc = (acc * _FNV_PRIME) & 0xFFFFFFFF
+    pows = jnp.asarray(pows)
+    wcap = min(WITNESS_RING, B)
+    k_last = K - 1
+
+    def one_iteration(carry, it):
+        # early-stop: once any witness is in the ring, the remaining
+        # scan iterations idle (the host drains, verifies and stops
+        # dispatching) — a mid-scan crack must not burn the rest of
+        # the dispatch's budget executing deep candidates.  Idled
+        # iterations report -1 (vs >= 0 hit counts) so the host's
+        # eval accounting stays truthful.
+        found = carry[12] > 0
+        return jax.lax.cond(found,
+                            lambda c: (c, jnp.int32(-1)),
+                            lambda c: _one_iteration_work(c, it),
+                            carry)
+
+    def _one_iteration_work(carry, it):
+        (e_bufs, e_lens, e_stage, e_dist, cursors, cap_x, cap_y,
+         cap_valid, wit_bufs, wit_lens, wit_src, wit_iter, wit_ptr,
+         best_primary) = carry
+        # -- probe centers: the best elite, plus ONE of (in priority
+        # order) the leading distinct-LENGTH elite (the structurally
+        # different tie — e.g. the zero-extended sibling whose extra
+        # positions a moved counter/checksum must land on), the first
+        # next-stage-back elite (the repair lane), or elite 1
+        idxs = jnp.arange(N_ELITE, dtype=jnp.int32)
+        dl = jnp.min(jnp.where(e_lens != e_lens[0], idxs, N_ELITE))
+        bk = jnp.min(jnp.where(e_stage > e_stage[0], idxs, N_ELITE))
+        c1 = jnp.where(dl < N_ELITE, dl,
+                       jnp.where(bk < N_ELITE, bk, 1))
+        base0, len0 = e_bufs[0], e_lens[0]
+        base1 = e_bufs[c1]
+        len1 = e_lens[c1]
+
+        # -- generate the candidate batch block by block
+        blocks = [(e_bufs.astype(jnp.int32), e_lens)]
+        if i2s:
+            blocks.append(_gen_i2s(e_bufs, e_lens, cap_x, cap_y,
+                                   cap_valid, dep_pos, n_dep, K, L))
+        else:
+            # i2s disabled (the ablation lane): plain base copies so
+            # the layout — and every other family's cursor stream —
+            # stays identical at equal budget
+            blocks.append((jnp.broadcast_to(
+                e_bufs[0].astype(jnp.int32)[None, :],
+                (lay["i2s"], L)),
+                jnp.broadcast_to(e_lens[0], (lay["i2s"],))))
+        blocks.append(_gen_one(base0, len0, base1, len1, cursors[0],
+                               cursors[1], pos_order, lay["one"], L))
+        blocks.append(_gen_two(base0, len0, base1, len1, cursors[2],
+                               cursors[3], pos_order, lay["two"], L))
+        blocks.append(_gen_ins(base0, len0, cursors[4], tok_bufs,
+                               tok_lens, lay["ins"],
+                               max(n_tokens, 1), L))
+        blocks.append(_gen_es(e_bufs, e_lens, it, salt,
+                              lay["el"] + lay["i2s"] + lay["one"]
+                              + lay["two"] + lay["ins"],
+                              lay["es"], L))
+        cand = jnp.concatenate([b for b, _ in blocks], axis=0)
+        lens = jnp.concatenate([ln for _, ln in blocks], axis=0)
+        lens = jnp.clip(lens, 0, L).astype(jnp.int32)
+        # the zeros-past-length invariant (hashing + extension moves
+        # rely on it)
+        cand = jnp.where(jnp.arange(L, dtype=jnp.int32)[None, :]
+                         < lens[:, None], cand, 0)
+        cand = (cand & 0xFF).astype(jnp.uint8)
+
+        # -- execute: curriculum distances + operand capture
+        res, dists, cx, cy = _dist_loop_core(
+            instrs, edge_table, cand, lens, mem_size, max_steps,
+            n_edges, specs, True)
+
+        # -- capture update: min-distance lane per guard
+        m = jnp.argmin(dists, axis=0)
+        dmin = jnp.min(dists, axis=0)
+        sampled = dmin < _UNREACHED_F32
+        ksel = jnp.arange(K, dtype=jnp.int32)
+        cap_x = jnp.where(sampled, cx[m, ksel], cap_x)
+        cap_y = jnp.where(sampled, cy[m, ksel], cap_y)
+        cap_valid = jnp.where(sampled, 1, cap_valid)
+
+        # -- witness ring: lanes that traversed the edge, (iteration,
+        # lane) order, pointer counts overflow
+        hits = res.counts[:, e_idx] > 0
+        raw = jnp.sum(hits).astype(jnp.int32)
+        (hidx,) = jnp.nonzero(hits, size=wcap, fill_value=0)
+        wpos = wit_ptr + jnp.arange(wcap, dtype=jnp.int32)
+        valid = (jnp.arange(wcap) < jnp.minimum(raw, wcap)) \
+            & (wpos < WITNESS_RING)
+        tgt = jnp.where(valid, wpos, WITNESS_RING)
+        wit_bufs = wit_bufs.at[tgt].set(cand[hidx], mode="drop")
+        wit_lens = wit_lens.at[tgt].set(lens[hidx], mode="drop")
+        wit_src = wit_src.at[tgt].set(fam[hidx], mode="drop")
+        wit_iter = wit_iter.at[tgt].set(it.astype(jnp.int32),
+                                        mode="drop")
+        wit_ptr = wit_ptr + raw
+
+        # -- device-side curriculum ranking: staged key per lane
+        sampled_m = dists < _UNREACHED_F32
+        any_s = jnp.any(sampled_m, axis=1)
+        deep = (K - 1) - jnp.argmax(sampled_m[:, ::-1], axis=1)
+        stage = jnp.where(any_s, (K - 1) - deep,
+                          K).astype(jnp.int32)
+        dist_at = jnp.sum(jnp.where(
+            ksel[None, :] == deep[:, None], dists, 0.0), axis=1)
+        dist_l = jnp.where(any_s, dist_at, _UNREACHED_F32)
+
+        # content hash (order-aware, length-mixed) for the dedup cut
+        h = jnp.sum(cand.astype(jnp.uint32) * pows[None, :], axis=1,
+                    dtype=jnp.uint32)
+        h = _mix32(h ^ lens.astype(jnp.uint32))
+        srt = jnp.lexsort((jnp.arange(B, dtype=jnp.int32), dist_l,
+                           stage))
+        hs = h[srt]
+        earlier = jnp.arange(B, dtype=jnp.int32)[:, None] \
+            > jnp.arange(B, dtype=jnp.int32)[None, :]
+        dup = jnp.any((hs[:, None] == hs[None, :]) & earlier, axis=1)
+        sel_rank = jnp.cumsum((~dup).astype(jnp.int32)) - 1
+        svals = jnp.arange(N_ELITE, dtype=jnp.int32)[:, None]
+        match = (sel_rank[None, :] == svals) & (~dup)[None, :]
+        found = jnp.any(match, axis=1)
+        fpos = jnp.argmax(match, axis=1)
+        pos = jnp.where(found, fpos,
+                        jnp.arange(N_ELITE, dtype=jnp.int32))
+        # stratified tail: the last two slots go to the best lanes of
+        # a LATER curriculum stage than the front's, when one exists
+        # — the deceptive-fitness repair reservation (a lane that
+        # re-broke an early guard while fixing a later operand is
+        # often one probe from the front, and a pure global cut
+        # evicts it)
+        stage_s = stage[srt]
+        back_m = (stage_s > stage_s[0]) & ~dup
+        brank = jnp.cumsum(back_m.astype(jnp.int32)) - 1
+        for slot, want in ((N_ELITE - 2, 0), (N_ELITE - 1, 1)):
+            bm = back_m & (brank == want)
+            bfound = jnp.any(bm)
+            bpos = jnp.argmax(bm)
+            pos = pos.at[slot].set(
+                jnp.where(bfound, bpos, pos[slot]))
+        # ... and one for the best distinct-LENGTH lane, so the
+        # structural sibling (zero-extension, insertion survivor) the
+        # second probe center wants never falls off the front while
+        # it still ranks mid-pack
+        lens_s = lens[srt]
+        dlm = (lens_s != lens_s[0]) & ~dup
+        dfound = jnp.any(dlm)
+        dpos = jnp.argmax(dlm)
+        pos = pos.at[N_ELITE - 3].set(
+            jnp.where(dfound, dpos, pos[N_ELITE - 3]))
+        sel = srt[pos]
+        e_bufs = jnp.take(cand, sel, axis=0)
+        e_lens = jnp.take(lens, sel)
+        e_stage = jnp.take(stage, sel)
+        e_dist = jnp.take(dist_l, sel)
+
+        best_primary = jnp.minimum(best_primary,
+                                   jnp.min(dists[:, k_last]))
+        cursors = cursors + jnp.asarray(
+            [lay["one"] // 2, lay["one"] - lay["one"] // 2,
+             lay["two"] // 2, lay["two"] - lay["two"] // 2,
+             lay["ins"]], jnp.int32)
+        carry = (e_bufs, e_lens, e_stage, e_dist, cursors, cap_x,
+                 cap_y, cap_valid, wit_bufs, wit_lens, wit_src,
+                 wit_iter, wit_ptr, best_primary)
+        return carry, raw
+
+    carry0 = (e_bufs, e_lens, e_stage, e_dist, cursors, cap_x, cap_y,
+              cap_valid, wit_bufs, wit_lens, wit_src, wit_iter,
+              wit_ptr, best_primary)
+    carry, raws = jax.lax.scan(
+        one_iteration, carry0,
+        it0 + jnp.arange(scan_iters, dtype=jnp.int32))
+    return carry + (raws,)
+
+
+#: positional args of _descent_scan_impl that are pure carry state —
+#: elite front (7-10), cursors (11), operand captures (12-14), the
+#: witness ring (15-19) and the best-primary fold (20).  The host
+#: materializes each dispatch's outputs BEFORE re-feeding them, so
+#: everything is donation-safe; CPU backends get no donation (same
+#: policy as the generation scans).
+_CARRY_ARGNUMS = tuple(range(7, 21))
+
+_DESCENT_JIT = None
+
+
+def _descent_scan(*args, **kwargs):
+    global _DESCENT_JIT
+    if _DESCENT_JIT is None:
+        _DESCENT_JIT = jax.jit(
+            _descent_scan_impl,
+            static_argnames=("mem_size", "max_steps", "n_edges",
+                             "specs", "e_idx", "lanes", "scan_iters",
+                             "n_tokens", "i2s"),
+            donate_argnums=carry_donation_argnums(
+                jax.default_backend(), _CARRY_ARGNUMS))
+    return _DESCENT_JIT(*args, **kwargs)
+
+
+class DeviceDescent:
+    """One edge's device-resident descent: owns the carry state and
+    the per-dispatch drive.  ``descend_edge_device`` is the
+    engine-shaped wrapper; the parity tests drive this class directly
+    (stepped vs in-scan at matched schedules)."""
+
+    def __init__(self, program, edge: Tuple[int, int],
+                 seeds: Sequence[bytes], *,
+                 mask: Optional[Sequence[int]] = None,
+                 lanes: int = 256,
+                 scan_iters: int = DEFAULT_SCAN_ITERS,
+                 max_len: int = 64, i2s: bool = True,
+                 trace_cache: Optional[Dict] = None):
+        self.program = program
+        self.edge = (int(edge[0]), int(edge[1]))
+        self.e_idx = _edge_index(program, self.edge)
+        if self.e_idx is None:
+            raise ValueError("edge not in the static universe")
+        seeds = [bytes(s) for s in seeds if s] or [b"\x00"]
+        own = edge_objectives(program, self.edge)
+        guards = _path_guards(program, self.edge, seeds,
+                              cap=max(MAX_GUARDS - len(own), 0),
+                              trace_cache=trace_cache)
+        self.specs_objs: List[BranchObjective] = \
+            (guards + own)[-MAX_GUARDS:]
+        if not self.specs_objs:
+            raise ValueError("unconditional edge (no deciding "
+                             "branches) — host engine handles it")
+        self.scan_iters = max(int(scan_iters), 1)
+        self.i2s = bool(i2s)
+        K = len(self.specs_objs)
+        max_len = max(int(max_len), max(len(s) for s in seeds))
+        self.L = max(8, ((max_len + 7) // 8) * 8)
+        lay = _layout(max(int(lanes), N_ELITE + I2S_PER_GUARD * K
+                          + 48), K)
+        self.lanes = lay["total"]
+
+        # per-guard dynamic byte deps (Angora's taint, read off one
+        # concrete slice): the i2s write positions + probe priority
+        self._mask = [p for p in (mask or []) if 0 <= p < self.L]
+        deps_by_guard: List[List[int]] = []
+        for obj in self.specs_objs:
+            d: List[int] = []
+            for s in seeds[:8]:
+                sl = trace_slice(program, s, obj)
+                if sl.reached:
+                    d = slice_operand_deps(program, sl, obj)
+                    break
+            deps_by_guard.append([p for p in d if 0 <= p < self.L])
+        self._set_focus(deps_by_guard)
+
+        try:
+            from ..analysis.dataflow import extract_dictionary
+            toks = [bytes(t) for t in extract_dictionary(program) if t]
+        except Exception:
+            toks = []
+        toks = [t for t in toks if len(t) <= 6][:12]
+        self.n_tokens = len(toks)
+        tl = max((len(t) for t in toks), default=1)
+        tok_bufs = np.zeros((max(self.n_tokens, 1), tl), np.uint8)
+        tok_lens = np.zeros((max(self.n_tokens, 1),), np.int32)
+        for i, t in enumerate(toks):
+            tok_bufs[i, :len(t)] = np.frombuffer(t, np.uint8)
+            tok_lens[i] = len(t)
+        self.tok_bufs, self.tok_lens = tok_bufs, tok_lens
+
+        # zero-extended seed variants ride along (length guards need
+        # longer inputs than any corpus entry — same move as the host
+        # engine's population init)
+        pool: List[bytes] = []
+        for s in seeds:
+            pool.append(s[:self.L])
+            for ext in (4, 8, 16):
+                if len(s) + ext <= self.L:
+                    pool.append(s + b"\x00" * ext)
+        e_bufs, e_lens = _pack(pool, N_ELITE, self.L)
+        self.carry = (
+            jnp.asarray(e_bufs), jnp.asarray(e_lens),
+            jnp.full((N_ELITE,), K, jnp.int32),
+            jnp.full((N_ELITE,), DIST_UNREACHED, jnp.float32),
+            jnp.zeros((5,), jnp.int32),
+            jnp.zeros((K,), jnp.int32), jnp.zeros((K,), jnp.int32),
+            jnp.zeros((K,), jnp.int32),
+            jnp.zeros((WITNESS_RING, self.L), jnp.uint8),
+            jnp.zeros((WITNESS_RING,), jnp.int32),
+            jnp.zeros((WITNESS_RING,), jnp.int32),
+            jnp.zeros((WITNESS_RING,), jnp.int32),
+            jnp.int32(0),
+            jnp.float32(DIST_UNREACHED))
+        self.it = 0
+        self.salt = jnp.uint32(((self.edge[0] & 0xFFFF) << 16)
+                               ^ (self.edge[1] & 0xFFFF) ^ 0x6465)
+        self._wit_seen = 0
+        self.last_worked = 0
+
+    def _set_focus(self, deps_by_guard: List[List[int]]) -> None:
+        K = len(self.specs_objs)
+        dep_pos = np.full((K, I2S_DEPS), -1, dtype=np.int32)
+        n_dep = np.zeros((K,), dtype=np.int32)
+        for k, d in enumerate(deps_by_guard):
+            d = d[:I2S_DEPS]
+            dep_pos[k, :len(d)] = d
+            n_dep[k] = len(d)
+        prio: List[int] = []
+        for d in deps_by_guard:
+            for p in d:
+                if p not in prio:
+                    prio.append(p)
+        for p in self._mask:
+            if p not in prio:
+                prio.append(p)
+        rest = [p for p in range(self.L) if p not in prio]
+        self.pos_order = np.asarray(prio + rest, dtype=np.int32)
+        self.dep_pos, self.n_dep = dep_pos, n_dep
+        self._deps_by_guard = deps_by_guard
+
+    def refresh_focus(self) -> None:
+        """Between dispatches: re-derive every guard's dynamic byte
+        deps from the CURRENT best elite's concrete slice (the host
+        engine recomputes its probe focus per iteration; here the
+        cadence is per dispatch — R iterations).  Guards the new best
+        does not reach keep their previous deps, so curriculum
+        progress only ever ADDS focus.  The parity pin drives
+        ``dispatch()`` directly without refreshing — focus arrays are
+        part of the matched schedule."""
+        bufs, lens, _stage, _dist = self.elite_front()
+        best = bufs[0, :int(lens[0])].tobytes()
+        deps: List[List[int]] = []
+        for k, obj in enumerate(self.specs_objs):
+            sl = trace_slice(self.program, best, obj)
+            d = slice_operand_deps(self.program, sl, obj) \
+                if sl.reached else []
+            d = [p for p in d if 0 <= p < self.L]
+            deps.append(d or self._deps_by_guard[k])
+        self._set_focus(deps)
+
+    @property
+    def specs(self) -> tuple:
+        return tuple(o.spec() for o in self.specs_objs)
+
+    def inject_candidates(self, rows: Sequence[bytes]) -> int:
+        """Host-proposed candidates (the soft-KBVM ``jax.grad``
+        steps, chained witnesses, ...) overwrite the tail of the
+        elite front between dispatches: they ride in the next batch's
+        elite lanes and are scored/ranked on device like any other
+        lane — proposals only, never witnesses (the honesty contract
+        is enforced at the ring drain)."""
+        rows = [bytes(r)[:self.L] for r in rows if r][:N_ELITE // 4]
+        if not rows:
+            return 0
+        bufs, lens, stage, dist = \
+            (np.asarray(a).copy() for a in self.carry[:4])
+        K = len(self.specs_objs)
+        for i, r in enumerate(rows):
+            slot = N_ELITE - 1 - i
+            row = np.zeros((self.L,), np.uint8)
+            row[:len(r)] = np.frombuffer(r, np.uint8)
+            bufs[slot] = row
+            lens[slot] = len(r)
+            stage[slot] = K
+            dist[slot] = np.float32(DIST_UNREACHED)
+        self.carry = (jnp.asarray(bufs), jnp.asarray(lens),
+                      jnp.asarray(stage), jnp.asarray(dist)) \
+            + self.carry[4:]
+        return len(rows)
+
+    def soft_propose(self) -> int:
+        """Host-side soft-KBVM refinement at per-dispatch cadence:
+        when the current best elite's path slice to its frontier
+        guard is arithmetic-only, one ``jax.grad`` of the relaxed
+        distance proposes multi-byte steps that are injected into the
+        elite tail (the host engine runs the same tier every 4th
+        iteration; here it rides the dispatch boundary the engine
+        already returns to the host on)."""
+        bufs, lens, stage, _dist = self.elite_front()
+        best = bufs[0, :int(lens[0])].tobytes()
+        K = len(self.specs_objs)
+        k_idx = min(max(K - 1 - int(stage[0]), 0), K - 1)
+        obj = self.specs_objs[k_idx]
+        sl = trace_slice(self.program, best, obj)
+        if not sl.eligible:
+            return 0
+        return self.inject_candidates(
+            soft_refine(self.program, best, obj, slice_=sl))
+
+    def dispatch(self, iters: Optional[int] = None
+                 ) -> List[Tuple[bytes, int, int]]:
+        """Run ``iters`` (default ``scan_iters``) descent iterations
+        on device; returns the NEW witness ring rows as ``(buf,
+        family, iteration)`` tuples in (iteration, lane) order
+        (already deduped against rows seen in earlier dispatches of
+        this descent).  ``iters`` exists for the TAIL dispatch of a
+        budget that ``scan_iters`` does not divide — the engine never
+        runs more iterations than asked (the equal-effort contract of
+        every host-vs-device comparison); a non-default value
+        compiles its own scan length once.  ``last_worked`` holds how
+        many of the dispatch's iterations actually searched (the
+        early-stop idles the rest once a witness lands)."""
+        prog = self.program
+        si = int(iters) if iters else self.scan_iters
+        out = _descent_scan(
+            jnp.asarray(prog.instrs), jnp.asarray(prog.edge_table),
+            jnp.asarray(self.pos_order), jnp.asarray(self.dep_pos),
+            jnp.asarray(self.n_dep), jnp.asarray(self.tok_bufs),
+            jnp.asarray(self.tok_lens),
+            *self.carry,
+            jnp.int32(self.it), self.salt,
+            mem_size=prog.mem_size, max_steps=prog.max_steps,
+            n_edges=prog.n_edges, specs=self.specs, e_idx=self.e_idx,
+            lanes=self.lanes, scan_iters=si,
+            n_tokens=self.n_tokens, i2s=self.i2s)
+        self.carry = out[:14]
+        self.it += si
+        self.last_worked = int(np.sum(np.asarray(out[14]) >= 0))
+        wit_bufs = np.asarray(out[8])
+        wit_lens = np.asarray(out[9])
+        wit_src = np.asarray(out[10])
+        wit_iter = np.asarray(out[11])
+        ptr = int(np.asarray(out[12]))
+        rows = []
+        for r in range(self._wit_seen, min(ptr, WITNESS_RING)):
+            rows.append((wit_bufs[r, :int(wit_lens[r])].tobytes(),
+                         int(wit_src[r]), int(wit_iter[r])))
+        self._wit_seen = min(ptr, WITNESS_RING)
+        return rows
+
+    def reset_witnesses(self) -> None:
+        """Clear the witness ring and its host cursor.  The driver
+        calls this when EVERY drained row failed reference
+        verification (a device/reference divergence the honesty
+        contract exists to catch): a nonzero ring pointer would
+        otherwise idle every remaining iteration via the early-stop,
+        silently burning the budget with zero search."""
+        c = list(self.carry)
+        for i in (8, 9, 10, 11):
+            c[i] = jnp.zeros_like(c[i])
+        c[12] = jnp.int32(0)
+        self.carry = tuple(c)
+        self._wit_seen = 0
+
+    # -- inspection (parity pin / reports) ---------------------------
+
+    def elite_front(self):
+        """(bufs, lens, stage, dist) as numpy — the ranked order the
+        parity pin compares between stepped and in-scan schedules."""
+        return tuple(np.asarray(a) for a in self.carry[:4])
+
+    @property
+    def best_primary(self) -> float:
+        return float(np.asarray(self.carry[13]))
+
+    @property
+    def witnesses_total(self) -> int:
+        """Total edge-traversing lanes observed (overflow included)."""
+        return int(np.asarray(self.carry[12]))
+
+
+def descend_edge_device(program, edge: Tuple[int, int],
+                        seeds: Sequence[bytes], *,
+                        mask: Optional[Sequence[int]] = None,
+                        lanes: int = 256,
+                        budget: int = 48,
+                        scan_iters: int = DEFAULT_SCAN_ITERS,
+                        max_len: int = 64,
+                        i2s: bool = True,
+                        trace=None,
+                        trace_cache: Optional[Dict] = None,
+                        registry=None) -> DescentResult:
+    """Device-resident twin of ``descent.descend_edge``: descend
+    ``edge``'s branch-distance curriculum with R iterations fused per
+    dispatch until a verified witness traverses it or ``budget``
+    ITERATIONS are spent (budget is iteration-denominated so host/
+    device comparisons run at equal search effort; dispatches =
+    ceil(budget / scan_iters)).  Stands down to the host engine on
+    unconditional edges.  Every witness is re-verified by the
+    reference interpreter on the host before it is reported —
+    identical honesty contract."""
+    f_idx, t_idx = int(edge[0]), int(edge[1])
+    try:
+        eng = DeviceDescent(program, edge, seeds, mask=mask,
+                            lanes=lanes, scan_iters=scan_iters,
+                            max_len=max_len, i2s=i2s,
+                            trace_cache=trace_cache)
+    except ValueError as e:
+        DEBUG_MSG("device descent stand-down on %d:%d (%s) — host "
+                  "engine takes it", f_idx, t_idx, e)
+        res = descend_edge(program, edge, seeds, mask=mask,
+                           lanes=lanes, budget=budget,
+                           max_len=max_len, trace=trace,
+                           trace_cache=trace_cache)
+        res.engine = "host"
+        res.iterations = res.steps
+        res.dispatches = res.steps
+        return res
+    if registry is not None:
+        registry.gauge("descent_iterations_per_dispatch",
+                       eng.scan_iters)
+    dispatches = 0
+    evals = 0
+    soft_used = False
+    remaining = max(int(budget), 1)
+    first = True
+    while remaining > 0:
+        if not first:
+            eng.refresh_focus()
+            soft_used = bool(eng.soft_propose()) or soft_used
+        first = False
+        si = min(eng.scan_iters, remaining)
+        span = (trace.span("descend_scan", lane="descent",
+                           args={"edge": f"{f_idx}:{t_idx}",
+                                 "iter0": eng.it,
+                                 "scan_iters": si,
+                                 "lanes": eng.lanes,
+                                 "guards": len(eng.specs_objs)})
+                if trace is not None else contextlib.nullcontext())
+        with span:
+            rows = eng.dispatch(si)
+        dispatches += 1
+        remaining -= si
+        evals += eng.last_worked * eng.lanes
+        for buf, fam_tag, it in rows:
+            # honesty contract: the reference interpreter must agree
+            # before the witness is reported
+            if (f_idx, t_idx) in _concrete_trace(program, buf,
+                                                 trace_cache).edges:
+                if registry is not None and fam_tag == FAM_I2S:
+                    registry.count("search_i2s_matches")
+                return DescentResult(
+                    edge=(f_idx, t_idx), status="descended",
+                    input=buf, steps=it + 1, evals=evals,
+                    best_dist=0.0,
+                    objective=eng.specs_objs[-1].desc,
+                    soft_used=soft_used,
+                    engine="device", dispatches=dispatches,
+                    iterations=it + 1, i2s=(fam_tag == FAM_I2S))
+        if rows:
+            # every drained row failed verification: clear the ring
+            # or the early-stop idles the rest of the budget
+            DEBUG_MSG("descend %d:%d: %d witness rows failed "
+                      "reference verification — ring reset",
+                      f_idx, t_idx, len(rows))
+            eng.reset_witnesses()
+    return DescentResult(
+        edge=(f_idx, t_idx), status="exhausted",
+        steps=eng.it, evals=evals, best_dist=eng.best_primary,
+        objective=eng.specs_objs[-1].desc, soft_used=soft_used,
+        reason=f"iteration budget exhausted ({eng.it} iterations / "
+               f"{dispatches} dispatches)",
+        engine="device", dispatches=dispatches, iterations=eng.it)

@@ -26,8 +26,8 @@ import numpy as np
 
 from ..analysis.solver import solve_edge, unknown_kind
 from ..search import (
-    DEFAULT_DESCENT_BUDGET, DEFAULT_LANES, descend_edge,
-    seeds_reaching_block,
+    DEFAULT_DESCENT_BUDGET, DEFAULT_LANES, DEFAULT_SCAN_ITERS,
+    descend_edge, descend_edge_device, seeds_reaching_block,
 )
 from .solve_tool import _load_program, _parse_edge
 
@@ -38,26 +38,49 @@ DEFAULT_ROUNDS = 3
 
 def descend_report(program, edges: List[Tuple[int, int]],
                    seeds: List[bytes], *, budget: int, lanes: int,
-                   rounds: int, intake: dict) -> dict:
+                   rounds: int, intake: dict,
+                   engine: str = "device",
+                   scan_iters: int = DEFAULT_SCAN_ITERS) -> dict:
+    """Chained descent over ``edges``; the report carries per-round
+    device-dispatch and candidate-evaluation counts (the
+    machine-readable denominator the bench wall-clock gate divides
+    by) alongside the per-edge verdicts."""
     out = {"target": program.name, "edges": {}, "cracked": 0,
-           "exhausted": 0, "intake": intake}
+           "exhausted": 0, "intake": intake, "engine": engine,
+           "scan_iters": (scan_iters if engine == "device" else 1),
+           "rounds": [], "dispatches": 0, "evals": 0}
     pending = list(edges)
     results = {}
     traces: dict = {}       # one reference replay per seed, shared
-    for _ in range(max(rounds, 1)):
+    for rnd in range(max(rounds, 1)):
         nxt = []
+        r_disp = r_evals = r_cracked = 0
         for e in pending:
             se = seeds_reaching_block(program, seeds, e[0], cap=24,
                                       trace_cache=traces) \
                 or seeds[:16]
-            r = descend_edge(program, e, se or [b"\x00"],
-                             budget=budget, lanes=lanes,
-                             trace_cache=traces)
+            if engine == "device":
+                r = descend_edge_device(program, e, se or [b"\x00"],
+                                        budget=budget, lanes=lanes,
+                                        scan_iters=scan_iters,
+                                        trace_cache=traces)
+            else:
+                r = descend_edge(program, e, se or [b"\x00"],
+                                 budget=budget, lanes=lanes,
+                                 trace_cache=traces)
             results[e] = r
+            r_disp += int(r.dispatches)
+            r_evals += int(r.evals)
             if r.status == "descended":
                 seeds.append(r.input)
+                r_cracked += 1
             else:
                 nxt.append(e)
+        out["rounds"].append({"round": rnd, "attempted": len(pending),
+                              "cracked": r_cracked,
+                              "dispatches": r_disp, "evals": r_evals})
+        out["dispatches"] += r_disp
+        out["evals"] += r_evals
         if not nxt or len(nxt) == len(pending):
             break
         pending = nxt
@@ -91,6 +114,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--lanes", type=int, default=DEFAULT_LANES,
                    help="candidate lanes per dispatch "
                         f"(default {DEFAULT_LANES})")
+    p.add_argument("--engine", choices=("device", "host"),
+                   default="device",
+                   help="descent engine: 'device' (default) runs R "
+                        "iterations per dispatch in one lax.scan "
+                        "with input-to-state operand matching "
+                        "(stands down to host per edge when "
+                        "needed); 'host' forces the host-driven "
+                        "engine")
+    p.add_argument("--scan-iters", type=int,
+                   default=DEFAULT_SCAN_ITERS, metavar="R",
+                   help="device engine: iterations fused per "
+                        f"dispatch (default {DEFAULT_SCAN_ITERS})")
     p.add_argument("--rounds", type=int, default=DEFAULT_ROUNDS,
                    help="chained escalation passes (a cracked edge's "
                         "witness seeds the rest; default "
@@ -153,7 +188,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     rep = descend_report(program, edges, seeds, budget=args.budget,
                          lanes=args.lanes, rounds=args.rounds,
-                         intake=intake)
+                         intake=intake, engine=args.engine,
+                         scan_iters=args.scan_iters)
     ok = (args.require_cracked is None
           or rep["cracked"] >= args.require_cracked)
 
@@ -166,18 +202,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"{program.name}: {len(edges)} edge(s) beyond the "
               f"solver ceiling — {rep['cracked']} cracked, "
               f"{rep['exhausted']} exhausted "
-              f"(intake: {intake['solved']} solved / "
+              f"({rep['engine']} engine, {rep['dispatches']} "
+              f"dispatches / {rep['evals']} evals; "
+              f"intake: {intake['solved']} solved / "
               f"{intake['unknown']} unknown / {intake['unsat']} unsat)")
         for key, d in rep["edges"].items():
             if d["status"] == "descended":
                 buf = bytes.fromhex(d["input_hex"])
                 soft = " [soft-grad]" if d.get("soft_used") else ""
-                print(f"  {key}: cracked in {d['steps']} batches"
-                      f"{soft} len={d['length']} {buf!r}")
+                soft += " [i2s]" if d.get("i2s") else ""
+                print(f"  {key}: cracked in {d['steps']} iterations"
+                      f" ({d.get('dispatches', d['steps'])} "
+                      f"dispatches){soft} len={d['length']} {buf!r}")
             else:
                 bd = d.get("best_dist")
-                print(f"  {key}: exhausted ({d['steps']} batches, "
-                      f"best distance "
+                print(f"  {key}: exhausted ({d['steps']} iterations"
+                      f" / {d.get('dispatches', d['steps'])} "
+                      f"dispatches, best distance "
                       f"{'unreached' if bd is None else bd})")
         if args.require_cracked is not None and not ok:
             print(f"FAIL: {rep['cracked']} cracked < required "

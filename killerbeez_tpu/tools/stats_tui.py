@@ -140,6 +140,19 @@ def render(snap: Dict[str, Any]) -> str:
         if c.get("solver_injected"):
             line += f" | {_fmt_n(c.get('solver_injected', 0))} injected"
         lines.append(line)
+    if c.get("search_attempts") or c.get("search_i2s_matches") \
+            or g.get("descent_iterations_per_dispatch"):
+        line = (f"  descent  : "
+                f"{_fmt_n(c.get('search_descended', 0))} descended"
+                f" | {_fmt_n(c.get('search_exhausted', 0))} exhausted"
+                f" | {_fmt_n(c.get('search_attempts', 0))} attempts")
+        if g.get("descent_iterations_per_dispatch"):
+            line += (f" | {int(g.get('descent_iterations_per_dispatch', 0))} "
+                     "iters/dispatch (device-resident)")
+        if c.get("search_i2s_matches"):
+            line += (f" | {_fmt_n(c.get('search_i2s_matches', 0))} "
+                     "i2s matches")
+        lines.append(line)
     if g.get("generations_per_dispatch"):
         line = (f"  genloop  : "
                 f"{int(g.get('generations_per_dispatch', 0))} "

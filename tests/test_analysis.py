@@ -352,7 +352,14 @@ def test_dataflow_cgc_targets_terminate_with_facts(name):
     assert df.branches, name
     guarded = [f for f in df.branches
                if f.const is not None and f.deps]
-    assert guarded, name                # magic-byte chains at least
+    if name != "magicsum_vm":
+        # magic-byte chains at least — except magicsum_vm, the
+        # input-to-state micro-family, whose ONLY interesting compare
+        # is input-derived vs input-derived (stored field vs computed
+        # checksum) BY DESIGN: no byte-vs-constant guard exists for
+        # the dictionary/solver signal to read, which is exactly why
+        # that family needs operand matching instead
+        assert guarded, name
     assert df.reached_pcs               # fixpoint visited the program
 
 

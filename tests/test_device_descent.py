@@ -1,0 +1,378 @@
+"""Device-resident descent (killerbeez_tpu/search/device_descent.py).
+
+The acceptance contract of the in-scan engine:
+
+  * the operand-capturing distance variant returns the same VMResult
+    and distances as the historical path, plus the concrete compare
+    operands at the min-distance sample;
+  * the stepped mode (scan_iters=1, host drives every iteration) and
+    the in-scan mode (scan_iters=R, one dispatch) are BIT-EXACT at
+    matched schedules: same elite ranked order, same witness ring —
+    the host-vs-device descent parity pin;
+  * input-to-state operand matching cracks the planted 4-byte
+    magic-compare family (magicsum_vm) in <= 2 dispatches, while the
+    probe families alone exhaust at equal budget;
+  * every emitted witness is reference-interpreter verified;
+  * unconditional edges stand down to the host engine;
+  * descent dispatches land on the ``descent`` flight-recorder lane,
+    the crack-stage escalation records engine/dispatch metadata, and
+    the new telemetry folds through ``aggregate.merge``.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from killerbeez_tpu.analysis.solver import concrete_run, solve_edge
+from killerbeez_tpu.models import targets, targets_cgc  # noqa: F401
+from killerbeez_tpu.models.compiler import Assembler
+from killerbeez_tpu.models.vm import DIST_UNREACHED, run_batch_distances
+from killerbeez_tpu.mutators.base import pack_byte_rows
+from killerbeez_tpu.search import (
+    descend_edge_device, edge_objectives, seeds_reaching_block,
+)
+from killerbeez_tpu.search.device_descent import (
+    FAM_I2S, DeviceDescent,
+)
+
+
+def _never_prog():
+    """Impossible eq (a byte can never be 256): exhausts, exercising
+    every probe family for as many iterations as asked."""
+    a = Assembler("never")
+    a.block()                       # 0
+    a.ldi(2, 0)
+    a.ldb(1, 2)
+    a.ldi(2, 1)
+    a.alu("mul", 3, 1, 2)
+    a.ldi(2, 256)
+    a.br("eq", 3, 2, "win")
+    a.block()                       # 1
+    a.halt(0)
+    a.label("win")
+    a.block()                       # 2
+    a.halt(0)
+    return a.build()
+
+
+def _magicsum():
+    return targets.get_target("magicsum_vm")
+
+
+# --------------------------------------------------------------------
+# operand capture (vm.run_batch_distances extension)
+# --------------------------------------------------------------------
+
+def test_capture_matches_plain_distances():
+    """capture_operands=True returns the same VMResult + distances as
+    the historical path, plus the concrete operand values."""
+    prog = targets.get_target("imgparse_vm")
+    rows = [b"QIMGH\x03\x00\x00\x00\x00\x00", b"QIMG", b"\xff" * 16]
+    bufs, lens = pack_byte_rows(rows)
+    obj = edge_objectives(prog, (13, 14))[0]
+    res0, d0 = run_batch_distances(prog, bufs, lens, (obj.spec(),))
+    res1, d1, cx, cy = run_batch_distances(
+        prog, bufs, lens, (obj.spec(),), capture_operands=True)
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+    for f in ("status", "exit_code", "counts", "steps", "path_hash"):
+        np.testing.assert_array_equal(np.asarray(getattr(res0, f)),
+                                      np.asarray(getattr(res1, f)), f)
+    assert np.asarray(cx).shape == (len(rows), 1)
+
+
+def test_capture_values_are_the_compared_operands():
+    """On a byte-vs-constant compare, the captures are exactly the
+    loaded byte and the magic constant."""
+    a = Assembler("cap_toy")
+    a.block()
+    a.ldi(2, 0)
+    a.ldb(1, 2)
+    a.ldi(2, 42)
+    a.br("eq", 1, 2, "win")
+    a.block()
+    a.halt(0)
+    a.label("win")
+    a.block()
+    a.halt(0)
+    prog = a.build()
+    obj = edge_objectives(prog, (0, 2))[0]
+    bufs, lens = pack_byte_rows([bytes([7]), bytes([42]), b""])
+    _, d, cx, cy = run_batch_distances(prog, bufs, lens,
+                                       (obj.spec(),),
+                                       capture_operands=True)
+    # the empty lane's LDB reads 0 out-of-bounds: still sampled
+    assert np.asarray(cx).ravel().tolist() == [7, 42, 0]
+    assert np.asarray(cy).ravel().tolist() == [42, 42, 42]
+    assert np.asarray(d).ravel().tolist() == [35.0, 0.0, 42.0]
+
+
+# --------------------------------------------------------------------
+# THE parity pin: stepped (host-driven) vs in-scan at matched schedules
+# --------------------------------------------------------------------
+
+def _front_and_witnesses(eng, dispatches):
+    wits = []
+    for _ in range(dispatches):
+        wits.extend(eng.dispatch())
+    return eng.elite_front(), wits, eng.witnesses_total, \
+        eng.best_primary
+
+
+@pytest.mark.parametrize("prog_edge_seeds", [
+    ("never", (0, 2), [b"\x12\x34\x56"]),
+    ("magicsum", (4, 5), [b"\x00" * 6]),
+], ids=["toy-exhaust", "magicsum"])
+def test_stepped_vs_scanned_bit_exact(prog_edge_seeds):
+    """R host-driven single-iteration dispatches == one R-iteration
+    scan: same elite ranked order (bufs, lens, stages, distances),
+    same witness ring, same best primary distance.  This is the
+    host-vs-device descent parity pin: the probe schedule is fully
+    deterministic, so where the loop lives must not change WHAT it
+    does."""
+    name, edge, seeds = prog_edge_seeds
+    prog = _never_prog() if name == "never" else _magicsum()
+    R = 8
+    stepped = DeviceDescent(prog, edge, seeds, lanes=128,
+                            scan_iters=1)
+    f_step, w_step, t_step, bp_step = _front_and_witnesses(stepped, R)
+    scanned = DeviceDescent(prog, edge, seeds, lanes=128,
+                            scan_iters=R)
+    f_scan, w_scan, t_scan, bp_scan = _front_and_witnesses(scanned, 1)
+    for a, b, what in zip(f_step, f_scan,
+                          ("bufs", "lens", "stage", "dist")):
+        np.testing.assert_array_equal(a, b, f"elite {what}")
+    assert w_step == w_scan
+    assert t_step == t_scan
+    assert bp_step == bp_scan
+
+
+def test_parity_on_imgparse_frontier():
+    """The pin holds on a real CGC-family frontier edge (guard
+    curriculum depth > 1, dictionary tokens present)."""
+    prog = targets.get_target("imgparse_vm")
+    seed = solve_edge(prog, (11, 13)).input
+    R = 4
+    stepped = DeviceDescent(prog, (14, 15), [seed], lanes=128,
+                            scan_iters=1)
+    f_step, w_step, _, _ = _front_and_witnesses(stepped, R)
+    scanned = DeviceDescent(prog, (14, 15), [seed], lanes=128,
+                            scan_iters=R)
+    f_scan, w_scan, _, _ = _front_and_witnesses(scanned, 1)
+    for a, b, what in zip(f_step, f_scan,
+                          ("bufs", "lens", "stage", "dist")):
+        np.testing.assert_array_equal(a, b, f"elite {what}")
+    assert w_step == w_scan
+
+
+# --------------------------------------------------------------------
+# input-to-state operand matching
+# --------------------------------------------------------------------
+
+def test_i2s_cracks_planted_magic_compare_in_2_dispatches():
+    """magicsum_vm (4,5): a 32-bit stored-vs-checksum compare the
+    solver reports unknown.  Iteration 1 samples the operands,
+    iteration 2 writes the observed checksum into the stored field —
+    <= 2 dispatches at scan_iters=2, witness verified and tagged
+    i2s."""
+    prog = _magicsum()
+    assert solve_edge(prog, (4, 5)).status == "unknown"
+    res = descend_edge_device(prog, (4, 5), [bytes(6)], lanes=128,
+                              budget=4, scan_iters=2)
+    assert res.status == "descended"
+    assert res.dispatches <= 2
+    assert res.i2s
+    assert res.engine == "device"
+    assert (4, 5) in concrete_run(prog, res.input).edges
+
+
+def test_probe_families_alone_exhaust_at_equal_budget():
+    """The ablation behind the bench i2s gate: the same engine with
+    i2s lanes disabled cannot crack the 32-bit compare at the same
+    iteration budget (coordinate walks need ~30+ iterations to carry
+    the descent across four stored bytes)."""
+    prog = _magicsum()
+    res = descend_edge_device(prog, (4, 5), [bytes(6)], lanes=256,
+                              budget=16, scan_iters=8, i2s=False)
+    assert res.status == "exhausted"
+    on = descend_edge_device(prog, (4, 5), [bytes(6)], lanes=256,
+                             budget=16, scan_iters=8, i2s=True)
+    assert on.status == "descended" and on.i2s
+
+
+def test_witness_ring_families_tagged():
+    """The witness ring records the generating lane family — the
+    telemetry's i2s attribution reads it."""
+    prog = _magicsum()
+    eng = DeviceDescent(prog, (4, 5), [bytes(6)], lanes=128,
+                        scan_iters=4)
+    rows = eng.dispatch()
+    assert rows, "expected an i2s witness within 4 iterations"
+    assert any(fam == FAM_I2S for _, fam, _ in rows)
+
+
+# --------------------------------------------------------------------
+# contracts: honesty, stand-down, flight recorder
+# --------------------------------------------------------------------
+
+def test_device_descends_real_frontier_edges():
+    """The in-scan engine cracks the same checksum edge the host
+    engine owns (imgparse 13:14), faster in dispatch terms, and the
+    witness passes the reference interpreter."""
+    prog = targets.get_target("imgparse_vm")
+    seed = solve_edge(prog, (11, 13)).input
+    res = descend_edge_device(prog, (13, 14), [seed], lanes=256,
+                              budget=16, scan_iters=8)
+    assert res.status == "descended"
+    assert res.dispatches <= 2
+    assert (13, 14) in concrete_run(prog, res.input).edges
+
+
+def test_unconditional_edge_stands_down_to_host():
+    a = Assembler("uncond")
+    a.block()                       # 0
+    a.ldi(1, 7)
+    a.block()                       # 1 (unconditional successor)
+    a.halt(0)
+    prog = a.build()
+    res = descend_edge_device(prog, (0, 1), [b"\x00"], lanes=64,
+                              budget=2, scan_iters=2)
+    assert res.engine == "host"
+    assert res.status == "descended"    # covering the block covers it
+
+
+def test_device_spans_on_descent_lane():
+    from killerbeez_tpu.telemetry.trace import TraceRecorder
+    prog = _never_prog()
+    tr = TraceRecorder(max_events=4096)
+    descend_edge_device(prog, (0, 2), [b"\x00"], lanes=64, budget=4,
+                        scan_iters=2, trace=tr)
+    chrome = tr.to_chrome()
+    lane_tid = tr.lane_id("descent")
+    spans = [e for e in chrome["traceEvents"]
+             if e.get("name") == "descend_scan"
+             and e.get("tid") == lane_tid and e.get("ph") == "B"]
+    assert len(spans) == 2, "one span per device dispatch"
+    assert all(s["args"]["scan_iters"] == 2 for s in spans)
+
+
+def test_budget_is_iteration_denominated():
+    """budget=16 at scan_iters=8 is 2 dispatches; the exhausted
+    report carries both numbers (the bench denominator)."""
+    prog = _never_prog()
+    res = descend_edge_device(prog, (0, 2), [b"\x00"], lanes=64,
+                              budget=16, scan_iters=8)
+    assert res.status == "exhausted"
+    assert res.iterations == 16
+    assert res.dispatches == 2
+    # the engine may round the lane count up to fit the static lane
+    # blocks; evals stays iteration-denominated
+    assert res.evals % res.iterations == 0
+    assert res.evals // res.iterations >= 64
+
+
+def test_non_multiple_budget_runs_exactly_budget_iterations():
+    """The equal-effort contract: a budget scan_iters does not divide
+    ends with a shorter TAIL dispatch, never an overshoot — host and
+    device comparisons at any budget burn identical iteration
+    counts."""
+    prog = _never_prog()
+    res = descend_edge_device(prog, (0, 2), [b"\x00"], lanes=64,
+                              budget=12, scan_iters=8)
+    assert res.status == "exhausted"
+    assert res.iterations == 12
+    assert res.dispatches == 2          # 8 + a 4-iteration tail
+    assert res.evals // 12 >= 64 and res.evals % 12 == 0
+
+
+# --------------------------------------------------------------------
+# wiring: cracker escalation, kb-descend report, telemetry folds
+# --------------------------------------------------------------------
+
+def test_cracker_device_engine_end_to_end(tmp_path):
+    """A blind magicsum campaign with --descend on the device engine:
+    the plateau escalates, i2s cracks the compare, the witness
+    injects, the cache records the engine/dispatch metadata, and the
+    descent gauges/counters are live."""
+    from killerbeez_tpu.drivers.factory import driver_factory
+    from killerbeez_tpu.fuzzer.crack import BranchCracker
+    from killerbeez_tpu.fuzzer.loop import Fuzzer
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    from killerbeez_tpu.mutators.factory import mutator_factory
+    instr = instrumentation_factory(
+        "jit_harness", json.dumps({"target": "magicsum_vm",
+                                   "novelty": "throughput"}))
+    mut = mutator_factory("havoc", '{"seed": 11}', b"\x00" * 6)
+    drv = driver_factory("file", None, instr, mut)
+    fz = Fuzzer(drv, output_dir=str(tmp_path / "out"),
+                batch_size=64, write_findings=False)
+    fz.cracker = BranchCracker(instr.program, plateau_batches=2,
+                               descend=16, descend_lanes=128,
+                               descend_engine="device",
+                               descend_scan_iters=8)
+    fz.run(4096)
+    reg = fz.telemetry.registry
+    assert reg.counters.get("search_attempts", 0) >= 1
+    assert reg.counters.get("search_i2s_matches", 0) >= 1
+    assert reg.gauges.get("descent_iterations_per_dispatch") == 8
+    entry = fz.cracker.cache.get("4:5")
+    assert entry is not None and entry["status"] == "descended"
+    assert entry["search"]["engine"] == "device"
+    assert entry["search"]["i2s"] is True
+    assert entry["search"]["dispatches"] >= 1
+    # the injected witness lit the compare edge's slot
+    slot = fz.cracker.slot_of_edge[(4, 5)]
+    vb = np.asarray(instr.virgin_bits)
+    assert int(vb[slot]) != 0xFF
+
+
+def test_cracker_rejects_bad_engine():
+    from killerbeez_tpu.fuzzer.crack import BranchCracker
+    with pytest.raises(ValueError):
+        BranchCracker(_magicsum(), descend_engine="quantum")
+
+
+def test_kb_descend_json_round_counts(capsys):
+    """kb-descend --json carries per-round dispatch + evaluation
+    counts (the bench wall-clock gate's machine-readable
+    denominator)."""
+    from killerbeez_tpu.tools.descend_tool import main
+    rc = main(["magicsum_vm", "--lanes", "128", "--budget", "8",
+               "--json", "--edge", "4:5"])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["engine"] == "device"
+    assert rep["scan_iters"] >= 1
+    assert rep["rounds"] and all(
+        set(r) >= {"round", "attempted", "cracked", "dispatches",
+                   "evals"} for r in rep["rounds"])
+    assert rep["dispatches"] >= 1 and rep["evals"] >= 128
+    d = rep["edges"]["4:5"]
+    assert d["engine"] == "device" and "dispatches" in d
+
+
+def test_descent_telemetry_folds_through_merge():
+    """search_i2s_matches sums and descent_iterations_per_dispatch
+    maxes across worker snapshots — the fleet view stays truthful."""
+    from killerbeez_tpu.telemetry.aggregate import merge
+    a = {"counters": {"search_i2s_matches": 2, "execs": 10},
+         "gauges": {"descent_iterations_per_dispatch": 8}}
+    b = {"counters": {"search_i2s_matches": 3, "execs": 5},
+         "gauges": {"descent_iterations_per_dispatch": 16}}
+    m = merge([a, b])
+    assert m["counters"]["search_i2s_matches"] == 5
+    assert m["gauges"]["descent_iterations_per_dispatch"] == 16
+
+
+def test_magicsum_crash_reproducer_wins():
+    """The registered seed/crash pair holds its contract: the seed
+    exits clean, the reproducer traverses the compare edge into the
+    planted wild store."""
+    from killerbeez_tpu.models.targets_cgc import (
+        magicsum_vm_crash, magicsum_vm_seed,
+    )
+    prog = _magicsum()
+    assert (4, 5) not in concrete_run(prog, magicsum_vm_seed()).edges
+    assert (4, 5) in concrete_run(prog, magicsum_vm_crash()).edges

@@ -258,6 +258,68 @@ def test_generation_scan_learn_v0_parity_single_chip(reseed):
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
+def _trained_params():
+    """A model whose logits are NOT uniform (some positions masked
+    out): one SGD round on synthetic labels — the regime where the
+    per-slot mask cache carries real information."""
+    rng = np.random.default_rng(5)
+    params = model.init_params()
+    bufs = rng.integers(0, 256, (64, 16), dtype=np.uint8)
+    lens = np.full(64, 16, np.int32)
+    positions = rng.integers(0, 16, 64).astype(np.int32)
+    y = (positions < 4).astype(np.float32)     # early bytes "useful"
+    X = model.batch_features(bufs, lens, positions)
+    w = np.where(y > 0, 4.0, 1.0).astype(np.float32)
+    for _ in range(60):
+        params, _ = model.train_step(params, X, jnp.asarray(y),
+                                     jnp.asarray(w), jnp.float32(0.5))
+    return params
+
+
+@pytest.mark.parametrize("reseed", [False, True])
+def test_mask_cache_matches_fresh_inference(reseed):
+    """ISSUE 15 satellite: the per-slot mask cache in the generation
+    scan carry.  A TRAINED model run as ONE G=4 dispatch (cache hits
+    on re-selected slots, invalidated on admission) must produce the
+    same findings ring and virgin maps as four G=1 dispatches of the
+    same campaign (every dispatch starts cache-cold, so every
+    generation infers fresh) — cached mask == fresh mask, byte for
+    byte, or the candidate streams diverge."""
+    params = _trained_params()
+    # the trained model must actually mask something, or the cache
+    # parity is vacuously the v0 all-ones case
+    lg = model.saliency_logits(params, jnp.asarray(
+        np.frombuffer(SEED, np.uint8)), jnp.int32(len(SEED)))
+    m = np.asarray(model.quantize_mask(lg, jnp.int32(len(SEED))))
+    assert 0 < m[:len(SEED)].sum() < len(SEED), \
+        "trained mask neither all-ones nor all-zero"
+
+    def run(g_per_dispatch, dispatches):
+        instr = instrumentation_factory("jit_harness",
+                                        '{"target": "test"}')
+        instr.learn_params = params
+        mut = mutator_factory("havoc", '{"seed": 7}', SEED)
+        outs = []
+        for _ in range(dispatches):
+            its = mut.peek_iterations(64)
+            out = instr.run_batch_generations(
+                mut, its, g_per_dispatch, pad_to=64, reseed=reseed)
+            outs.append(out.materialize())
+            mut.advance(64 * g_per_dispatch)
+        return outs, instr
+
+    big, i_big = run(4, 1)
+    small, i_small = run(1, 4)
+    big_bufs = big[0].fr_bufs[:min(int(big[0].fr_ptr), big[0].cap)]
+    small_bufs = np.concatenate([
+        o.fr_bufs[:min(int(o.fr_ptr), o.cap)] for o in small])
+    assert len(big_bufs), "nothing found — the comparison is vacuous"
+    assert np.array_equal(big_bufs, small_bufs)
+    for a, b in ((i_big.virgin_bits, i_small.virgin_bits),
+                 (i_big.virgin_crash, i_small.virgin_crash)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
 @pytest.mark.parametrize("feedback", [0, 8])
 def test_generation_campaign_learn_v0_parity(tmp_path, feedback):
     """Full -G campaigns: a learn tier that never trains (version 0

@@ -1098,6 +1098,134 @@ def bench_stateful(targets=None, batch=512, execs=16384, gate=False):
     return 0
 
 
+def bench_grammar(names=None, batch=512, execs=16384, g=4,
+                  gate=False):
+    """--grammar A/B lane: blind havoc vs grammar-structured havoc on
+    the generated target zoo's gated instances (models/zoo.py).
+
+    Both lanes run the device-resident generation loop (-G) with
+    jit_harness + havoc from the SAME benign seed for the same exec
+    budget; the structured lane additionally threads the family's
+    compiled grammar tables through the scan (instrumentation option
+    ``grammar``), which protects literal/length fields and
+    substitutes command tokens from the field's alphabet.  The metric
+    is the CERTIFIED DEEP EDGE: the planted bug's single verdict
+    branch, certified at generation time (kb-zoo certify doctrine) as
+    crash-coincident, benign-seed-missed and witness-reached.
+
+    The zoo families leak NO incremental coverage toward the trigger
+    (one fused verdict register, one branch into the win block), so
+    blind havoc must jackpot the whole multi-byte command token while
+    holding the header intact; the structured lane reaches it with
+    ONE token substitution.  ``--gate``: every gated instance must
+    certify, the structured lane must crack its deep edge, and the
+    blind lane must crack none.  Deep-edge coverage is read from the
+    collision-free AFL slot (an instance whose deep edge shares a
+    slot with a shallow edge would be excluded — the generators are
+    built so it never is).  Artifact: bench_out/BENCH_grammar.json."""
+    import json as _json
+    import shutil
+    import numpy as np
+    from killerbeez_tpu.drivers.factory import driver_factory
+    from killerbeez_tpu.fuzzer.loop import Fuzzer
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    from killerbeez_tpu.models.zoo import (
+        GATED_NAMES, build_zoo, certify_zoo,
+    )
+    from killerbeez_tpu.mutators.factory import mutator_factory
+
+    rows = []
+    ok = True
+    for name in (names or GATED_NAMES):
+        t = build_zoo(name)
+        report = certify_zoo(name)
+        if not report["certified"]:
+            print(f"FAIL: {name} does not certify: {report}",
+                  file=sys.stderr)
+            ok = False
+        rows.append(emit(
+            "zoo-certify",
+            f"{name}: planted deep edge {tuple(t.deep_edge)} "
+            f"(lint clean, benign seed misses, witness crashes "
+            f"through; solver {report['solver']})",
+            int(report["certified"]), unit="certified", target=name,
+            solver=report["solver"]))
+
+        ef = np.asarray(t.program.edge_from)
+        et = np.asarray(t.program.edge_to)
+        slots = np.asarray(t.program.edge_slot)
+        deep_idx = [e for e in range(len(et))
+                    if (int(ef[e]), int(et[e])) == t.deep_edge]
+        other = {int(slots[e]) for e in range(len(et))
+                 if e not in deep_idx}
+        deep_slots = sorted({int(slots[e]) for e in deep_idx}
+                            - other)
+        if not deep_slots:
+            print(f"FAIL: {name} deep edge has no collision-free "
+                  f"AFL slot", file=sys.stderr)
+            ok = False
+            continue
+
+        safe = name.replace(":", "_").replace(",", "_") \
+                   .replace("=", "")
+
+        def run_lane(structured):
+            iopts = {"target": name, "novelty": "throughput"}
+            if structured:
+                iopts["grammar"] = t.grammar.to_json()
+            instr = instrumentation_factory("jit_harness",
+                                            _json.dumps(iopts))
+            mut = mutator_factory("havoc", '{"seed": 7}', t.seed)
+            drv = driver_factory("file", None, instr, mut)
+            out = os.path.join(
+                REPO, "bench_out",
+                f"grammar_{safe}_"
+                f"{'structured' if structured else 'blind'}")
+            shutil.rmtree(out, ignore_errors=True)
+            fz = Fuzzer(drv, output_dir=out, batch_size=batch,
+                        write_findings=False, generations=g,
+                        feedback=0)
+            t0 = time.time()
+            stats = fz.run(execs)
+            dt = max(time.time() - t0, 1e-9)
+            vb = np.asarray(instr.virgin_bits)
+            deep_hit = sum(1 for s in deep_slots if vb[s] != 0xFF)
+            return stats, stats.iterations / dt, deep_hit
+
+        sA, rateA, deepA = run_lane(False)
+        rows.append(emit(
+            "grammar-blind",
+            f"blind havoc on {name} (-b {batch} -G {g}, {execs} "
+            f"execs, feedback off)", rateA, target=name,
+            deep_edges_hit=deepA, new_paths=sA.new_paths,
+            crashes=sA.crashes))
+        sB, rateB, deepB = run_lane(True)
+        rows.append(emit(
+            "grammar-structured",
+            f"grammar-structured havoc on {name} (-b {batch} -G {g}, "
+            f"{execs} execs, feedback off)", rateB, target=name,
+            deep_edges_hit=deepB, new_paths=sB.new_paths,
+            crashes=sB.crashes))
+        if deepA != 0:
+            print(f"FAIL: {name} blind lane hit the deep edge "
+                  f"({deepA}) — the family is not blind-hostile at "
+                  f"this budget", file=sys.stderr)
+            ok = False
+        if deepB < 1:
+            print(f"FAIL: {name} structured lane missed the deep "
+                  f"edge (need >= 1)", file=sys.stderr)
+            ok = False
+    os.makedirs(os.path.join(REPO, "bench_out"), exist_ok=True)
+    with open(os.path.join(REPO, "bench_out",
+                           "BENCH_grammar.json"), "w") as f:
+        json.dump({"rows": rows, "ok": ok}, f, indent=1)
+    if gate and not ok:
+        return 1
+    return 0
+
+
 BENCH_R05_GATE = 1807549.5   # BENCH_r05 headline: execs/s/chip,
 #                              fused-pallas superbatch on tlvstack_vm
 
@@ -1544,6 +1672,34 @@ def main():
         bench_schedulers(schedules, targets=tgts or None,
                         batch=batch, execs=execs)
         return 0
+
+    if "--grammar" in sys.argv[1:]:
+        # grammar-structured A/B mode over the generated target zoo:
+        #   python bench.py --grammar [zoo:name ...] [-b BATCH]
+        #       [-n EXECS] [-G GENS] [--gate]
+        from killerbeez_tpu.models.zoo import parse_zoo_name
+        rest = [a for a in sys.argv[1:] if a != "--grammar"]
+        gate = "--gate" in rest
+        rest = [a for a in rest if a != "--gate"]
+        batch, execs, gens, names = 512, 16384, 4, []
+        j = 0
+        while j < len(rest):
+            if rest[j] == "-b":
+                batch = int(rest[j + 1]); j += 2
+            elif rest[j] == "-n":
+                execs = int(rest[j + 1]); j += 2
+            elif rest[j] == "-G":
+                gens = int(rest[j + 1]); j += 2
+            else:
+                names.append(rest[j]); j += 1
+        for n in names:
+            try:
+                parse_zoo_name(n)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+        return bench_grammar(names=names or None, batch=batch,
+                             execs=execs, g=gens, gate=gate)
 
     if "--stateful" in sys.argv[1:]:
         # stateful session-tier A/B mode:

@@ -46,6 +46,15 @@ CMP_NAMES = {CMP_EQ: "eq", CMP_NE: "ne", CMP_LT: "lt", CMP_GE: "ge"}
 #: taint lattice top: "may depend on any input byte"
 ANY = None
 
+#: internal taint marker for OP_LEN results ("depends on the input
+#: LENGTH, not on any byte value").  It rides the same frozensets as
+#: byte indices during the fixpoint but is STRIPPED before facts are
+#: published: ``BranchFact.deps`` still names byte positions only
+#: (every downstream consumer — focus masks, dictionary runs, the
+#: solver — indexes buffers with them), and the length dependency
+#: surfaces as ``BranchFact.len_dep`` for the grammar auto-deriver.
+_LEN_TAINT = -1
+
 # an abstract register value: (const, taint)
 #   const: int (known exact value) or None (unknown)
 #   taint: frozenset of input byte indices, or ANY (= None)
@@ -120,6 +129,10 @@ class BranchFact:
     deps: Optional[FrozenSet[int]]
     #: statically decided outcome (both sides constant), else None
     always: Optional[bool]
+    #: True when the comparison may depend on the input LENGTH
+    #: (OP_LEN taint) — the grammar auto-deriver's length-field
+    #: signal; byte-position consumers keep reading ``deps``
+    len_dep: bool = False
 
 
 @dataclass
@@ -189,7 +202,7 @@ def analyze_dataflow(program) -> DataflowResult:
             const = _i32(xc + c) if xc is not None else None
             out_regs[_reg(a)] = (const, xt)
         elif op == OP_LEN:
-            out_regs[_reg(a)] = (None, frozenset())
+            out_regs[_reg(a)] = (None, frozenset({_LEN_TAINT}))
         elif op == OP_LDM:
             out_regs[_reg(a)] = (None, mem_taint)
         elif op == OP_STM:
@@ -230,9 +243,14 @@ def analyze_dataflow(program) -> DataflowResult:
             const = xc
         elif yc is not None and xc is None:
             const = yc
+        deps = _join_taint(xt, yt)
+        len_dep = False
+        if deps is not ANY:
+            len_dep = _LEN_TAINT in deps
+            deps = frozenset(i for i in deps if i >= 0)
         branches.append(BranchFact(
             pc=pc, block=block_of_pc[pc], cmp=CMP_NAMES[b & 3],
-            const=const, deps=_join_taint(xt, yt), always=always))
+            const=const, deps=deps, always=always, len_dep=len_dep))
 
     # -- definite-crash pcs (constant-index memory faults) ------------
     crash_pcs: Set[int] = set()
@@ -380,6 +398,18 @@ def dictionary_candidates(program,
         else:
             cands.append((f.pc, u.to_bytes(4, "little")))
             cands.append((f.pc, u.to_bytes(4, "big")))
+        # compare-WIDTH little-endian encoding: a multi-byte eq/ne
+        # compare (deps = {i..i+w-1}, e.g. a 32-bit field assembled
+        # from 4 OP_LDBs) against a SMALL constant needs the wide
+        # encoding in the input — value magnitude alone emits only
+        # the short form (0x50 compared as a dword must land as
+        # 50 00 00 00, never as a lone 0x50).  Endianness of the
+        # assembly is unknowable statically; little-endian is the
+        # KBVM convention (read_bytes/write_bytes default) and the
+        # grammar token alphabets seed from exactly these.
+        if (f.cmp in ("eq", "ne") and f.deps is not ANY
+                and 2 <= len(f.deps) <= 4 and u < (1 << (8 * len(f.deps)))):
+            cands.append((f.pc, u.to_bytes(len(f.deps), "little")))
 
     tokens: List[Tuple[int, bytes]] = []
     seen: Set[bytes] = set()

@@ -1,0 +1,278 @@
+"""Structured mutation kernels — the device half of the grammar tier.
+
+``grammar_havoc_at`` is the generation scans' structured twin of
+``havoc_at``: it parses the seed once against the compiled field
+program (``parse_fields``), then runs the stacked-edit scan where
+each lane is either BLIND (plain havoc, bit-identical stream) or
+STRUCTURED, selected by a per-lane stage byte.  Structured lanes
+interleave mask-constrained havoc (edits land only on mutable bytes
+— token slots, free bytes, the unparsed tail; literals and length
+fields are protected) with four structured ops:
+
+* token substitution — a token from the picked field's alphabet
+  overwrites the slot;
+* field-aware splice — one field's bytes overwrite another's;
+* subtree regeneration — every mutable byte of one rule-instance
+  group is redrawn;
+* length-field repair — a length field is rewritten to cover the
+  net insert/delete the lane's blind edits applied.
+
+RNG discipline (the parity anchor, PR 14 pattern): the base stream
+``words = bits(key, (n_steps+1, 8))`` and the stack draw are
+byte-identical to ``havoc_at``; ALL grammar randomness comes from a
+side key ``fold_in(key, GRAMMAR_SALT)``.  Under the degenerate
+grammar (``meta[0] == 0``) every lane is blind with an all-ones mask
+— and an all-ones mask is pinned bit-identical to unmasked havoc
+(``_havoc_one``) — so the structured kernel IS ``havoc_at``
+bit-for-bit, single-chip and mesh (tests/test_grammar.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.vm import _mix32
+from ..ops.mutate_core import _havoc_one, read_bytes
+from .tables import KIND_ALPHA, KIND_BLOB, KIND_LEN
+
+#: fold_in salt deriving the grammar side stream from the lane key —
+#: the base havoc stream never sees it, so blind lanes (and every
+#: degenerate-grammar lane) keep their exact historical bytes
+GRAMMAR_SALT = 0x6772616D
+
+
+class ParsedFields(NamedTuple):
+    """One forward parse of a buffer against the field program."""
+    offs: jax.Array        # int32[P] field start offsets
+    effw: jax.Array        # int32[P] effective widths
+    valid: jax.Array       # bool[P]  starts inside the live prefix
+    mut_mask: jax.Array    # uint8[L] 1 = mutation may touch the byte
+    grp_byte: jax.Array    # int32[L] rule-instance group id (-1 tail)
+    edit_byte: jax.Array   # bool[L]  byte belongs to a mutable field
+
+
+def _width_mask(w):
+    """uint32 value mask for a 1/2/4-byte length field."""
+    return jnp.select([w == 1, w == 2],
+                      [jnp.uint32(0xFF), jnp.uint32(0xFFFF)],
+                      jnp.uint32(0xFFFFFFFF))
+
+
+def parse_fields(buf: jax.Array, length: jax.Array,
+                 gt: Tuple) -> ParsedFields:
+    """Sequential offset walk over the P field-program entries (P is
+    static — the loop unrolls at trace time).  Length fields read
+    their little-endian value from the buffer and size the entry they
+    measure; width-0 free bytes take the measured width, or the rest
+    of the live prefix.  The parse is TOTAL: any buffer parses, and
+    bytes past the last entry stay mutable (the field program widens
+    to "anything" where structure runs out)."""
+    fp_kind, fp_width, fp_aux, fp_grp = gt[0], gt[1], gt[2], gt[3]
+    P = fp_kind.shape[0]
+    L = buf.shape[-1]
+    pr = jnp.arange(P, dtype=jnp.int32)
+    idx = jnp.arange(L, dtype=jnp.int32)
+
+    off = jnp.int32(0)
+    offs = jnp.zeros((P,), jnp.int32)
+    effw = jnp.zeros((P,), jnp.int32)
+    measured = jnp.full((P,), -1, jnp.int32)
+    for p in range(P):
+        kind = fp_kind[p]
+        w = fp_width[p]
+        # length fields: little-endian read at the current offset,
+        # masked to the field width, sizes the measured entry
+        val = (read_bytes(buf, off, 4, False)
+               & _width_mask(w)).astype(jnp.int32)
+        is_len = kind == KIND_LEN
+        measured = jnp.where(
+            is_len & (fp_aux[p] >= 0) & (pr == fp_aux[p]),
+            jnp.clip(val, 0, L), measured)
+        is_blob = kind == KIND_BLOB
+        w_eff = jnp.where(
+            is_blob & (w == 0),
+            jnp.where(measured[p] >= 0, measured[p],
+                      jnp.maximum(length - off, 0)),
+            w)
+        w_eff = jnp.clip(w_eff, 0, jnp.maximum(L - off, 0))
+        offs = offs.at[p].set(off)
+        effw = effw.at[p].set(w_eff)
+        off = off + w_eff
+
+    valid = (effw > 0) & (offs < jnp.maximum(length, 1))
+    covered = jnp.zeros((L,), bool)
+    edit_byte = jnp.zeros((L,), bool)
+    grp_byte = jnp.full((L,), -1, jnp.int32)
+    for p in range(P):
+        in_f = (idx >= offs[p]) & (idx < offs[p] + effw[p])
+        editable = (fp_kind[p] == KIND_ALPHA) | \
+            (fp_kind[p] == KIND_BLOB)
+        edit_byte = edit_byte | (in_f & editable)
+        grp_byte = jnp.where(in_f & ~covered, fp_grp[p], grp_byte)
+        covered = covered | in_f
+    mut_mask = (edit_byte | ~covered).astype(jnp.uint8)
+    return ParsedFields(offs=offs, effw=effw, valid=valid,
+                        mut_mask=mut_mask, grp_byte=grp_byte,
+                        edit_byte=edit_byte)
+
+
+def _pick(pred, word):
+    """Rank-select the ``word % count``-th set entry of ``pred``
+    (the same rank idiom as ``_havoc_one``'s mask path)."""
+    cnt = jnp.sum(pred).astype(jnp.uint32)
+    cs = jnp.cumsum(pred.astype(jnp.int32))
+    k = (word % jnp.maximum(cnt, 1)).astype(jnp.int32)
+    return jnp.argmax(cs > k).astype(jnp.int32), cnt
+
+
+def _at(arr, i):
+    """arr[i] for a traced scalar index without a dynamic gather
+    (one-hot compare-select; see read_bytes for the rationale)."""
+    n = arr.shape[0]
+    return jnp.sum(jnp.where(
+        jnp.arange(n, dtype=jnp.int32) == i, arr,
+        jnp.zeros((), arr.dtype))).astype(arr.dtype)
+
+
+def _structured_one(buf, length, seed_len, gw, pf: ParsedFields, gt):
+    """One structured edit: op = ``gw[0] % 4`` over (token sub, field
+    splice, subtree regen, length repair).  Every op guards its own
+    applicability (no alphabet fields / a single field / no length
+    fields -> no-op) so any grammar is safe on any buffer."""
+    fp_kind, fp_width, fp_aux, fp_grp = gt[0], gt[1], gt[2], gt[3]
+    tok, tok_len, alpha_tok, alpha_n = gt[4], gt[5], gt[6], gt[7]
+    L = buf.shape[-1]
+    idx = jnp.arange(L, dtype=jnp.int32)
+    sop = (gw[0] % 4).astype(jnp.int32)
+
+    # -- op 0: token substitution ------------------------------------
+    pred_a = pf.valid & (fp_kind == KIND_ALPHA)
+    f_a, cnt_a = _pick(pred_a, gw[1])
+    off_a = _at(pf.offs, f_a)
+    w_a = _at(pf.effw, f_a)
+    row = _at(fp_aux, f_a)
+    K, AC = alpha_tok.shape
+    an = _at(alpha_n, row)
+    slot = (gw[2] % jnp.maximum(an.astype(jnp.uint32), 1)
+            ).astype(jnp.int32)
+    tid = jnp.sum(jnp.where(
+        (jnp.arange(K, dtype=jnp.int32)[:, None] == row)
+        & (jnp.arange(AC, dtype=jnp.int32)[None, :] == slot),
+        alpha_tok, 0)).astype(jnp.int32)
+    T, TW = tok.shape
+    tl = _at(tok_len, tid)
+    tbytes = jnp.sum(jnp.where(
+        jnp.arange(T, dtype=jnp.int32)[:, None] == tid, tok, 0),
+        axis=0, dtype=jnp.int32).astype(jnp.uint8)      # [TW]
+    rel_a = idx - off_a
+    wlim = jnp.minimum(jnp.maximum(tl, 1), jnp.maximum(w_a, 1))
+    tval = jnp.sum(jnp.where(
+        jnp.clip(rel_a, 0, TW - 1)[:, None]
+        == jnp.arange(TW, dtype=jnp.int32)[None, :],
+        tbytes[None, :], 0), axis=1, dtype=jnp.int32).astype(jnp.uint8)
+    in_a = (rel_a >= 0) & (rel_a < wlim) & (cnt_a > 0) & (an > 0)
+    out0 = jnp.where(in_a, tval, buf)
+
+    # -- op 1: field-aware splice (field g's bytes over field f) -----
+    pred_s = pf.valid
+    f_s, cnt_s = _pick(pred_s, gw[1])
+    g_s, _ = _pick(pred_s, gw[3])
+    off_f = _at(pf.offs, f_s)
+    off_g = _at(pf.offs, g_s)
+    wmin = jnp.minimum(_at(pf.effw, f_s), _at(pf.effw, g_s))
+    src = jnp.clip(idx - off_f + off_g, 0, L - 1)
+    oh = src[:, None] == idx[None, :]
+    spliced = jnp.sum(jnp.where(oh, buf[None, :], 0),
+                      axis=1, dtype=jnp.int32).astype(jnp.uint8)
+    in_s = (idx >= off_f) & (idx < off_f + wmin) & (cnt_s >= 2)
+    out1 = jnp.where(in_s, spliced, buf)
+
+    # -- op 2: subtree regeneration ----------------------------------
+    # pick a mutable field, redraw every mutable byte of its rule-
+    # instance group (nested rules inline-expand into groups, so a
+    # group IS the subtree); literals and length fields in the group
+    # keep their bytes — structure survives its own regeneration
+    pred_e = pf.valid & ((fp_kind == KIND_ALPHA)
+                         | (fp_kind == KIND_BLOB))
+    f_e, cnt_e = _pick(pred_e, gw[1])
+    grp_f = _at(fp_grp, f_e)
+    rnd = (_mix32((idx.astype(jnp.uint32)
+                   * jnp.uint32(0x9E3779B9)) ^ gw[4])
+           & jnp.uint32(0xFF)).astype(jnp.uint8)
+    in_g = (pf.grp_byte == grp_f) & pf.edit_byte & (cnt_e > 0)
+    out2 = jnp.where(in_g, rnd, buf)
+
+    # -- op 3: length-field repair -----------------------------------
+    # blind delete/insert edits moved the tail; rewrite one length
+    # field to its parse-time measured width plus the lane's net
+    # length delta, so the structure the parser sees tracks the edit
+    pred_l = pf.valid & (fp_kind == KIND_LEN) & (fp_aux >= 0)
+    f_l, cnt_l = _pick(pred_l, gw[1])
+    m_idx = _at(fp_aux, f_l)
+    w_m = _at(pf.effw, m_idx)
+    off_l = _at(pf.offs, f_l)
+    w_l = _at(fp_width, f_l)
+    delta = length - seed_len
+    new_u = jnp.clip(w_m + delta, 0, jnp.int32(0x7FFFFFFF)
+                     ).astype(jnp.uint32) & _width_mask(w_l)
+    rel_l = idx - off_l
+    lbytes = ((new_u >> (8 * jnp.clip(rel_l, 0, 3).astype(jnp.uint32)))
+              & 0xFF).astype(jnp.uint8)
+    in_l = (rel_l >= 0) & (rel_l < w_l) & (cnt_l > 0)
+    out3 = jnp.where(in_l, lbytes, buf)
+
+    out = jnp.where(sop == 0, out0,
+                    jnp.where(sop == 1, out1,
+                              jnp.where(sop == 2, out2, out3)))
+    return out, length
+
+
+@partial(jax.jit, static_argnames=("stack_pow2",))
+def grammar_havoc_at(buf: jax.Array, length: jax.Array,
+                     key: jax.Array, gt: Tuple, stack_pow2: int = 4
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """``havoc_at`` with grammar-structured stages interleaved.
+
+    The base words and stack draw are byte-identical to ``havoc_at``;
+    grammar randomness comes only from the ``GRAMMAR_SALT`` side key.
+    A lane's stage byte (side stream) selects blind vs structured:
+    blind lanes run unmasked-equivalent havoc (all-ones mask);
+    structured lanes constrain havoc to mutable bytes and replace 3
+    of every 4 stacked edits with a structured op.  ``meta[0] == 0``
+    (degenerate grammar) forces every lane blind — the bit-exactness
+    anchor."""
+    n_steps = 1 << stack_pow2
+    words = jax.random.bits(key, (n_steps + 1, 8), dtype=jnp.uint32)
+    stack = jnp.uint32(1) << (1 + words[0, 0] % stack_pow2)
+    side = jax.random.fold_in(key, GRAMMAR_SALT)
+    gwords = jax.random.bits(side, (n_steps + 1, 8),
+                             dtype=jnp.uint32)
+    meta = gt[8]
+    pf = parse_fields(buf, length, gt)
+    stage = gwords[0, 0] % 256
+    structured = (meta[0] != 0) & \
+        (stage < meta[1].astype(jnp.uint32))
+    mask = jnp.where(structured, pf.mut_mask, jnp.uint8(1))
+
+    def step(carry, xs):
+        i, w, gw = xs
+        b, ln = carry
+        nb, nln = _havoc_one(b, ln, w, mask=mask)
+        sb, sln = _structured_one(b, ln, length, gw, pf, gt)
+        use_s = structured & ((gw[7] & 3) != 0)
+        nb = jnp.where(use_s, sb, nb)
+        nln = jnp.where(use_s, sln, nln)
+        active = i < stack
+        b = jnp.where(active, nb, b)
+        ln = jnp.where(active, nln, ln)
+        return (b, ln), None
+
+    (out, out_len), _ = jax.lax.scan(
+        step, (buf, length),
+        (jnp.arange(n_steps, dtype=jnp.uint32), words[1:],
+         gwords[1:]))
+    return out, out_len

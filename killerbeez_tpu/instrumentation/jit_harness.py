@@ -265,7 +265,8 @@ class JitHarnessInstrumentation(Instrumentation):
                      "gen_findings_cap": int, "gen_admits": int,
                      "gen_fold_every": int, "stateful": int,
                      "msgs": int, "n_states": int, "state_reg": int,
-                     "learn": int}
+                     "learn": int, "grammar": str,
+                     "grammar_stage": int}
     OPTION_DESCS = {
         "target": "built-in KBVM target name (test/hang/libtest/cgc_like)",
         "program_file": "path to a .npz compiled KBVM program",
@@ -320,12 +321,23 @@ class JitHarnessInstrumentation(Instrumentation):
                  "havoc positions — per generation inside the -G "
                  "scan, per rotation via focus masks in the host "
                  "loop (forces the xla engine; docs/LEARN.md)",
+        "grammar": "structure-aware generation tier (killerbeez_tpu/"
+                   "grammar/; docs/GRAMMAR.md): a grammar spec as "
+                   "JSON, @path, \"auto\" (derive from the target's "
+                   "static analysis) or \"degenerate\" (the parity "
+                   "anchor).  Compiled to device tables the -G scan "
+                   "threads; forces the xla engine; exclusive with "
+                   "learn",
+        "grammar_stage": "grammar: structured-lane probability "
+                         "numerator of 256 (default 128 = half the "
+                         "lanes run structured stages)",
     }
     DEFAULTS = {"novelty": "exact", "edges": 0, "engine": "xla",
                 "phase1_steps": -1, "gen_ring_slots": 32,
                 "gen_findings_cap": 0, "gen_admits": 8,
                 "gen_fold_every": 0, "stateful": 0, "msgs": 0,
-                "n_states": 0, "state_reg": -1, "learn": 0}
+                "n_states": 0, "state_reg": -1, "learn": 0,
+                "grammar": "", "grammar_stage": 128}
 
     def __init__(self, options: Optional[str] = None):
         super().__init__(options)
@@ -373,6 +385,33 @@ class JitHarnessInstrumentation(Instrumentation):
                 "generates candidates in-kernel and cannot consume "
                 "a per-generation mask)", self.engine)
             self.engine = "xla"
+        # -- grammar tier (killerbeez_tpu/grammar/) -------------------
+        # the spec compiles to fixed-shape device tables ONCE here;
+        # the -G scan threads them through the jitted carry.  "auto"
+        # derives from the target's static analysis; "degenerate"
+        # compiles the parity-anchor tables (bit-identical scan)
+        self.grammar_tables = None
+        if self.options["grammar"]:
+            if self.options["learn"]:
+                raise ValueError(
+                    "grammar and learn are mutually exclusive — "
+                    "both tiers would own the in-scan mutation "
+                    "kernel")
+            from ..grammar import compile_grammar, derive_grammar
+            from ..grammar.spec import load_grammar
+            src = str(self.options["grammar"])
+            gspec = derive_grammar(prog) if src == "auto" \
+                else load_grammar(src)
+            self.grammar_tables = compile_grammar(
+                gspec, stage_p=int(self.options["grammar_stage"]))
+            if self.engine != "xla":
+                WARNING_MSG(
+                    "jit_harness: grammar-structured generations "
+                    "run the xla engine — %r stands down (the fused "
+                    "VMEM kernel generates candidates in-kernel and "
+                    "cannot consume the structure tables)",
+                    self.engine)
+                self.engine = "xla"
         self._fuse_warned = False
         from ..ops.vm_kernel import auto_phase1_steps, dot_modes
         # exactness-guarded MXU dtypes, decided once per program
@@ -684,13 +723,17 @@ class JitHarnessInstrumentation(Instrumentation):
         # per generation INSIDE the scan (docs/LEARN.md)
         learn = self.learn_params is not None
         lp = self.learn_params if learn else ()
+        # grammar tier: compiled structure tables ride the dispatch
+        # as a replicated pytree (None = the exact historical path)
+        grammar = self.grammar_tables is not None
+        gtab = self.grammar_tables.device() if grammar else ()
         (vb, vc, vh, vs), ring, rep = run_generations(
             self._instrs, self._edge_table, self._u_slots,
             self._seg_id, *self._gen_ring, base_key,
             jnp.asarray(its), jnp.int32(n),
             jnp.uint32(self._gen_count), jnp.uint32(salt),
             self.virgin_bits, self.virgin_crash, self.virgin_tmout,
-            vs, lp,
+            vs, lp, gtab,
             mem_size=self.program.mem_size,
             max_steps=self.program.max_steps,
             n_edges=self.program.n_edges, exact=self.exact,
@@ -700,7 +743,7 @@ class JitHarnessInstrumentation(Instrumentation):
                     else "xla"),
             phase1_steps=self.phase1_steps, dots=self._dots,
             reseed=bool(reseed), adm_cap=adm_cap, findings_cap=cap,
-            stateful=stateful, learn=learn)
+            stateful=stateful, learn=learn, grammar=grammar)
         self.virgin_bits, self.virgin_crash, self.virgin_tmout = \
             vb, vc, vh
         if spec is not None:

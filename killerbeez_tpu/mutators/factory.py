@@ -16,6 +16,7 @@ from .deterministic import (
     ArithmeticMutator, BitFlipMutator, DictionaryMutator,
     InterestingValueMutator, NopMutator,
 )
+from .grammar import GrammarMutator
 from .multipart import ManagerMutator
 from .radamsa import RadamsaMutator
 from .randomized import (
@@ -33,7 +34,8 @@ def register_mutator(cls: Type[Mutator]) -> Type[Mutator]:
 for _cls in (NopMutator, BitFlipMutator, ArithmeticMutator,
              InterestingValueMutator, DictionaryMutator, HavocMutator,
              ZzufMutator, NiMutator, HonggfuzzMutator, SpliceMutator,
-             AflMutator, ManagerMutator, RadamsaMutator):
+             AflMutator, ManagerMutator, RadamsaMutator,
+             GrammarMutator):
     register_mutator(_cls)
 
 

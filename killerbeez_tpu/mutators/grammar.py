@@ -1,0 +1,86 @@
+"""Grammar-structured mutator: the host-driven twin of the in-scan
+structured stages.
+
+``GrammarMutator`` compiles a grammar spec (inline JSON, ``@file``,
+``degenerate``, or ``auto`` derived from a named target's static
+analysis) to device tables once at construction and vmaps
+``grammar_havoc_at`` over per-lane keys — the SAME kernel the
+generation scans run, so host-batch campaigns and -G campaigns draw
+identical structured candidates for identical (seed, key) pairs.
+
+Parity anchor: with the degenerate grammar every candidate is
+bit-identical to ``HavocMutator`` at the same seed/stack_pow2 (the
+tables carry ``nondegen == 0`` and the kernel reduces to blind
+havoc).  ``fused_spec`` is the plain havoc spec — under -G the
+harness's own ``grammar`` option supplies the tables, keeping one
+source of structure per campaign.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..grammar import compile_grammar, derive_grammar, grammar_havoc_at
+from ..grammar.spec import load_grammar
+from ..grammar.tables import STAGE_P
+from .randomized import _KeyedMutator
+
+
+class GrammarMutator(_KeyedMutator):
+    """Structure-aware havoc: field-aware splice, token substitution,
+    length repair, and subtree regeneration interleaved with blind
+    stacked edits, per-lane stage-byte selected."""
+    name = "grammar"
+    OPTION_SCHEMA = {"stack_pow2": int, "grammar": str,
+                     "grammar_stage": int, "target": str}
+    OPTION_DESCS = {
+        "stack_pow2": "max stacked edits = 2**stack_pow2 (default 4)",
+        "grammar": "spec source: inline JSON, @file, 'degenerate', "
+                   "or 'auto' (derive from the static analysis of "
+                   "--target)",
+        "grammar_stage": "structured-stage probability numerator of "
+                         "256 (default 128: half the lanes)",
+        "target": "built-in target name for grammar='auto' "
+                  "derivation",
+    }
+    DEFAULTS = {"stack_pow2": 4, "grammar": "degenerate",
+                "grammar_stage": STAGE_P, "target": ""}
+
+    def __init__(self, options, input_bytes):
+        super().__init__(options, input_bytes)
+        sp = int(self.options["stack_pow2"])
+        if not (1 <= sp <= 7):
+            raise ValueError("stack_pow2 must be in 1..7")
+        src = str(self.options["grammar"]) or "degenerate"
+        if src == "auto":
+            tgt = str(self.options["target"])
+            if not tgt:
+                raise ValueError(
+                    "grammar='auto' derivation needs a target name "
+                    "(the grammar comes from its static analysis)")
+            from ..models.targets import get_target
+            gspec = derive_grammar(get_target(tgt))
+        else:
+            gspec = load_grammar(src)
+        self.grammar_tables = compile_grammar(
+            gspec, stage_p=int(self.options["grammar_stage"]))
+        gtab = self.grammar_tables.device()
+        self._fn = jax.jit(jax.vmap(
+            lambda b, ln, k: grammar_havoc_at(b, ln, k, gtab,
+                                              stack_pow2=sp),
+            in_axes=(None, None, 0)))
+
+    def _generate(self, its):
+        bufs, lens = self._fn(jnp.asarray(self.seed_buf),
+                              jnp.int32(self.seed_len),
+                              self._keys(its))
+        return bufs, lens  # device arrays: base keeps them lazy
+
+    def fused_spec(self):
+        """Fused/generation campaigns take the plain havoc spec; the
+        harness's ``grammar`` option carries the structure tables (one
+        source of structure per campaign, and the degenerate default
+        keeps the fused path parity-anchored)."""
+        return (self.seed_buf, self.seed_len, self._base_key(),
+                int(self.options["stack_pow2"]))

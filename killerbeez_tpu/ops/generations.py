@@ -349,13 +349,15 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
                     ring_finds, ring_ptr,
                     base_key, its0, n_real, gen0, salt,
                     vb, vc, vh, vs, learn_params=(),
+                    grammar_tables=(),
                     mem_size=0, max_steps=0, n_edges=0, exact=True,
                     stack_pow2=4,
                     g=1, engine="xla", phase1_steps=0,
                     dots=("f32", "f32"), reseed=True,
                     adm_cap=DEFAULT_ADM_CAP,
                     findings_cap=DEFAULT_FINDINGS_CAP,
-                    interpret=False, stateful=None, learn=False):
+                    interpret=False, stateful=None, learn=False,
+                    grammar=False):
     """G generations in ONE device program.  Returns (new virgin maps,
     new ring state, GenerationOutcome fields) — see module docstring
     for the state/replay contract.
@@ -391,6 +393,17 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
     then bit-identical to ``havoc_at`` — the shaped scan IS the
     unshaped scan until training starts (parity-pinned in
     tests/test_learn.py).
+
+    ``grammar`` (static) + ``grammar_tables`` (the compiled field
+    program / token / alphabet tables, ``GrammarTables.device()``)
+    run structure-aware mutation IN the scan: candidates come from
+    ``grammar_havoc_at`` — blind havoc and structured stages
+    interleaved per lane by a stage byte (killerbeez_tpu/grammar/).
+    Requires engine "xla" like sessions and shaping, and is mutually
+    exclusive with ``learn`` (both would own the mutation kernel).
+    Under the degenerate grammar the structured kernel is
+    bit-identical to ``havoc_at`` — the parity anchor pinned in
+    tests/test_grammar.py.
     """
     from ..instrumentation.base import pack_verdicts
     from ..instrumentation.jit_harness import _triage_counts
@@ -409,6 +422,16 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
             "learned mutation shaping needs the xla engine (the "
             "fused VMEM kernel generates candidates in-kernel and "
             "cannot consume a per-generation mask)")
+    if grammar and engine != "xla":
+        raise ValueError(
+            "grammar-structured generations need the xla engine "
+            "(the fused VMEM kernel generates candidates in-kernel "
+            "and cannot consume the structure tables)")
+    if grammar and learn:
+        raise ValueError(
+            "grammar and learn are mutually exclusive — both tiers "
+            "would own the in-scan mutation kernel (run the learned "
+            "mask OR the structure tables, not both)")
 
     def one_generation(carry, j):
         (vb, vc, vh, vs, ring_bufs, ring_lens, ring_filled,
@@ -437,7 +460,18 @@ def _run_generations_impl(instrs, edge_table, u_slots, seg_id,
         else:
             from .mutate_core import havoc_at, havoc_mask_at
             from ..models.vm import _run_batch_impl
-            if learn:
+            if grammar:
+                # structure-aware candidates: the grammar kernel
+                # interleaves blind and structured stages per lane
+                # (stage byte from the side stream); degenerate
+                # tables make this branch bit-identical to the
+                # havoc_at branch below (the parity anchor)
+                from ..grammar.device import grammar_havoc_at
+                bufs, lens = jax.vmap(
+                    lambda k: grammar_havoc_at(
+                        seed_buf, seed_len, k, grammar_tables,
+                        stack_pow2=stack_pow2))(keys)
+            elif learn:
                 # in-scan inference: saliency of THIS generation's
                 # seed slot -> dense mask -> masked havoc.  The
                 # branch is static, so campaigns without --learn
@@ -557,7 +591,7 @@ def run_generations(*args, **kwargs):
                              "exact", "stack_pow2", "g", "engine",
                              "phase1_steps", "dots", "reseed",
                              "adm_cap", "findings_cap", "interpret",
-                             "stateful", "learn"),
+                             "stateful", "learn", "grammar"),
             donate_argnums=carry_donation_argnums(
                 jax.default_backend(), _CARRY_ARGNUMS))
     return _RUN_GENERATIONS_JIT(*args, **kwargs)

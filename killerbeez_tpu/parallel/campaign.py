@@ -280,7 +280,10 @@ class ShardedCampaignDriver(Driver):
         # installs them before the FIRST dispatch, so the flag never
         # flips mid-campaign and the ring never rebuilds for it)
         learn = getattr(instr, "learn_params", None) is not None
-        key = (L, slots, learn)
+        # grammar tables are compiled at instrumentation init, so
+        # presence is likewise stable for the campaign's lifetime
+        grammar = getattr(instr, "grammar_tables", None) is not None
+        key = (L, slots, learn, grammar)
         if self._gen_ring is not None and self._gen_ring_key == key:
             return
         bpd = self.batch_per_device
@@ -298,7 +301,7 @@ class ShardedCampaignDriver(Driver):
             engine=instr.engine, interpret=self._interpret,
             seed=int(self.mutator.options.get("seed", 0)),
             salt=salt, adm_cap=adm_cap, findings_cap=cap,
-            stateful=self._stateful, learn=learn)
+            stateful=self._stateful, learn=learn, grammar=grammar)
         self._gen_ring = sharded_gen_ring_init(
             self.mesh, seed_buf, int(seed_len), slots, L)
         self._gen_ring_key = key
@@ -321,11 +324,14 @@ class ShardedCampaignDriver(Driver):
         base_it = int(its[0])   # same 64-bit counter contract as
         # test_batch; generation j inside the scan adds j*n on device
         fold_every = int(instr.options.get("gen_fold_every", 0))
+        gtab = getattr(instr, "grammar_tables", None)
         with self._span("execute"):     # the whole loop is in-kernel
             self.state, self._gen_ring, rep = self._gen_dispatch(
                 self.state, self._gen_ring, base_it, self._gen_count,
                 int(g), reseed=bool(reseed), fold_every=fold_every,
-                learn_params=getattr(instr, "learn_params", None))
+                learn_params=getattr(instr, "learn_params", None),
+                grammar_tables=(gtab.device()
+                                if gtab is not None else None))
         out = MeshGenerationOutcome(
             *rep, ring_filled=self._gen_ring.filled,
             gen0=self._gen_count, g=int(g), n_real=n, cap=self._gen_cap,

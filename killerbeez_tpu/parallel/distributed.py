@@ -260,18 +260,26 @@ class _ShardKernels:
             p.max_steps, p.n_edges, interpret=self.interpret,
             dots=self.dots)
 
-    def mutate_exec(self, keys, seed_buf, seed_len, mask=None):
+    def mutate_exec(self, keys, seed_buf, seed_len, mask=None,
+                    grammar_tables=None):
         """havoc-mutate this shard's lanes from ``seed_buf`` and
         execute them; returns (VMResult, bufs, lens).  ``mask`` is
         the learned dense uint8[L] focus mask (learn/): mutation
         routes through the masked havoc kernel — xla engine only
         (the generation scan guards it), and an all-ones mask is
-        bit-identical to the unmasked kernel."""
-        p = self.program
-        bpd = self.batch_per_device
+        bit-identical to the unmasked kernel.  ``grammar_tables`` is
+        the compiled structure-table pytree (grammar/): mutation
+        routes through ``grammar_havoc_at`` — also xla-only, and the
+        degenerate tables are bit-identical to blind havoc (the
+        grammar parity anchor)."""
         if mask is not None and self.engine != "xla":
             raise ValueError(
                 "learned mutation shaping needs the xla engine")
+        if grammar_tables is not None and self.engine != "xla":
+            raise ValueError(
+                "grammar-structured mutation needs the xla engine")
+        p = self.program
+        bpd = self.batch_per_device
         if self.engine == "pallas_fused":
             # mutation AND execution in one kernel per dp shard
             from ..ops.vm_kernel import (
@@ -298,7 +306,13 @@ class _ShardKernels:
                 bufs = bufs[:bpd]
                 lens = lens[:bpd]
             return res, bufs, lens
-        if mask is not None:
+        if grammar_tables is not None:
+            from ..grammar.device import grammar_havoc_at
+            bufs, lens = jax.vmap(
+                lambda k: grammar_havoc_at(
+                    seed_buf, seed_len, k, grammar_tables,
+                    stack_pow2=self.stack_pow2))(keys)
+        elif mask is not None:
             from ..ops.mutate_core import havoc_mask_at
             bufs, lens = jax.vmap(
                 lambda k: havoc_mask_at(
@@ -664,7 +678,8 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                              salt: int = 0,
                              adm_cap: int = DEFAULT_ADM_CAP,
                              findings_cap: int = DEFAULT_FINDINGS_CAP,
-                             stateful=None, learn: bool = False):
+                             stateful=None, learn: bool = False,
+                             grammar: bool = False):
     """Build the mesh-resident generation dispatch: the single-chip
     generation scan (ops/generations.py) lifted into a ``shard_map``
     over the (dp, mp) mesh.
@@ -712,6 +727,15 @@ def make_sharded_generations(program: Program, mesh: Mesh,
             "learned mutation shaping needs the xla engine (the "
             "fused VMEM kernel generates candidates in-kernel and "
             "cannot consume a per-generation mask)")
+    if grammar and engine != "xla":
+        raise ValueError(
+            "grammar-structured mutation needs the xla engine (the "
+            "fused VMEM kernel generates candidates in-kernel and "
+            "cannot consume the structure tables)")
+    if grammar and learn:
+        raise ValueError(
+            "grammar and learn both reshape the same mutation draw "
+            "stream — enable one per campaign")
     kern = _ShardKernels(program, mesh, b, max_len,
                          stack_pow2=stack_pow2, engine=engine,
                          interpret=interpret, seed=seed,
@@ -725,7 +749,7 @@ def make_sharded_generations(program: Program, mesh: Mesh,
         A_eff = A if reseed else 1
 
         def body(vb, vc, vh, rbufs, rlens, rfilled, rhits, rfinds,
-                 rptr, vs, base_it, gen0, salt, lp):
+                 rptr, vs, base_it, gen0, salt, lp, gtab):
             dp_i = jax.lax.axis_index("dp")
             # P("dp") blocks arrive with a leading axis of 1
             rbufs, rlens, rfilled, rhits, rfinds, rptr, vs = (
@@ -766,9 +790,9 @@ def make_sharded_generations(program: Program, mesh: Mesh,
                                           mask_valid)
                 else:
                     mask = None
-                res, bufs, lens = kern.mutate_exec(keys, seed_buf,
-                                                   seed_len,
-                                                   mask=mask)
+                res, bufs, lens = kern.mutate_exec(
+                    keys, seed_buf, seed_len, mask=mask,
+                    grammar_tables=gtab if grammar else None)
                 statuses = jnp.where(res.status == FUZZ_RUNNING,
                                      FUZZ_HANG, res.status)
                 rets, uc, uh, vb, vc, vh = kern.triage_local(
@@ -869,12 +893,13 @@ def make_sharded_generations(program: Program, mesh: Mesh,
             fn = jax.jit(
                 shard_map(
                     gen_body(g, reseed, fold_every), mesh=mesh,
-                    # the trailing P() is the learn-model weight
-                    # pytree, replicated to every shard (a pytree
-                    # prefix: one spec covers all leaves)
+                    # the trailing P()s are the learn-model weight
+                    # pytree and the grammar-table pytree, both
+                    # replicated to every shard (pytree prefixes:
+                    # one spec covers all leaves)
                     in_specs=(P("mp"), P("mp"), P("mp"),
                               *dp_specs, P("dp"), P(), P(), P(),
-                              P()),
+                              P(), P()),
                     out_specs=((P("mp"), P("mp"), P("mp"))
                                + (P("dp"),) * 20),
                     check_vma=False),
@@ -891,7 +916,8 @@ def make_sharded_generations(program: Program, mesh: Mesh,
 
     def dispatch(state: ShardedFuzzState, ring: ShardedGenRing,
                  base_it, gen0: int, g: int, reseed: bool = True,
-                 fold_every: int = 0, learn_params=None):
+                 fold_every: int = 0, learn_params=None,
+                 grammar_tables=None):
         """Run ``g`` mesh generations in ONE device program.
         ``fold_every`` <= 0 means auto: once per dispatch with
         reseeding on (cheapest), every generation with reseeding off
@@ -918,12 +944,19 @@ def make_sharded_generations(program: Program, mesh: Mesh,
             raise ValueError(
                 "this mesh generation dispatch was built with "
                 "learn=True — pass the model weights (learn_params)")
+        if grammar and grammar_tables is None:
+            raise ValueError(
+                "this mesh generation dispatch was built with "
+                "grammar=True — pass the compiled structure tables "
+                "(grammar_tables)")
         lp = learn_params if learn else jnp.zeros((1,), jnp.float32)
+        gt = grammar_tables if grammar \
+            else jnp.zeros((1,), jnp.int32)
         outs = _jit(g, bool(reseed), fold)(
             state.virgin_bits, state.virgin_crash, state.virgin_tmout,
             ring.bufs, ring.lens, ring.filled, ring.hits, ring.finds,
             ring.ptr, state.virgin_state, _counter_halves(base_it),
-            jnp.uint32(int(gen0)), salt_u32, lp)
+            jnp.uint32(int(gen0)), salt_u32, lp, gt)
         (vb, vc, vh, vs, rbufs, rlens, rfilled, rhits, rfinds, rptr,
          *rep) = outs
         new_state = ShardedFuzzState(vb, vc, vh, state.step + g, vs)

@@ -11,6 +11,7 @@ the merger (tools/merger.py) to report fleet coverage next to the
 store's.
 
     kb-corpus ls out/corpus
+    kb-corpus heat out/corpus --top 4
     kb-corpus stats out/corpus --states node0.state node1.state -I afl
     kb-corpus compact out/corpus --dry-run
     kb-corpus compact out/corpus --sign file afl \\
@@ -179,7 +180,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="kb-corpus",
         description="inspect / summarize / compact a persistent "
                     "corpus store (--corpus-dir)")
-    p.add_argument("command", choices=["ls", "stats", "compact"])
+    p.add_argument("command", choices=["ls", "stats", "compact",
+                                       "heat"])
     p.add_argument("store", help="corpus store directory")
     p.add_argument("--sign", nargs=2, metavar=("DRIVER", "INSTR"),
                    help="sign unsigned entries with one execution "
@@ -197,6 +199,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--dry-run", action="store_true",
                    help="compact: report what would be removed, "
                         "remove nothing")
+    p.add_argument("--entry", metavar="MD5",
+                   help="heat: one parent's panel (md5 prefix ok) "
+                        "instead of the hottest parents")
+    p.add_argument("--top", type=int, default=4,
+                   help="heat: how many parent panels (default 4)")
+    p.add_argument("--hex-width", type=int, default=16,
+                   help="heat: bytes per hex-dump row (default 16)")
+    p.add_argument("--no-color", action="store_true",
+                   help="heat: character ramp instead of ANSI")
+    p.add_argument("--base", metavar="FILE",
+                   help="heat: the campaign's base seed file, so "
+                        "first-generation lineage (parent 'base') "
+                        "renders too")
     p.add_argument("-l", "--logging-options", help="logging JSON options")
     args = p.parse_args(argv)
     try:
@@ -205,6 +220,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         entries = store.load()
         if args.command == "ls":
             print(render_ls(entries))
+            return 0
+        if args.command == "heat":
+            # FMViz-style per-byte mutation heat from the lineage's
+            # provenance bitmaps (tools/heat.py)
+            from .heat import render_store_heat
+            base = None
+            if args.base:
+                with open(args.base, "rb") as f:
+                    base = f.read()
+            print(render_store_heat(
+                entries, top=args.top, width=args.hex_width,
+                color=not args.no_color, only_md5=args.entry,
+                base=base))
             return 0
         if args.command == "stats":
             merged_cov = None

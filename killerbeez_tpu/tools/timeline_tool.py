@@ -687,6 +687,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "4x the median dispatch gap)")
     p.add_argument("--no-lanes", action="store_true",
                    help="skip the ANSI lane view")
+    p.add_argument("--heat", action="store_true",
+                   help="append the corpus store's per-byte "
+                        "mutation-heat panels (FMViz-style, from "
+                        "<path>/corpus provenance sidecars)")
+    p.add_argument("--base", metavar="FILE",
+                   help="--heat: the campaign's base seed file, so "
+                        "first-generation lineage renders too")
     p.add_argument("--fleet", metavar="MANAGER_URL",
                    help="merge the fleet's event streams from a "
                         "manager (/api/events/<campaign>) onto one "
@@ -733,6 +740,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     bubble_us = (args.bubble_ms * 1e3 if args.bubble_ms is not None
                  else None)
     report = build_report(doc, events, stats, bubble_us)
+    heat_text = None
+    if args.heat:
+        # lineage heat next to the time axis: which parent bytes the
+        # campaign profited from mutating (tools/heat.py)
+        from ..corpus.store import CorpusStore
+        from .heat import heat_report, render_store_heat
+        store_dir = os.path.join(out_dir, "corpus")
+        if not os.path.isdir(store_dir):
+            print(f"error: --heat needs a corpus store at "
+                  f"{store_dir} (run with --corpus-dir)",
+                  file=sys.stderr)
+            return 1
+        heat_entries = CorpusStore(store_dir).load()
+        base = None
+        if args.base:
+            with open(args.base, "rb") as f:
+                base = f.read()
+        if args.json:
+            report["heat"] = heat_report(heat_entries, base=base)
+        else:
+            heat_text = render_store_heat(heat_entries, base=base)
     if args.json:
         print(json.dumps(report, indent=2))
         return 0
@@ -744,6 +772,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                           report.get("window_us", 0.0),
                           width=args.width)
     print(render(report, lanes))
+    if heat_text is not None:
+        print("\nmutation heat (corpus lineage):")
+        print(heat_text)
     return 0
 
 

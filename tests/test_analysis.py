@@ -587,3 +587,26 @@ def test_showmap_static_summary():
     slots = [int(s) for s in np.asarray(p.edge_slot)[:3]]
     line = static_summary(p, slots)
     assert "7 blocks" in line and "3/11 static slots" in line
+
+
+def test_extract_dictionary_zoo_cksum_wide_magic():
+    """The zoo's cksum gate compares a 32-bit LE word built from the
+    first four input bytes — the dictionary must surface the magic in
+    both byte orders (the LE rendering is what actually lands in the
+    file)."""
+    toks = extract_dictionary(targets.get_target(
+        "zoo:cksum:style=sum,bug=1"))
+    assert b"CKSM" in toks              # little-endian: file order
+    assert b"MSKC" in toks              # big-endian companion
+
+
+def test_dataflow_len_dep_flags_length_comparisons():
+    """``BranchFact.len_dep`` marks branches whose operand folds the
+    input length — the signal the grammar auto-deriver reads to place
+    length fields.  Byte-content gates must stay unflagged."""
+    df = analyze_dataflow(targets.get_target("zoo:chain:width=2,bug=0"))
+    len_facts = [f for f in df.branches if f.len_dep]
+    assert len_facts                    # load_len guard + verdict fold
+    assert any(not f.deps for f in len_facts)   # pure length bound
+    content = [f for f in df.branches if f.deps and not f.len_dep]
+    assert content                      # the 32-bit magic gate

@@ -1,0 +1,344 @@
+"""Grammar/structure-aware generation tier (killerbeez_tpu/grammar/).
+
+Pins the tier's contracts:
+  * the PARITY ANCHOR — degenerate tables (``meta[0] == 0``) force
+    every lane blind and ``grammar_havoc_at`` is bit-identical to
+    ``havoc_at``; threading the degenerate grammar through the
+    generation scans (single-chip -G and dp>1 mesh) leaves findings,
+    virgin maps and corpus write-through bit-identical to the
+    no-grammar path;
+  * the structure compiler's edge cases: empty alphabets, empty
+    rules, nesting beyond the depth cap (clipped to free bytes with
+    ONE warning, never a miscompile), the entry-table bound, and
+    deterministic recompiles;
+  * the forward parse protects literals and length fields
+    (``mut_mask``) while leaving token/blob bytes and everything
+    past the structured prefix mutable;
+  * auto-derivation (static dataflow -> grammar) compiles and runs
+    over every built-in target family;
+  * end to end: a structured campaign cracks a certified zoo deep
+    edge at a budget where the A/B bench pins blind havoc to zero.
+"""
+
+import json
+import os
+import shutil
+
+import jax
+import numpy as np
+import pytest
+
+from killerbeez_tpu.drivers.factory import driver_factory
+from killerbeez_tpu.fuzzer.loop import Fuzzer
+from killerbeez_tpu.grammar.derive import derive_grammar
+from killerbeez_tpu.grammar.device import grammar_havoc_at, parse_fields
+from killerbeez_tpu.grammar.spec import (
+    Grammar, Rule, blob, length, lit, load_grammar, ref, token,
+)
+from killerbeez_tpu.grammar.tables import (
+    DEPTH_CAP, KIND_BLOB, MAX_ENTRIES, compile_grammar,
+    degenerate_tables,
+)
+from killerbeez_tpu.instrumentation.factory import instrumentation_factory
+from killerbeez_tpu.models.targets import get_target, target_names
+from killerbeez_tpu.mutators.factory import mutator_factory
+from killerbeez_tpu.ops.mutate_core import havoc_at
+
+SEED = b"ABCD1234"
+
+
+# ---------------------------------------------------------------------------
+# the kernel parity anchor
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("stack_pow2", [2, 4])
+def test_degenerate_kernel_bit_identical_to_havoc(stack_pow2):
+    """grammar_havoc_at over degenerate tables == havoc_at, bit for
+    bit, across lanes/lengths — the anchor the whole tier rests on."""
+    gt = degenerate_tables().device()
+    rng = np.random.default_rng(3)
+    buf = jax.numpy.asarray(rng.integers(0, 256, 64).astype(np.uint8))
+    for i in range(8):
+        key = jax.random.PRNGKey(i)
+        ln = jax.numpy.int32(4 + 7 * i)
+        b0, l0 = havoc_at(buf, ln, key, stack_pow2=stack_pow2)
+        b1, l1 = grammar_havoc_at(buf, ln, key, gt,
+                                  stack_pow2=stack_pow2)
+        assert np.array_equal(np.asarray(b0), np.asarray(b1))
+        assert int(l0) == int(l1)
+
+
+def test_nondegenerate_kernel_diverges_and_preserves_shape():
+    g = Grammar(rules={"m": Rule("m", (
+        lit(b"MAGI"), token([b"\x01", b"\x02"], 1),
+        length(of="tail"), blob(0, name="tail")))}, start="m")
+    gt = compile_grammar(g, stage_p=256).device()
+    buf = jax.numpy.asarray(np.frombuffer(
+        b"MAGI\x01\x03abc" + bytes(55), np.uint8))
+    ln = jax.numpy.int32(9)
+    diverged = False
+    for i in range(8):
+        key = jax.random.PRNGKey(i)
+        b0, _ = havoc_at(buf, ln, key)
+        b1, l1 = grammar_havoc_at(buf, ln, key, gt)
+        assert b1.shape == buf.shape and 0 <= int(l1) <= 64
+        diverged |= not np.array_equal(np.asarray(b0),
+                                       np.asarray(b1))
+    assert diverged, "structured stages never engaged"
+
+
+# ---------------------------------------------------------------------------
+# the forward parse: literal/length protection
+# ---------------------------------------------------------------------------
+
+
+def test_parse_fields_protects_lits_and_lens():
+    g = Grammar(rules={"m": Rule("m", (
+        lit(b"AB"), length(of="tail"),
+        token([b"\x10\x20"], 2), blob(0, name="tail")))}, start="m")
+    gt = compile_grammar(g).device()
+    raw = b"AB\x04\x10\x20wxyz"
+    buf = jax.numpy.asarray(np.frombuffer(raw + bytes(64 - len(raw)),
+                                          np.uint8))
+    pf = parse_fields(buf, jax.numpy.int32(len(raw)), gt)
+    mask = np.asarray(pf.mut_mask)
+    assert mask[0] == 0 and mask[1] == 0      # lit pinned
+    assert mask[2] == 0                       # length field pinned
+    assert mask[3] == 1 and mask[4] == 1      # token mutable
+    assert mask[5:9].all()                    # blob mutable
+    assert mask[len(raw):].all()              # past structure: anything
+
+
+def test_parse_is_total_on_garbage():
+    g = Grammar(rules={"m": Rule("m", (
+        lit(b"AB"), length(of="t"), blob(0, name="t")))}, start="m")
+    gt = compile_grammar(g).device()
+    buf = jax.numpy.asarray(np.full(32, 0xFF, np.uint8))
+    for ln in (0, 1, 31):
+        pf = parse_fields(buf, jax.numpy.int32(ln), gt)
+        assert np.asarray(pf.mut_mask).shape == (32,)
+        out, _ = grammar_havoc_at(buf, jax.numpy.int32(ln),
+                                  jax.random.PRNGKey(0),
+                                  compile_grammar(g,
+                                                  stage_p=256).device())
+        assert out.shape == buf.shape
+
+
+# ---------------------------------------------------------------------------
+# the structure compiler: edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_compile_empty_alphabet_guarded():
+    g = Grammar(rules={"m": Rule("m", (token([], 1), blob(0)))},
+                start="m")
+    t = compile_grammar(g, stage_p=256)
+    assert int(t.alpha_n[0]) == 0
+    buf = jax.numpy.asarray(np.zeros(16, np.uint8))
+    out, _ = grammar_havoc_at(buf, jax.numpy.int32(8),
+                              jax.random.PRNGKey(1), t.device())
+    assert out.shape == buf.shape       # kernels guard n == 0
+
+
+def test_compile_empty_rule_is_degenerate():
+    g = Grammar(rules={"m": Rule("m", ())}, start="m")
+    t = compile_grammar(g)
+    assert not t.nondegen               # "anything": the parity path
+
+
+def test_compile_depth_cap_clips_with_one_warning(capsys):
+    rules = {"m": Rule("m", (lit(b"X"), ref("m")))}
+    t = compile_grammar(Grammar(rules=rules, start="m"))
+    err = capsys.readouterr().err
+    assert err.count("grammar: clipped") == 1   # one-shot warning
+    assert int(t.meta[3]) > 0
+    # the clip widened to free bytes, never narrowed
+    assert KIND_BLOB in t.fp_kind.tolist()
+    # lit depth: DEPTH_CAP expansions of "m" emit DEPTH_CAP lits
+    assert t.fp_kind.tolist().count(0) == DEPTH_CAP
+
+
+def test_compile_entry_cap_clips_with_warning(capsys):
+    fields = tuple(lit(bytes([65 + (i % 26)]))
+                   for i in range(MAX_ENTRIES + 8))
+    t = compile_grammar(Grammar(
+        rules={"m": Rule("m", fields)}, start="m"))
+    assert int(t.meta[2]) == MAX_ENTRIES
+    assert int(t.meta[3]) > 0
+    assert capsys.readouterr().err.count("grammar: clipped") == 1
+
+
+def test_compile_deterministic():
+    g = derive_grammar(get_target("tlvstack_vm"))
+    a, b = compile_grammar(g), compile_grammar(g)
+    for x, y in zip(a, b):
+        assert np.array_equal(x, y)
+
+
+def test_load_grammar_roundtrip_and_degenerate():
+    g = Grammar(rules={"m": Rule("m", (
+        lit(b"\x00\xFF"), token([b"ab"], 2), blob(0)))}, start="m")
+    g2 = load_grammar(g.to_json())
+    assert g2.to_json() == g.to_json()
+    assert not compile_grammar(load_grammar("degenerate")).nondegen
+
+
+# ---------------------------------------------------------------------------
+# auto-derivation over every built-in target family
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(target_names()))
+def test_derive_compile_run_every_builtin_target(name):
+    """The static layer's facts always yield a compilable grammar
+    whose kernel runs — over ALL built-in families."""
+    prog = get_target(name)
+    g = derive_grammar(prog)
+    t = compile_grammar(g, stage_p=256)
+    buf = jax.numpy.asarray(np.zeros(64, np.uint8))
+    out, ln = grammar_havoc_at(buf, jax.numpy.int32(16),
+                               jax.random.PRNGKey(0), t.device())
+    assert out.shape == buf.shape and 0 <= int(ln) <= 64
+
+
+# ---------------------------------------------------------------------------
+# generation scans: degenerate parity, structured crack
+# ---------------------------------------------------------------------------
+
+
+def _findings(out_dir):
+    res = {}
+    for kind in ("new_paths", "crashes", "hangs"):
+        d = os.path.join(out_dir, kind)
+        res[kind] = sorted(os.listdir(d)) if os.path.isdir(d) else []
+    return res
+
+
+def test_generation_scan_degenerate_grammar_parity(tmp_path):
+    """The single-chip -G scan with the degenerate grammar threaded
+    is bit-identical to the no-grammar scan: findings, corpus
+    write-through, virgin map."""
+    def run(name, grammar):
+        iopts = {"target": "test"}
+        if grammar:
+            iopts["grammar"] = "degenerate"
+        instr = instrumentation_factory("jit_harness",
+                                        json.dumps(iopts))
+        mut = mutator_factory("havoc", '{"seed": 7}', SEED)
+        drv = driver_factory("file", None, instr, mut)
+        fz = Fuzzer(drv, output_dir=str(tmp_path / name),
+                    batch_size=64, feedback=0, generations=4,
+                    corpus_dir=str(tmp_path / name / "corpus"))
+        fz.run(1024)
+        return instr
+
+    i0 = run("off", False)
+    i1 = run("on", True)
+    assert i1.grammar_tables is not None
+    assert _findings(str(tmp_path / "on")) == \
+        _findings(str(tmp_path / "off"))
+    assert _findings(str(tmp_path / "on"))["new_paths"], "vacuous"
+    assert np.array_equal(np.asarray(i0.virgin_bits),
+                          np.asarray(i1.virgin_bits))
+
+    def entries(name):
+        d = tmp_path / name / "corpus"
+        return sorted(f for f in os.listdir(d) if len(f) == 32)
+
+    assert entries("on") == entries("off")
+
+
+def test_mesh_generation_scan_degenerate_grammar_parity():
+    """The dp>1 mesh scan with degenerate tables threaded is
+    bit-identical to the no-grammar mesh scan, per shard."""
+    from killerbeez_tpu.parallel import ShardedCampaignDriver
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices (conftest forces 8 on CPU)")
+
+    def run(grammar):
+        iopts = {"target": "test"}
+        if grammar:
+            iopts["grammar"] = "degenerate"
+        instr = instrumentation_factory("jit_harness",
+                                        json.dumps(iopts))
+        mut = mutator_factory("havoc", '{"seed": 7}', SEED)
+        drv = ShardedCampaignDriver("2,1", instr, mut,
+                                    batch_size=128)
+        out = drv.test_batch_generations(128, 4)
+        return out.materialize(), instr
+
+    h0, i0 = run(False)
+    h1, i1 = run(True)
+    found = 0
+    for d in range(2):
+        s0, s1 = h0.shard(d), h1.shard(d)
+        assert int(s0.fr_ptr) == int(s1.fr_ptr)
+        st = min(int(s0.fr_ptr), int(s0.cap))
+        found += st
+        assert np.array_equal(s0.fr_bufs[:st], s1.fr_bufs[:st])
+        assert np.array_equal(s0.adm_bufs, s1.adm_bufs)
+    assert found > 0, "vacuous"
+    assert np.array_equal(np.asarray(i0.virgin_bits),
+                          np.asarray(i1.virgin_bits))
+
+
+def test_mesh_generation_scan_structured_grammar_runs():
+    """A NON-degenerate grammar threads through the dp>1 mesh scan
+    (trailing replicated pytree spec) and produces findings."""
+    from killerbeez_tpu.models.zoo import build_zoo
+    from killerbeez_tpu.parallel import ShardedCampaignDriver
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices (conftest forces 8 on CPU)")
+    t = build_zoo("zoo:tlv:depth=2,bug=1")
+    instr = instrumentation_factory("jit_harness", json.dumps(
+        {"target": t.name, "grammar": t.grammar.to_json()}))
+    mut = mutator_factory("havoc", '{"seed": 7}', t.seed)
+    drv = ShardedCampaignDriver("2,1", instr, mut, batch_size=128)
+    out = drv.test_batch_generations(128, 4).materialize()
+    assert sum(int(out.shard(d).fr_ptr) for d in range(2)) > 0
+
+
+def test_structured_campaign_cracks_certified_zoo_deep_edge(tmp_path):
+    """End to end at a deliberately small budget: the structured -G
+    campaign reaches a zoo family's certified deep edge (the A/B
+    bench additionally pins blind havoc to ZERO at 8x this budget —
+    bench.py --grammar --gate)."""
+    from killerbeez_tpu.models.zoo import build_zoo
+    t = build_zoo("zoo:tlv:depth=2,bug=1")
+    instr = instrumentation_factory("jit_harness", json.dumps(
+        {"target": t.name, "novelty": "throughput",
+         "grammar": t.grammar.to_json()}))
+    mut = mutator_factory("havoc", '{"seed": 7}', t.seed)
+    drv = driver_factory("file", None, instr, mut)
+    fz = Fuzzer(drv, output_dir=str(tmp_path / "crack"),
+                batch_size=256, write_findings=False,
+                generations=4, feedback=0)
+    fz.run(2048)
+    ef = np.asarray(t.program.edge_from)
+    et = np.asarray(t.program.edge_to)
+    slots = np.asarray(t.program.edge_slot)
+    vb = np.asarray(instr.virgin_bits)
+    hit = any(int(vb[slots[e]]) != 0xFF for e in range(len(et))
+              if (int(ef[e]), int(et[e])) == t.deep_edge)
+    assert hit and fz.stats.crashes > 0
+
+
+# ---------------------------------------------------------------------------
+# option plumbing / stand-down rules
+# ---------------------------------------------------------------------------
+
+
+def test_grammar_needs_xla_engine():
+    from killerbeez_tpu.parallel.distributed import _ShardKernels
+    k = _ShardKernels.__new__(_ShardKernels)
+    k.engine = "pallas_fused"
+    with pytest.raises(ValueError, match="xla engine"):
+        k.mutate_exec(None, None, None,
+                      grammar_tables=degenerate_tables().device())
+
+
+def test_grammar_and_learn_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        instrumentation_factory("jit_harness", json.dumps(
+            {"target": "test", "grammar": "degenerate", "learn": 1}))

@@ -1,0 +1,169 @@
+"""The generated target zoo (models/zoo.py + kb-zoo).
+
+Pins the zoo's contracts: every family instance certifies (lint
+clean, benign seed misses the deep edge and exits clean, witness
+crashes THROUGH it under exact concrete semantics), generation is
+deterministic, instances resolve through the ordinary target
+registry under ``zoo:`` names, bad names fail loudly, and the
+kb-zoo CLI round-trips list / certify / generate.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from killerbeez_tpu import FUZZ_CRASH, FUZZ_NONE
+from killerbeez_tpu.analysis.solver import concrete_run
+from killerbeez_tpu.models.targets import get_target
+from killerbeez_tpu.models.zoo import (
+    GATED_NAMES, build_zoo, certify_zoo, parse_zoo_name, zoo_families,
+    zoo_name,
+)
+from killerbeez_tpu.tools import zoo_tool
+
+ALL_INSTANCES = list(GATED_NAMES) + [
+    "zoo:tlv:depth=1,bug=0",
+    "zoo:tlv:depth=4,bug=2",
+    "zoo:chain:width=1,bug=0",
+    "zoo:chain:width=6,bug=4",
+    "zoo:cksum:style=xor,bug=0",
+]
+
+
+# ---------------------------------------------------------------------------
+# certification over the parameter space
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ALL_INSTANCES)
+def test_zoo_instance_certifies(name):
+    r = certify_zoo(name)
+    assert r["certified"], r
+    assert r["seed_benign"] and r["witness_crashes"]
+    assert not r["lint_errors"]
+
+
+@pytest.mark.parametrize("name", GATED_NAMES)
+def test_zoo_deep_edge_is_crash_coincident(name):
+    """The planted bug's verdict branch IS the crash: the witness
+    trace crosses the deep edge and dies, the benign seed does
+    neither — the property the bench gate's deep-slot metric reads."""
+    t = build_zoo(name)
+    seed_tr = concrete_run(t.program, t.seed)
+    crash_tr = concrete_run(t.program, t.crash)
+    assert seed_tr.status == FUZZ_NONE
+    assert t.deep_edge not in seed_tr.edges
+    assert crash_tr.status == FUZZ_CRASH
+    assert t.deep_edge in crash_tr.edges
+
+
+@pytest.mark.parametrize("name", GATED_NAMES)
+def test_zoo_deep_edge_has_collision_free_slot(name):
+    """The gate metric is honest only if the deep edge owns its AFL
+    slot — pinned per gated instance."""
+    t = build_zoo(name)
+    ef = np.asarray(t.program.edge_from)
+    et = np.asarray(t.program.edge_to)
+    slots = np.asarray(t.program.edge_slot)
+    deep = [e for e in range(len(et))
+            if (int(ef[e]), int(et[e])) == t.deep_edge]
+    assert deep
+    other = {int(slots[e]) for e in range(len(et)) if e not in deep}
+    assert {int(slots[e]) for e in deep} - other
+
+
+def test_zoo_generation_deterministic():
+    a = build_zoo("zoo:tlv:depth=2,bug=1")
+    b = build_zoo("zoo:tlv:depth=2,bug=1")
+    assert np.array_equal(np.asarray(a.program.instrs),
+                          np.asarray(b.program.instrs))
+    assert a.seed == b.seed and a.crash == b.crash
+    assert a.grammar.to_json() == b.grammar.to_json()
+
+
+def test_zoo_grammar_carries_trigger_token():
+    """The family grammar's command alphabet includes the trigger —
+    that is the whole crack mechanism (one token substitution)."""
+    from killerbeez_tpu.models.zoo import _tokens
+    for name in GATED_NAMES:
+        t = build_zoo(name)
+        _, trigger = _tokens(t.params["bug"])
+        alphas = [f for r in t.grammar.rules.values()
+                  for f in r.fields if f.kind == "token"]
+        assert alphas and any(trigger in a.alphabet for a in alphas)
+        assert trigger in t.crash and trigger not in t.seed
+
+
+# ---------------------------------------------------------------------------
+# names and registry resolution
+# ---------------------------------------------------------------------------
+
+
+def test_zoo_names_roundtrip_and_defaults():
+    fam, params = parse_zoo_name("zoo:tlv")
+    assert fam == "tlv" and params == zoo_families()["tlv"]
+    assert parse_zoo_name(zoo_name(fam, params))[1] == params
+    fam, params = parse_zoo_name("zoo:cksum:bug=2")
+    assert params["style"] == "sum" and params["bug"] == 2
+
+
+@pytest.mark.parametrize("bad,msg", [
+    ("tlv:depth=2", "not a zoo target"),
+    ("zoo:nosuch", "unknown zoo family"),
+    ("zoo:tlv:nope=1", "bad zoo parameter"),
+    ("zoo:tlv:depth=99", "out of range"),
+    ("zoo:cksum:style=crc", "sum or xor"),
+])
+def test_zoo_bad_names_fail_loudly(bad, msg):
+    with pytest.raises(ValueError, match=msg):
+        build_zoo(bad) if bad.startswith("zoo:") else \
+            parse_zoo_name(bad)
+
+
+def test_zoo_resolves_through_target_registry():
+    prog = get_target("zoo:chain:width=3,bug=1")
+    assert prog.name.startswith("zoo_chain")
+    with pytest.raises(ValueError, match="unknown zoo family"):
+        get_target("zoo:bogus")
+
+
+# ---------------------------------------------------------------------------
+# kb-zoo CLI
+# ---------------------------------------------------------------------------
+
+
+def test_kb_zoo_list(capsys):
+    assert zoo_tool.main(["list"]) == 0
+    out = capsys.readouterr().out
+    for fam in zoo_families():
+        assert fam in out
+    for n in GATED_NAMES:
+        assert n in out
+
+
+def test_kb_zoo_certify_json(capsys):
+    assert zoo_tool.main(["certify", "--json",
+                          "zoo:tlv:depth=1,bug=0"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["certified"]
+    assert doc["targets"][0]["name"] == "zoo:tlv:bug=0,depth=1"
+
+
+def test_kb_zoo_generate_bundle(tmp_path, capsys):
+    out = str(tmp_path / "bundle")
+    assert zoo_tool.main(["generate", "zoo:cksum:style=sum,bug=1",
+                          "--out", out]) == 0
+    for f in ("program.npz", "seed", "crash", "grammar.json",
+              "certificate.json"):
+        assert os.path.exists(os.path.join(out, f))
+    with open(os.path.join(out, "certificate.json")) as f:
+        assert json.load(f)["certified"]
+    # the npz round-trips through the ordinary program_file loader
+    from killerbeez_tpu.models.targets import load_program_from_options
+    prog = load_program_from_options(
+        {"program_file": os.path.join(out, "program.npz")}, "x")
+    with open(os.path.join(out, "crash"), "rb") as f:
+        crash = f.read()
+    assert concrete_run(prog, crash).status == FUZZ_CRASH

@@ -1226,6 +1226,127 @@ def bench_grammar(names=None, batch=512, execs=16384, g=4,
     return 0
 
 
+def bench_hybrid(batch=256, execs=65536, gate=False):
+    """--hybrid A/B lane: the hybrid campaign (TPU proxy coverage
+    guidance + cross-tier native confirmation, docs/HYBRID.md) vs the
+    native tier ALONE at equal wall clock, on the test/test-plain
+    proxy/native pair.
+
+    Lane B (hybrid): a coverage-guided proxy campaign on the ``test``
+    KBVM target with the ``test`` binding attached — every unique
+    proxy crash is replayed on corpus/build/test-plain and must come
+    back ``confirmed``.  Lane A (native alone): blind havoc straight
+    on the native binary for the SAME wall clock lane B took — the
+    only campaign mode the native tier has by itself here (no
+    coverage map without the KBVM proxy).  The 4-byte "ABCD" magic is
+    trivial for coverage guidance and a ~2^-24 lottery per blind
+    exec, so the A/B isolates what the hybrid bridge buys: native
+    ground truth at proxy discovery speed.
+
+    ``--gate``: lane B must record >= 1 native-CONFIRMED crash and
+    lane A must find 0.  Degrades to a {"skipped": ...} row (exit 0)
+    when the host toolchain is unavailable.  Artifact:
+    bench_out/BENCH_hybrid.json."""
+    import json as _json
+    import random
+    import shutil
+    from killerbeez_tpu import FUZZ_CRASH
+    from killerbeez_tpu.native.exec_backend import classify
+
+    os.makedirs(os.path.join(REPO, "bench_out"), exist_ok=True)
+    art = os.path.join(REPO, "bench_out", "BENCH_hybrid.json")
+    if not build_corpus():
+        row = emit("hybrid-skip",
+                   "hybrid A/B skipped: native toolchain / corpus "
+                   "build unavailable", 0.0, unit="skipped",
+                   skipped="native build unavailable")
+        with open(art, "w") as f:
+            json.dump({"rows": [row], "ok": None,
+                       "skipped": "native build unavailable"}, f,
+                      indent=1)
+        return 0
+
+    from killerbeez_tpu.drivers.factory import driver_factory
+    from killerbeez_tpu.fuzzer.loop import Fuzzer
+    from killerbeez_tpu.hybrid import make_bridge
+    from killerbeez_tpu.hybrid.registry import open_native
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    from killerbeez_tpu.mutators.factory import mutator_factory
+
+    rows = []
+    seed = b"AAAA"
+
+    # lane B: hybrid — proxy coverage guidance + native confirmation
+    bridge = make_bridge("test", repeats=3, queue_cap=64, workers=1)
+    instr = instrumentation_factory(
+        "jit_harness", _json.dumps({"target": "test",
+                                    "novelty": "throughput"}))
+    mut = mutator_factory("havoc", '{"seed": 7}', seed)
+    drv = driver_factory("file", None, instr, mut)
+    out = os.path.join(REPO, "bench_out", "hybrid_ab")
+    shutil.rmtree(out, ignore_errors=True)
+    fz = Fuzzer(drv, output_dir=out, batch_size=batch,
+                write_findings=False, feedback=8, hybrid=bridge)
+    t0 = time.time()
+    stats = fz.run(execs)
+    t_hybrid = max(time.time() - t0, 1e-9)
+    c = fz.telemetry.registry.snapshot()["counters"]
+    confirmed = int(c.get("hybrid_confirmed", 0))
+    rows.append(emit(
+        "hybrid-campaign",
+        f"hybrid campaign on test/test-plain (-b {batch}, {execs} "
+        f"proxy execs + native confirmation)",
+        stats.iterations / t_hybrid,
+        proxy_crashes=stats.crashes,
+        validated=int(c.get("hybrid_validations", 0)),
+        confirmed=confirmed,
+        proxy_only=int(c.get("hybrid_proxy_only", 0)),
+        flaky=int(c.get("hybrid_flaky", 0)),
+        native_execs=bridge.native_execs,
+        wall_s=round(t_hybrid, 2)))
+
+    # lane A: native alone — blind havoc for the same wall clock
+    target = open_native(bridge.binding.native)
+    rng = random.Random(7)
+    n_execs = 0
+    native_crashes = 0
+    t0 = time.time()
+    try:
+        while time.time() - t0 < t_hybrid:
+            buf = bytearray(seed)
+            for _ in range(rng.randint(1, 4)):
+                buf[rng.randrange(len(buf))] = rng.randrange(256)
+            kind, _ = classify(target.run(bytes(buf)))
+            n_execs += 1
+            if kind == FUZZ_CRASH:
+                native_crashes += 1
+    finally:
+        target.close()
+    t_native = max(time.time() - t0, 1e-9)
+    rows.append(emit(
+        "hybrid-native-alone",
+        f"native tier alone: blind havoc on test-plain for "
+        f"{t_hybrid:.1f}s (equal wall clock)",
+        n_execs / t_native, crashes=native_crashes,
+        execs=n_execs, wall_s=round(t_native, 2)))
+
+    ok = confirmed >= 1 and native_crashes == 0
+    if confirmed < 1:
+        print("FAIL: hybrid lane recorded no native-confirmed crash",
+              file=sys.stderr)
+    if native_crashes != 0:
+        print(f"FAIL: blind native lane found {native_crashes} "
+              f"crashes — the A/B no longer isolates coverage "
+              f"guidance", file=sys.stderr)
+    with open(art, "w") as f:
+        json.dump({"rows": rows, "ok": ok}, f, indent=1)
+    if gate and not ok:
+        return 1
+    return 0
+
+
 BENCH_R05_GATE = 1807549.5   # BENCH_r05 headline: execs/s/chip,
 #                              fused-pallas superbatch on tlvstack_vm
 
@@ -1726,6 +1847,25 @@ def main():
             return 2
         return bench_stateful(targets=tgts or None, batch=batch,
                               execs=execs, gate=gate)
+
+    if "--hybrid" in sys.argv[1:]:
+        # hybrid cross-tier A/B mode:
+        #   python bench.py --hybrid [-b BATCH] [-n EXECS] [--gate]
+        rest = [a for a in sys.argv[1:] if a != "--hybrid"]
+        gate = "--gate" in rest
+        rest = [a for a in rest if a != "--gate"]
+        batch, execs = 256, 65536
+        j = 0
+        while j < len(rest):
+            if rest[j] == "-b":
+                batch = int(rest[j + 1]); j += 2
+            elif rest[j] == "-n":
+                execs = int(rest[j + 1]); j += 2
+            else:
+                print(f"error: unknown --hybrid arg {rest[j]!r}",
+                      file=sys.stderr)
+                return 2
+        return bench_hybrid(batch=batch, execs=execs, gate=gate)
 
     if "--crack" in sys.argv[1:]:
         # plateau-crack A/B mode:

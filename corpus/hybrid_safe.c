@@ -1,0 +1,37 @@
+/* Deliberately-divergent hybrid fixture (docs/HYBRID.md): same input
+ * interface as test.c (argv[1] file, else stdin) and the same benign
+ * behavior — prints matched depth, exits 0 — but NEVER crashes, even
+ * on the full "ABCD" magic.  Binding a KBVM "test" proxy against this
+ * binary certifies clean (benign seeds agree) yet every proxy crash
+ * replays clean natively, so cross-tier triage must produce
+ * `proxy_only` verdicts and proxy-gap reports.  That is the fixture's
+ * whole job: a controlled semantic gap for validating the gap path.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <unistd.h>
+
+static int check(const unsigned char *buf, size_t n) {
+  if (n < 1 || buf[0] != 'A') return 0;
+  if (n < 2 || buf[1] != 'B') return 1;
+  if (n < 3 || buf[2] != 'C') return 2;
+  if (n < 4 || buf[3] != 'D') return 3;
+  /* proxy dies here; we just report the match */
+  return 4;
+}
+
+int main(int argc, char **argv) {
+  unsigned char buf[64];
+  size_t n;
+  if (argc > 1) {
+    FILE *f = fopen(argv[1], "rb");
+    if (!f) return 1;
+    n = fread(buf, 1, sizeof(buf), f);
+    fclose(f);
+  } else {
+    ssize_t r = read(0, buf, sizeof(buf));
+    n = r > 0 ? (size_t)r : 0;
+  }
+  printf("matched %d bytes\n", check(buf, n));
+  return 0;
+}

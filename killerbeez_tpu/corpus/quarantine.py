@@ -47,7 +47,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..utils.fileio import ensure_dir, md5_hex
 from ..utils.logging import WARNING_MSG
-from .store import CorpusEntry, coverage_hash
+from .store import CorpusEntry, VALIDATION_VERDICTS, coverage_hash
 
 #: quarantine subdirectory under a corpus store root
 QUARANTINE_DIR = "quarantine"
@@ -168,6 +168,45 @@ class EntryValidator:
             if nb is not None and not (isinstance(nb, int)
                                        and 0 <= nb <= len(buf)):
                 return None, "schema:provenance"
+        tier = meta.get("tier")
+        if tier is not None:
+            # hybrid tier tag (docs/HYBRID.md): a short identifier —
+            # a peer must not be able to ship arbitrary blobs through
+            # the per-tier fold.  Old rows without it pass untouched.
+            if not isinstance(tier, str) or not (0 < len(tier) <= 32) \
+                    or not all(c.isalnum() or c in "-_" for c in tier):
+                return None, "schema:tier"
+        val = meta.get("validation")
+        if val is not None:
+            # cross-tier verdict write-back (hybrid bridge): verdict
+            # from the fixed taxonomy plus bounded numeric fields —
+            # the claim "native-confirmed" steers scheduling, so its
+            # shape is checked as strictly as provenance.
+            if not isinstance(val, dict):
+                return None, "schema:validation"
+            if val.get("verdict") not in VALIDATION_VERDICTS:
+                return None, "schema:validation"
+            vtier = val.get("tier")
+            if vtier is not None and not (isinstance(vtier, str)
+                                          and len(vtier) <= 32):
+                return None, "schema:validation"
+            for key in ("repro", "repeats", "attempts"):
+                v = val.get(key)
+                if v is not None and not (isinstance(v, int)
+                                          and 0 <= v <= 4096):
+                    return None, "schema:validation"
+            t = val.get("t")
+            if t is not None and not isinstance(t, (int, float)):
+                return None, "schema:validation"
+            sts = val.get("statuses")
+            if sts is not None:
+                if not isinstance(sts, list) or len(sts) > 64 or \
+                        not all(isinstance(s, int) for s in sts):
+                    return None, "schema:validation"
+            detail = val.get("detail")
+            if detail is not None and not (isinstance(detail, str)
+                                           and len(detail) <= 256):
+                return None, "schema:validation"
         for key in ("selections", "finds", "discovered", "seq"):
             v = meta.get(key)
             if v is not None and not isinstance(v, (int, float)):

@@ -39,7 +39,8 @@ class Arm(list):
     metadata as attributes."""
 
     __slots__ = ("md5", "seq", "sig", "state_sig", "parent",
-                 "source", "discovered", "provenance")
+                 "source", "discovered", "provenance", "tier",
+                 "validation")
 
     def __init__(self, buf: bytes, selections: float = 0.0,
                  finds: float = 0.0, md5: Optional[str] = None,
@@ -47,7 +48,8 @@ class Arm(list):
                  parent: Optional[str] = None, source: str = "local",
                  discovered: Optional[float] = None,
                  state_sig: Optional[List] = None,
-                 provenance=None):
+                 provenance=None, tier: Optional[str] = None,
+                 validation=None):
         super().__init__([bytes(buf), selections, finds])
         self.md5 = md5 or md5_hex(buf)
         self.seq = int(seq)
@@ -57,6 +59,10 @@ class Arm(list):
         #: mutation provenance (learn tier): set at admission, rides
         #: into the entry sidecar
         self.provenance = provenance
+        #: hybrid campaign tags: minting tier + cross-tier verdict
+        #: (docs/HYBRID.md) — ride into/out of the entry sidecar
+        self.tier = tier
+        self.validation = validation
         self.source = source
         self.discovered = discovered
 
@@ -74,7 +80,8 @@ class Arm(list):
             edge_hits=None, selections=float(self[1]),
             finds=float(self[2]), parent=self.parent,
             source=self.source, discovered=self.discovered,
-            state_sig=self.state_sig, provenance=self.provenance)
+            state_sig=self.state_sig, provenance=self.provenance,
+            tier=self.tier, validation=self.validation)
 
     @classmethod
     def from_entry(cls, e: CorpusEntry) -> "Arm":
@@ -82,7 +89,9 @@ class Arm(list):
                    md5=e.md5, seq=e.seq, sig=e.sig, parent=e.parent,
                    source=e.source, discovered=e.discovered,
                    state_sig=e.state_sig,
-                   provenance=getattr(e, "provenance", None))
+                   provenance=getattr(e, "provenance", None),
+                   tier=getattr(e, "tier", None),
+                   validation=getattr(e, "validation", None))
 
 
 class Scheduler:
@@ -103,11 +112,23 @@ class Scheduler:
     #: policy so observability and resume see comparable stats)
     DECAY = 0.8
 
+    #: find-equivalent credit for a native-confirmed verdict (hybrid
+    #: bridge): ground truth on the real binary is worth a full find
+    CONFIRM_CREDIT = 1.0
+
+    #: cap on the remembered confirmed-md5 set (enough for any real
+    #: campaign; bounds resume state)
+    CONFIRM_CAP = 4096
+
     def __init__(self, cap: Optional[int] = None):
         self.arms: List[Arm] = []
         self.base_stats: List[float] = [0.0, 0.0]  # [selections, finds]
         self.base_seed: Optional[bytes] = None
         self.rotations = 0
+        #: md5s whose findings the native tier confirmed on the real
+        #: binary (hybrid bridge write-back; docs/HYBRID.md) — the
+        #: cross-tier credit boost keys off membership here
+        self.confirmed_md5s: set = set()
         if cap is not None:
             self.CAP = int(cap)
         # deterministic splice/choice stream — the loop's historical
@@ -170,6 +191,27 @@ class Scheduler:
         else:
             active[1] += 1
 
+    def note_validation(self, md5: str, verdict: str,
+                        parent: Optional[str] = None) -> None:
+        """Fold one cross-tier verdict (hybrid bridge).  A
+        ``confirmed`` verdict — the finding reproduced on the real
+        native binary — marks the finding AND its generating seed
+        (``parent``) confirmed, and credits any arm carrying either
+        md5 with a find-equivalent boost (RareEdgeScheduler
+        additionally sharpens their rarity).  Idempotent per finding
+        md5; other verdicts are recorded nowhere here (proxy_only
+        feeds the proxy-gap report, not scheduling).  With no hybrid
+        bridge attached this is never called, so every policy's
+        ordering is exactly the historical one (parity-pinned)."""
+        if verdict != "confirmed" or md5 in self.confirmed_md5s:
+            return
+        for m in (md5, parent):
+            if m and len(self.confirmed_md5s) < self.CONFIRM_CAP:
+                self.confirmed_md5s.add(m)
+        for arm in self.arms:
+            if arm.md5 == md5 or (parent and arm.md5 == parent):
+                arm[2] += self.CONFIRM_CREDIT
+
     # -- selection ------------------------------------------------------
 
     def select(self) -> Tuple[Optional[int], Optional[bytes]]:
@@ -187,19 +229,26 @@ class Scheduler:
 
     def state_dict(self) -> Dict[str, Any]:
         st = self.rng.getstate()
-        return {
+        d = {
             "scheduler": self.name,
             "base_stats": list(self.base_stats),
             "rotations": self.rotations,
             "rng_state": [st[0], list(st[1]), st[2]],
             "seq": self._seq,
         }
+        # only hybrid campaigns carry verdict state — pre-hybrid
+        # checkpoints stay byte-identical in shape
+        if self.confirmed_md5s:
+            d["confirmed"] = sorted(self.confirmed_md5s)
+        return d
 
     def load_state(self, d: Dict[str, Any]) -> None:
         self.base_stats = [float(v) for v in
                            d.get("base_stats", [0.0, 0.0])]
         self.rotations = int(d.get("rotations", 0))
         self._seq = int(d.get("seq", self._seq))
+        self.confirmed_md5s = set(
+            str(m) for m in d.get("confirmed", []))
         rs = d.get("rng_state")
         if rs:
             self.rng.setstate((rs[0], tuple(rs[1]), rs[2]))
@@ -361,12 +410,23 @@ class RareEdgeScheduler(Scheduler):
         self._forget(arm)
         return arm
 
+    #: rarity scale for native-confirmed arms: a confirmed seed's
+    #: rarest edge counts as half as common, so at equal raw rarity
+    #: ground-truthed frontier outranks proxy-only frontier — the
+    #: cross-tier extension of FairFuzz rarity (docs/HYBRID.md).
+    #: With an empty confirmed set the scale never applies and the
+    #: ordering is exactly the historical one.
+    CONFIRM_RARITY_SCALE = 0.5
+
     def _rarity(self, arm: Arm) -> float:
         if not arm.sig:
             # unsigned: probe once (rarity 0 beats everything), then
             # deprioritize below any signed arm
             return 0.0 if arm[1] == 0 else float("inf")
-        return min(self.edge_hits.get(e, 1) for e in arm.sig)
+        r = float(min(self.edge_hits.get(e, 1) for e in arm.sig))
+        if self.confirmed_md5s and arm.md5 in self.confirmed_md5s:
+            r *= self.CONFIRM_RARITY_SCALE
+        return r
 
     def select(self) -> Tuple[Optional[int], Optional[bytes]]:
         if not self.arms:

@@ -38,7 +38,17 @@ Sidecar schema (docs/CORPUS.md):
                                       # docs/LEARN.md) — optional,
                                       # pre-learn sidecars omit it
      "source": "local" | "sync",
-     "discovered": unix_time}
+     "discovered": unix_time,
+     "tier": "tpu" | "native" | ... | null,  # execution tier that
+                                      # minted the entry (hybrid
+                                      # campaigns; docs/HYBRID.md) —
+                                      # pre-hybrid sidecars omit it
+     "validation": {"verdict": "confirmed" | "proxy_only" | "flaky",
+                    "tier": ..., "repro": N, "repeats": N,
+                    "attempts": N, "statuses": [...], "t": unix_time}
+                                      # | null — cross-tier verdict
+                                      # written back by the hybrid
+                                      # bridge (docs/HYBRID.md)
 
 Every write is atomic (tmp file + ``os.replace``, the telemetry
 sink's discipline) so a tailer or a crash mid-write never leaves a
@@ -68,6 +78,11 @@ _RESERVED = (STATE_FILE, MUTATOR_STATE_FILE, INSTR_STATE_FILE,
              SOLVER_STATE_FILE, CHECKPOINT_FILE,
              CHECKPOINT_FILE + _ckpt.PREV_SUFFIX)
 
+# Cross-tier validation verdicts (hybrid bridge; docs/HYBRID.md).
+# Shared by the sidecar schema, EntryValidator bounds and the hybrid
+# validator itself so the taxonomy cannot drift between layers.
+VALIDATION_VERDICTS = ("confirmed", "proxy_only", "flaky")
+
 
 def coverage_hash(sig: Optional[List[int]],
                   buf: Optional[bytes] = None,
@@ -95,7 +110,8 @@ class CorpusEntry:
 
     __slots__ = ("buf", "md5", "seq", "sig", "state_sig", "edge_hits",
                  "selections", "finds", "parent", "source",
-                 "discovered", "cov_hash", "provenance")
+                 "discovered", "cov_hash", "provenance", "tier",
+                 "validation")
 
     def __init__(self, buf: bytes, md5: Optional[str] = None,
                  seq: int = 0, sig: Optional[List[int]] = None,
@@ -105,7 +121,9 @@ class CorpusEntry:
                  discovered: Optional[float] = None,
                  cov_hash: Optional[str] = None,
                  state_sig: Optional[List] = None,
-                 provenance: Optional[Dict[str, Any]] = None):
+                 provenance: Optional[Dict[str, Any]] = None,
+                 tier: Optional[str] = None,
+                 validation: Optional[Dict[str, Any]] = None):
         self.buf = bytes(buf)
         self.md5 = md5 or md5_hex(self.buf)
         self.seq = int(seq)
@@ -125,6 +143,12 @@ class CorpusEntry:
         self.provenance = (dict(provenance)
                            if isinstance(provenance, dict) else None)
         self.source = source
+        # hybrid campaign tags (optional): the tier that minted this
+        # entry and the cross-tier validation verdict written back by
+        # the hybrid bridge — pre-hybrid sidecars load unchanged
+        self.tier = str(tier) if tier else None
+        self.validation = (dict(validation)
+                           if isinstance(validation, dict) else None)
         self.discovered = (time.time() if discovered is None
                            else float(discovered))
         self.cov_hash = cov_hash or coverage_hash(
@@ -140,6 +164,7 @@ class CorpusEntry:
             "parent": self.parent, "provenance": self.provenance,
             "source": self.source,
             "discovered": self.discovered,
+            "tier": self.tier, "validation": self.validation,
         }
 
     @classmethod
@@ -154,7 +179,9 @@ class CorpusEntry:
                    discovered=meta.get("discovered"),
                    cov_hash=meta.get("cov_hash"),
                    state_sig=meta.get("state_sig"),
-                   provenance=meta.get("provenance"))
+                   provenance=meta.get("provenance"),
+                   tier=meta.get("tier"),
+                   validation=meta.get("validation"))
 
 
 def _atomic_write(path: str, data: bytes) -> None:
@@ -244,6 +271,29 @@ class CorpusStore:
         except OSError as e:
             WARNING_MSG("corpus sidecar update failed for %s: %s",
                         entry.md5, e)
+
+    def update_validation(self, md5: str,
+                          validation: Dict[str, Any]) -> bool:
+        """Fold a cross-tier verdict into one entry's sidecar (hybrid
+        bridge write-back).  Reads the sidecar as stored rather than
+        regenerating it from an in-memory entry so concurrently
+        flushed stats are not clobbered; returns False when no
+        sidecar exists for ``md5`` (findings that never became corpus
+        entries live only in the findings sidecar)."""
+        path = self.meta_path(md5)
+        try:
+            with open(path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            return False
+        meta["validation"] = dict(validation)
+        try:
+            _atomic_write(path, json.dumps(meta).encode())
+        except OSError as e:
+            WARNING_MSG("corpus validation update failed for %s: %s",
+                        md5, e)
+            return False
+        return True
 
     def remove(self, md5: str) -> None:
         for p in (self.entry_path(md5), self.meta_path(md5)):

@@ -125,6 +125,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "sidecar at (default: its bind address; set "
                         "when NAT or 0.0.0.0 binds make that "
                         "unreachable/ambiguous)")
+    p.add_argument("--hybrid", metavar="BINDING", default=None,
+                   help="hybrid native⇄TPU campaign (docs/HYBRID.md): "
+                        "certify the named proxy binding (kb-fuzz "
+                        "refuses a binding whose benign seed diverges "
+                        "across tiers), then validate every unique "
+                        "TPU finding on the real native binary — "
+                        "confirmed/proxy_only/flaky verdicts land in "
+                        "corpus sidecars and the event stream, "
+                        "proxy_only divergences emit machine-readable "
+                        "proxy-gap reports under <output>/proxy_gaps/."
+                        "  Built-ins: " + "test, test_safe")
+    p.add_argument("--hybrid-repeats", type=int, default=3,
+                   metavar="N",
+                   help="native replays per finding before a verdict "
+                        "(default 3: all crash = confirmed, none = "
+                        "proxy_only, else flaky)")
+    p.add_argument("--hybrid-queue", type=int, default=256,
+                   metavar="N",
+                   help="validation queue bound (default 256); a full "
+                        "queue rejects new findings with a counted, "
+                        "logged drop — never silently")
+    p.add_argument("--hybrid-workers", type=int, default=1,
+                   metavar="N",
+                   help="native validator threads (default 1; 0 = "
+                        "validate synchronously at fold points — "
+                        "deterministic, for tests)")
     p.add_argument("--crack", type=int, nargs="?", const=16, default=0,
                    metavar="N",
                    help="plateau crack stage (KBVM device targets): "
@@ -551,6 +577,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                 train_interval_s=args.learn_interval,
                 max_len=getattr(mutator, "max_length", 4096))
 
+        hybrid_bridge = None
+        if args.hybrid:
+            from ..hybrid import CertificationError, make_bridge
+            try:
+                hybrid_bridge = make_bridge(
+                    args.hybrid, repeats=args.hybrid_repeats,
+                    queue_cap=args.hybrid_queue,
+                    workers=args.hybrid_workers)
+            except (KeyError, CertificationError,
+                    RuntimeError) as e:
+                # stand-down rule (docs/HYBRID.md): no native
+                # substrate / divergent binding -> refuse the hybrid
+                # campaign rather than run one that cannot validate
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+
         fuzzer = Fuzzer(driver, output_dir=args.output,
                         batch_size=args.batch_size,
                         debug_triage=args.debug_triage,
@@ -567,7 +609,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                         events_max_mb=args.events_max_mb,
                         watchdog=watchdog,
                         generations=args.generations,
-                        learn=learn_tier)
+                        learn=learn_tier,
+                        hybrid=hybrid_bridge)
+        native_beat = None
+        if hybrid_bridge is not None and args.sync_manager and \
+                args.sync_campaign:
+            # the native tier as a fleet citizen: its own heartbeat
+            # row (meta tier "native") beside the TPU worker's, so
+            # kb-fleet's per-tier fold sees both (docs/HYBRID.md)
+            from ..hybrid import NativeHeartbeat
+            native_beat = NativeHeartbeat(
+                hybrid_bridge, args.sync_manager, args.sync_campaign,
+                args.sync_worker or f"worker-{os.getpid()}",
+                interval=args.sync_interval)
+            native_beat.start()
         if args.schedule == "rare-edge":
             _wire_rare_edge_signer(fuzzer, driver)
             _wire_static_prior(fuzzer, driver)
@@ -616,6 +671,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"error: device lost: {e}", file=sys.stderr)
                 return DEVICE_LOST_EXIT_CODE
             raise
+        finally:
+            if native_beat is not None:
+                native_beat.stop()   # posts one parting beat
         # both rates read the SAME registry the loop recorded into —
         # the CLI never recomputes from its own wall clock
         INFO_MSG(

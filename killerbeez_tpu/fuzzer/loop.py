@@ -173,7 +173,8 @@ class Fuzzer:
                  events_max_mb: float = 0.0,
                  watchdog=None,
                  generations: int = 0,
-                 learn=None):
+                 learn=None,
+                 hybrid=None):
         self.driver = driver
         self.output_dir = output_dir
         self.batch_size = int(batch_size)
@@ -310,6 +311,14 @@ class Fuzzer:
         #: boundaries for the host-driven loop.  None = off (the
         #: exact historical paths compile).
         self.learn = learn
+        #: hybrid native⇄TPU bridge (killerbeez_tpu/hybrid/): unique
+        #: findings enqueue for native validation in _triage_lane,
+        #: verdicts fold back beside every sync round.  None = off
+        #: (the exact historical paths run).
+        self.hybrid = hybrid
+        #: tier tag stamped onto minted corpus entries
+        #: (docs/HYBRID.md sidecar schema); this loop is the TPU tier
+        self.tier_tag = "tpu"
         self.stats = FuzzStats(telemetry.registry)
         self._seen = {k: set() for k in ("crashes", "hangs", "new_paths")}
         if write_findings:
@@ -644,7 +653,8 @@ class Fuzzer:
         host loop's.  Never mints a duplicate arm (resume replays and
         ring replays re-present known digests)."""
         reg = self.telemetry.registry
-        arm = Arm(buf, parent=parent, discovered=time.time())
+        arm = Arm(buf, parent=parent, discovered=time.time(),
+                  tier=self.tier_tag)
         if self._signer is not None:
             try:
                 arm.sig = self._signer(buf)
@@ -711,6 +721,14 @@ class Fuzzer:
                     "crash", md5=digest,
                     crashes=int(s.crashes),
                     unique_crashes=int(s.unique_crashes))
+                if self.hybrid is not None:
+                    # cross-tier triage (docs/HYBRID.md): one native
+                    # validation per UNIQUE finding — the dedup above
+                    # is the rate limit
+                    self.hybrid.enqueue(
+                        "crash", buf, digest,
+                        parent=getattr(self._credit_arm, "md5", None),
+                        proxy_status=status)
                 if self.debug_triage:
                     self._debug_repro(buf)
         elif status == FUZZ_HANG:
@@ -722,6 +740,11 @@ class Fuzzer:
                 self.telemetry.event(
                     "hang", md5=digest, hangs=int(s.hangs),
                     unique_hangs=int(s.unique_hangs))
+                if self.hybrid is not None:
+                    self.hybrid.enqueue(
+                        "hang", buf, digest,
+                        parent=getattr(self._credit_arm, "md5", None),
+                        proxy_status=status)
         elif status == FUZZ_ERROR:
             s.errors += 1
             WARNING_MSG("target exec error on iteration %d", s.iterations)
@@ -812,6 +835,11 @@ class Fuzzer:
             # runs on clean exits AND interrupts, so --resume
             # continues exactly here
             self._persist_campaign(force=True)
+            # hybrid bridge drain BEFORE the forced sync: verdicts
+            # from in-flight native validations must land in sidecars
+            # and the event stream before the final push
+            if self.hybrid is not None:
+                self.hybrid.finish(self)
             # one forced sync round AFTER the drain: entries triaged
             # there (a short campaign triages everything in it) must
             # still reach the fleet
@@ -1253,6 +1281,8 @@ class Fuzzer:
         self._persist_campaign()
         if self.sync is not None:
             self.sync.maybe_sync(self)
+        if self.hybrid is not None:
+            self.hybrid.fold(self)
 
     def _drain_ready(self, pending) -> None:
         """Triage every leading pending batch whose device results are
@@ -1414,6 +1444,8 @@ class Fuzzer:
                 self._persist_campaign()
                 if self.sync is not None:
                     self.sync.maybe_sync(self)
+                if self.hybrid is not None:
+                    self.hybrid.fold(self)
         finally:
             # findings in already-executed batches must survive an
             # interrupt (Ctrl-C on an infinite run) or a raise
@@ -1650,6 +1682,8 @@ class Fuzzer:
                     self._persist_campaign()
                     if self.sync is not None:
                         self.sync.maybe_sync(self)
+                    if self.hybrid is not None:
+                        self.hybrid.fold(self)
             finally:
                 while pending:
                     self._drain_generations(*pending.popleft())
@@ -1715,3 +1749,5 @@ class Fuzzer:
             self._persist_campaign()
             if self.sync is not None:
                 self.sync.maybe_sync(self)
+            if self.hybrid is not None:
+                self.hybrid.fold(self)

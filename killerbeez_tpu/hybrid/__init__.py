@@ -1,0 +1,52 @@
+"""Hybrid native⇄TPU campaign bridge (docs/HYBRID.md).
+
+The PTrix split (PAPERS.md, arxiv 1905.10499) applied across this
+repo's two execution tiers: the TPU tier explores cheap soft-KBVM
+proxies at millions of execs/s, native workers confirm findings on
+the real binary, and both tiers share one corpus / event / fleet
+stream.  Four pieces:
+
+  * :mod:`.registry`  — declarative proxy⇄native bindings with a
+    bind-time certification check (benign seed behaves identically
+    on both sides);
+  * :mod:`.translate` — lossless, property-tested seed translation
+    between TPU byte buffers and native delivery formats (stdin,
+    file, argv, framed TCP/stdin message trains);
+  * :mod:`.validate`  — the cross-tier triage pipeline: bounded
+    validation queue, native replay with retry/backoff, ``confirmed``
+    / ``proxy_only`` / ``flaky`` verdicts, proxy-gap reports;
+  * :mod:`.reconcile` — per-tier coverage reconciliation: tier tags
+    on entries / heartbeats / gossip rows, per-tier fleet folds, the
+    native-tier heartbeat.
+"""
+
+from .registry import (  # noqa: F401
+    CertificationError,
+    NativeSpec,
+    ProxyBinding,
+    bind,
+    binding_names,
+    builtin_bindings,
+    certify_binding,
+    get_binding,
+    register_binding,
+)
+from .translate import (  # noqa: F401
+    NativeDelivery,
+    from_delivery,
+    to_delivery,
+)
+from .validate import (  # noqa: F401
+    VERDICT_CONFIRMED,
+    VERDICT_FLAKY,
+    VERDICT_PROXY_ONLY,
+    HybridBridge,
+    NativeValidator,
+    ValidationQueue,
+    make_bridge,
+)
+from .reconcile import (  # noqa: F401
+    DEFAULT_TIER,
+    NativeHeartbeat,
+    tier_of,
+)

@@ -1,0 +1,277 @@
+"""Proxy registry: declarative native⇄KBVM bindings + certification.
+
+A :class:`ProxyBinding` ties a native target (argv / stdin / file /
+TCP driver spec — the reference's driver layer, PAPER.md L2) to the
+soft-KBVM proxy program the TPU tier fuzzes in its place.  ``bind()``
+runs a CERTIFICATION check first: the binding's benign seed must
+behave identically on both sides (same FUZZ verdict class).  A
+binding that fails certification is refused — a proxy that diverges
+on a benign input would make every cross-tier verdict meaningless.
+
+Certification uses a BENIGN seed on purpose: a proxy that diverges
+only on crashing inputs still binds, and that divergence surfaces
+later as a ``proxy_only`` verdict plus a machine-readable proxy-gap
+report — the signal for improving the proxy, never a silent drop
+(docs/HYBRID.md).
+
+When the native toolchain is absent, certification returns a
+skip-with-reason record (``certified: None``) instead of failing:
+the stand-down rule is "no native tier, no hybrid claims".
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import FUZZ_CRASH, FUZZ_HANG, FUZZ_NONE, FUZZ_RUNNING
+from .translate import DELIVERY_MODES, NativeDelivery, to_delivery
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: where the corpus fixture binaries land (corpus/Makefile)
+CORPUS_BUILD_DIR = os.environ.get(
+    "KB_CORPUS_BUILD_DIR", os.path.join(_REPO_ROOT, "corpus", "build"))
+
+
+class CertificationError(ValueError):
+    """A binding's benign seed behaves differently on proxy vs
+    native — the binding is refused."""
+
+
+@dataclass
+class NativeSpec:
+    """How to run the native side of a binding (driver spec)."""
+
+    argv: Tuple[str, ...]
+    #: one of translate.DELIVERY_MODES
+    delivery: str = "stdin"
+    #: file mode: the input path to pass (exec_backend substitutes)
+    input_file: Optional[str] = None
+    #: train modes: the framed-sequence message cap (PR 12 m_max)
+    m_max: int = 0
+    #: tcp mode: (host, port) the launched server listens on
+    addr: Optional[Tuple[str, int]] = None
+    timeout: float = 2.0
+    env: Dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.argv = tuple(self.argv)
+        if self.delivery not in DELIVERY_MODES:
+            raise ValueError(
+                f"unknown delivery {self.delivery!r} "
+                f"(choose from {', '.join(DELIVERY_MODES)})")
+        if self.delivery in ("stdin_train", "tcp") and self.m_max <= 0:
+            raise ValueError(
+                f"delivery {self.delivery!r} needs m_max > 0")
+
+
+@dataclass
+class ProxyBinding:
+    """One native target and its soft-KBVM proxy."""
+
+    name: str
+    #: built-in KBVM target name (models/targets.py registry)
+    proxy_target: str
+    native: NativeSpec
+    #: certification input: must be verdict-identical on both sides
+    benign_seed: bytes = b"hello"
+
+    def program(self):
+        from ..models.targets import get_target
+        return get_target(self.proxy_target)
+
+    def translate(self, buf: bytes) -> NativeDelivery:
+        return to_delivery(buf, self.native.delivery,
+                           self.native.m_max)
+
+
+# -- registry ---------------------------------------------------------
+
+_BINDINGS: Dict[str, ProxyBinding] = {}
+
+
+def register_binding(binding: ProxyBinding) -> ProxyBinding:
+    _BINDINGS[binding.name] = binding
+    return binding
+
+
+def get_binding(name: str) -> ProxyBinding:
+    _ensure_builtins()
+    try:
+        return _BINDINGS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown proxy binding {name!r} (choose from "
+            f"{', '.join(sorted(_BINDINGS)) or '<none>'})")
+
+
+def binding_names() -> List[str]:
+    _ensure_builtins()
+    return sorted(_BINDINGS)
+
+
+_BUILTINS_DONE = False
+
+
+def _ensure_builtins() -> None:
+    global _BUILTINS_DONE
+    if _BUILTINS_DONE:
+        return
+    _BUILTINS_DONE = True
+    for b in builtin_bindings():
+        _BINDINGS.setdefault(b.name, b)
+
+
+def builtin_bindings() -> List[ProxyBinding]:
+    """The shipped proxy⇄native pairs (corpus/ fixtures).
+
+    * ``test`` — the KBVM "test" target and its native twin
+      ``corpus/test.c`` (both crash on inputs starting "ABCD"): the
+      faithful pair, every TPU finding should confirm.
+    * ``test_safe`` — the same proxy bound to ``corpus/hybrid_safe.c``
+      (reads input, always exits 0): the DELIBERATELY DIVERGENT pair
+      — benign certification passes, crashes never reproduce, every
+      crash verdict is ``proxy_only``.  Exists to exercise the
+      proxy-gap path end to end.
+    """
+    d = CORPUS_BUILD_DIR
+    return [
+        ProxyBinding(
+            name="test", proxy_target="test",
+            native=NativeSpec(argv=(os.path.join(d, "test-plain"),),
+                              delivery="stdin"),
+            benign_seed=b"hello"),
+        ProxyBinding(
+            name="test_safe", proxy_target="test",
+            native=NativeSpec(argv=(os.path.join(d, "hybrid-safe"),),
+                              delivery="stdin"),
+            benign_seed=b"hello"),
+    ]
+
+
+# -- execution (both sides) -------------------------------------------
+
+def proxy_verdict(binding: ProxyBinding, buf: bytes) -> int:
+    """Run one input through the soft-KBVM proxy; returns the FUZZ_*
+    verdict with the step-budget lane mapped to FUZZ_HANG (the
+    engine's wait-loop-timeout convention)."""
+    import numpy as np
+
+    from ..models import vm
+
+    program = binding.program()
+    data = np.frombuffer(bytes(buf) or b"\x00", dtype=np.uint8)
+    inputs = data[None, :]
+    lengths = np.array([len(bytes(buf))], dtype=np.int32)
+    out = vm.run_batch(program, inputs, lengths, record_stream=False)
+    status = int(out.status[0])
+    return FUZZ_HANG if status == FUZZ_RUNNING else status
+
+
+def open_native(spec: NativeSpec):
+    """Build an ExecTarget for the binding's native side (launch-style
+    for tcp).  Callers own close()."""
+    from ..native.exec_backend import ExecTarget
+
+    kwargs: Dict[str, Any] = dict(
+        timeout=spec.timeout,
+        extra_env=([f"{k}={v}" for k, v in spec.env.items()]
+                   if spec.env else None),
+    )
+    if spec.delivery in ("stdin", "stdin_train"):
+        kwargs["use_stdin"] = True
+    elif spec.delivery == "file":
+        kwargs["input_file"] = spec.input_file
+    return ExecTarget(list(spec.argv), **kwargs)
+
+
+def native_verdict(target, spec: NativeSpec,
+                   delivery: NativeDelivery) -> Tuple[int, int]:
+    """Replay one delivery on the native side; returns
+    ``(FUZZ_* verdict, raw status)``."""
+    from ..native.exec_backend import classify, replay_message_train
+
+    if spec.delivery in ("stdin_train", "tcp"):
+        status = replay_message_train(
+            target, delivery.messages or [delivery.payload],
+            mode=spec.delivery, addr=spec.addr,
+            timeout=spec.timeout)
+    else:
+        status = target.run(delivery.payload, spec.timeout)
+    kind, _ = classify(status)
+    return kind, status
+
+
+# -- certification ----------------------------------------------------
+
+def _verdict_class(kind: int) -> str:
+    if kind == FUZZ_CRASH:
+        return "crash"
+    if kind == FUZZ_HANG:
+        return "hang"
+    if kind == FUZZ_NONE:
+        return "ok"
+    return "error"
+
+
+def certify_binding(binding: ProxyBinding) -> Dict[str, Any]:
+    """Run the binding's benign seed through both sides and compare
+    verdict classes.  Returns a certification record::
+
+        {"certified": True | False | None, "reason": ...,
+         "proxy": {"verdict": ...}, "native": {"verdict": ..., ...}}
+
+    ``None`` means the native substrate is unavailable (toolchain
+    absent / binary missing) — skip-with-reason, never a silent
+    pass."""
+    from ..native.build import build_error, native_available
+
+    if not native_available():
+        return {"certified": None, "binding": binding.name,
+                "reason": f"native toolchain unavailable: "
+                          f"{build_error()}"}
+    exe = binding.native.argv[0]
+    if not os.path.exists(exe):
+        return {"certified": None, "binding": binding.name,
+                "reason": f"native binary missing: {exe} "
+                          f"(make -C corpus)"}
+    p_kind = proxy_verdict(binding, binding.benign_seed)
+    target = open_native(binding.native)
+    try:
+        delivery = binding.translate(binding.benign_seed)
+        n_kind, n_status = native_verdict(
+            target, binding.native, delivery)
+    finally:
+        target.close()
+    p_cls, n_cls = _verdict_class(p_kind), _verdict_class(n_kind)
+    ok = p_cls == n_cls
+    return {
+        "certified": ok, "binding": binding.name,
+        "reason": (None if ok else
+                   f"benign seed diverges: proxy={p_cls} "
+                   f"native={n_cls}"),
+        "proxy": {"target": binding.proxy_target, "verdict": p_cls},
+        "native": {"argv": list(binding.native.argv),
+                   "delivery": binding.native.delivery,
+                   "verdict": n_cls, "status": n_status},
+    }
+
+
+def bind(binding: ProxyBinding, certify: bool = True,
+         strict: bool = True) -> Dict[str, Any]:
+    """Register a binding, certification first.  ``strict`` refuses
+    a binding whose benign seed diverges (CertificationError); an
+    unavailable native substrate registers anyway with the skip
+    reason in the record (the bridge will stand down at attach)."""
+    cert: Dict[str, Any] = {"certified": None,
+                            "reason": "certification skipped"}
+    if certify:
+        cert = certify_binding(binding)
+        if strict and cert["certified"] is False:
+            raise CertificationError(
+                f"binding {binding.name!r} refused: {cert['reason']}")
+    register_binding(binding)
+    return cert

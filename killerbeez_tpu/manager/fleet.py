@@ -28,6 +28,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..hybrid.reconcile import fold_tiers, tier_of, validation_summary
 from ..telemetry import merge
 from ..telemetry.aggregate import STATUS_RANK as _STATUS_RANK
 from ..telemetry.openmetrics import (
@@ -73,6 +74,10 @@ class FleetConfig:
     #: campaigns stop latching worker_death forever and /metrics
     #: label cardinality stays bounded (0 = never retire)
     retire_after: float = 86400.0
+    #: validation_backlog: the hybrid bridge's oldest queued finding
+    #: has waited this long — the native tier is falling behind the
+    #: TPU tier and verdicts are going stale (docs/HYBRID.md)
+    validation_backlog_after: float = 120.0
 
 
 def classify(age: float, cfg: FleetConfig) -> str:
@@ -88,8 +93,9 @@ def classify(age: float, cfg: FleetConfig) -> str:
 #
 # A rule sees the campaign view:
 #   {"now", "statuses": {worker: status}, "counters": merged counters,
-#    "paths_changed_t", "execs_changed_t", "drops_changed_t",
-#    "crash_window": deque of (t, unique_crashes), "started": bool}
+#    "gauges": merged gauges, "paths_changed_t", "execs_changed_t",
+#    "drops_changed_t", "crash_window": deque of (t, unique_crashes),
+#    "started": bool}
 
 
 def _rule_worker_death(view: Dict[str, Any], cfg: FleetConfig
@@ -151,6 +157,21 @@ def _rule_findings_drop(view: Dict[str, Any], cfg: FleetConfig
                               1)}
 
 
+def _rule_validation_backlog(view: Dict[str, Any], cfg: FleetConfig
+                             ) -> Tuple[bool, Dict[str, Any]]:
+    """The hybrid bridge's validation queue has findings older than
+    ``validation_backlog_after``: the native tier cannot keep up and
+    cross-tier verdicts lag the frontier they should steer.  Only
+    fires for campaigns that post the queue gauges at all — a pure
+    TPU or pure native fleet never alarms."""
+    g = view.get("gauges", {})
+    depth = int(g.get("validation_queue_depth", 0))
+    age = float(g.get("validation_queue_age", 0.0))
+    active = depth > 0 and age >= cfg.validation_backlog_after
+    return active, {"queue_depth": depth,
+                    "oldest_age_s": round(age, 1)}
+
+
 #: declarative rule table: name -> predicate
 ALERT_RULES: Tuple[Tuple[str, Callable], ...] = (
     ("worker_death", _rule_worker_death),
@@ -158,6 +179,7 @@ ALERT_RULES: Tuple[Tuple[str, Callable], ...] = (
     ("crash_spike", _rule_crash_spike),
     ("coverage_stall", _rule_coverage_stall),
     ("findings_drop", _rule_findings_drop),
+    ("validation_backlog", _rule_validation_backlog),
 )
 
 
@@ -309,7 +331,9 @@ class FleetMonitor(threading.Thread):
             win.popleft()
 
         view = {"now": now, "statuses": statuses,
-                "counters": counters, "paths": st["paths"],
+                "counters": counters,
+                "gauges": merged.get("gauges", {}),
+                "paths": st["paths"],
                 "paths_changed_t": st["paths_changed_t"],
                 "execs_changed_t": st["execs_changed_t"],
                 "drops_changed_t": st["drops_changed_t"],
@@ -402,6 +426,14 @@ def worker_stats_summary(snap: Dict[str, Any]) -> Dict[str, Any]:
         "gossip_entries_out": int(c.get("gossip_entries_out", 0)),
         "peers_banned": int(c.get("peers_banned", 0)),
         "peers_banned_active": int(g.get("peers_banned_active", 0)),
+        # hybrid bridge row: cross-tier verdict counters + the
+        # validation queue the backlog alert watches (docs/HYBRID.md)
+        "hybrid_validations": int(c.get("hybrid_validations", 0)),
+        "hybrid_confirmed": int(c.get("hybrid_confirmed", 0)),
+        "hybrid_proxy_only": int(c.get("hybrid_proxy_only", 0)),
+        "hybrid_flaky": int(c.get("hybrid_flaky", 0)),
+        "validation_queue_depth":
+            int(g.get("validation_queue_depth", 0)),
         "execs_per_sec": float(d.get("execs_per_sec", 0.0)),
         "execs_per_sec_ema": float(d.get("execs_per_sec_ema", 0.0)),
     }
@@ -473,6 +505,11 @@ def fleet_view(db, cfg: FleetConfig, campaign: str,
     merged = merge([r["snapshot"] for r in stats.values()])
     if merged is not None and health:
         merged["health"] = health
+    # per-tier fold (hybrid campaigns; docs/HYBRID.md): workers group
+    # by meta["tier"] — a pure TPU fleet shows one "tpu" tier and the
+    # validation rollup reads all-zero
+    statuses = {w: e["status"] for w, e in workers.items()}
+    tiers = fold_tiers(rows, stats, statuses)
     return {
         "campaign": campaign,
         "t": now,
@@ -482,6 +519,8 @@ def fleet_view(db, cfg: FleetConfig, campaign: str,
         "counts": counts,
         "workers": workers,
         "merged": merged,
+        "tiers": tiers,
+        "validation": validation_summary(merged),
         "alerts": (monitor.alerts(campaign) if monitor is not None
                    else []),
     }
@@ -558,6 +597,34 @@ def render_fleet_metrics(db, cfg: FleetConfig,
             add_gauge(fams, "kbz_fleet_workers", n,
                       {"campaign": campaign, "status": status},
                       help_text="workers by health status")
+        # hybrid campaign series (docs/HYBRID.md): per-tier worker
+        # counts and the cross-tier verdict counters — only emitted
+        # once a campaign actually posts tier tags / hybrid counters,
+        # so pure TPU fleets keep their exact historical scrape
+        tier_counts: Dict[str, int] = {}
+        for row in by_campaign.get(campaign, []):
+            t = tier_of(row.get("meta"))
+            tier_counts[t] = tier_counts.get(t, 0) + 1
+        if len(tier_counts) > 1 or (tier_counts and
+                                    "tpu" not in tier_counts):
+            for t, n in sorted(tier_counts.items()):
+                add_gauge(fams, "kbz_fleet_tier_workers", n,
+                          {"campaign": campaign, "tier": t},
+                          help_text="workers by execution tier")
+        mc = (merged or {}).get("counters", {})
+        if "hybrid_validations" in mc:
+            for verdict in ("confirmed", "proxy_only", "flaky"):
+                add_counter(fams, "kbz_hybrid_validations",
+                            mc.get(f"hybrid_{verdict}", 0),
+                            {"campaign": campaign,
+                             "verdict": verdict},
+                            help_text="cross-tier validation "
+                                      "verdicts (hybrid bridge)")
+            mg = (merged or {}).get("gauges", {})
+            add_gauge(fams, "kbz_validation_queue_depth",
+                      mg.get("validation_queue_depth", 0), labels_c,
+                      help_text="findings awaiting native "
+                                "validation")
         if monitor is not None:
             for a in monitor.alerts(campaign):
                 add_gauge(fams, "kbz_alert_active",

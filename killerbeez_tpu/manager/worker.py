@@ -88,7 +88,8 @@ class Heartbeat(threading.Thread):
     observability)."""
 
     def __init__(self, manager_url: str, campaign: str, worker: str,
-                 output_dir: str, interval: float = 5.0):
+                 output_dir: str, interval: float = 5.0,
+                 tier: Optional[str] = None):
         super().__init__(daemon=True)
         self.url = f"{manager_url}/api/stats/{campaign}"
         self.events_url = f"{manager_url}/api/events/{campaign}"
@@ -110,6 +111,10 @@ class Heartbeat(threading.Thread):
                          "host": socket.gethostname()}
         except OSError:
             self.meta = {"pid": os.getpid()}
+        # execution tier tag (hybrid campaigns; docs/HYBRID.md) —
+        # absent means "tpu" to every per-tier fold
+        if tier:
+            self.meta["tier"] = tier
 
     #: per-beat read window over events.jsonl: bounds memory and
     #: request size — a long backlog (worker restart against a

@@ -15,6 +15,7 @@ from __future__ import annotations
 import ctypes as ct
 import os
 import tempfile
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -119,6 +120,66 @@ def classify_batch(statuses_raw: np.ndarray
     exit_codes = np.where(statuses_raw >= 512, statuses_raw - 512,
                           np.maximum(statuses_raw, 0)).astype(np.int32)
     return verdicts, exit_codes
+
+
+def replay_message_train(target: "ExecTarget",
+                         messages: Sequence[bytes],
+                         mode: str = "stdin_train",
+                         addr: Optional[Tuple[str, int]] = None,
+                         timeout: Optional[float] = None,
+                         connect_timeout: float = 5.0) -> int:
+    """Replay a translated message train (hybrid bridge,
+    docs/HYBRID.md) on a native target; returns a raw status code.
+
+    ``stdin_train`` concatenates the messages onto the target's
+    stdin — the child reads them sequentially off the pipe, which is
+    the reference's stdin replay of a session.  ``tcp`` is the
+    network_client / send_tcp_input pattern: launch() the server,
+    connect, send each message as one write, half-close, then
+    wait_done() for the verdict.  Connection failure returns the
+    error sentinel (-2), never an exception — the validator's
+    retry/backoff owns transient transport faults.
+    """
+    if mode in ("stdin", "stdin_train", "file"):
+        return target.run(b"".join(messages), timeout)
+    if mode != "tcp":
+        raise ValueError(f"unknown replay mode {mode!r}")
+    if not addr:
+        raise ValueError("tcp replay needs addr=(host, port)")
+    import socket
+
+    target.launch()
+    sock = None
+    deadline = time.monotonic() + connect_timeout
+    while time.monotonic() < deadline:
+        try:
+            sock = socket.create_connection(addr, timeout=1.0)
+            break
+        except OSError:
+            if not target.alive():
+                break
+            time.sleep(0.05)
+    if sock is None:
+        target.wait_done(0.01)      # reap the launched child
+        return -2
+    try:
+        with sock:
+            for m in messages:
+                sock.sendall(bytes(m))
+            try:
+                sock.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+            # drain any reply so the server isn't blocked on write
+            sock.settimeout(0.25)
+            try:
+                while sock.recv(4096):
+                    pass
+            except OSError:
+                pass
+    except OSError:
+        pass                        # verdict comes from wait_done
+    return target.wait_done(timeout)
 
 
 class ExecTarget:

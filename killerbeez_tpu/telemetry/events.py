@@ -77,7 +77,17 @@ EVENT_TYPES = ("new_path", "crash", "hang", "plateau",
                # one completed on-device training round of the
                # byte-saliency model — version, label counts, the
                # final batch loss
-               "learn_update")
+               "learn_update",
+               # hybrid bridge (killerbeez_tpu/hybrid/): one cross-
+               # tier validation verdict — a TPU-tier finding
+               # replayed on the real native binary, with md5, kind,
+               # verdict (confirmed / proxy_only / flaky), repro
+               # counts and wall time (docs/HYBRID.md)
+               "cross_tier_validate",
+               # hybrid bridge: a proxy_only divergence — the soft
+               # proxy crashed where the native binary did not; the
+               # event names the machine-readable gap report path
+               "proxy_gap")
 
 #: events a fleet worker forwards to the manager alongside heartbeats
 TERMINAL_EVENTS = ("crash", "hang", "plateau")

@@ -91,6 +91,30 @@ def render_fleet(body: Dict[str, Any], url: str) -> str:
             f"{_fmt_n(c.get('gossip_entries_out', 0))} out"
             f" | {_fmt_n(c.get('sync_quarantined', 0))} quarantined"
             f" | {_fmt_n(c.get('peers_banned', 0))} peer bans")
+    # hybrid campaigns (docs/HYBRID.md): per-tier worker fold + the
+    # cross-tier validation rollup — hidden for pure TPU fleets
+    tiers = body.get("tiers") or {}
+    if len(tiers) > 1 or (tiers and "tpu" not in tiers):
+        parts = []
+        for t in sorted(tiers):
+            tv = tiers[t]
+            tc = tv.get("counters", {})
+            parts.append(
+                f"{t} {tv.get('n_workers', 0)}w "
+                f"({_fmt_n(tc.get('execs', 0))} execs, "
+                f"{_fmt_n(tc.get('crashes', 0))} crashes)")
+        lines.append("  tiers   : " + " | ".join(parts))
+    val = body.get("validation") or {}
+    if val.get("validations") or val.get("queue_depth"):
+        v = val.get("verdicts", {})
+        lines.append(
+            f"  hybrid  : {_fmt_n(val.get('validations', 0))} "
+            f"validated"
+            f" | {_fmt_n(v.get('confirmed', 0))} confirmed / "
+            f"{_fmt_n(v.get('proxy_only', 0))} proxy-only / "
+            f"{_fmt_n(v.get('flaky', 0))} flaky"
+            f" | queue {val.get('queue_depth', 0)} "
+            f"(oldest {_fmt_age(val.get('queue_age_s', 0.0))})")
     active = [a for a in body.get("alerts", []) if a.get("active")]
     if active:
         now = body.get("t", time.time())
@@ -109,14 +133,18 @@ def render_fleet(body: Dict[str, Any], url: str) -> str:
     if workers:
         lines.append("")
         lines.append(
-            f"  {'worker':<18} {'status':<8} {'last seen':>9} "
+            f"  {'worker':<18} {'status':<8} {'tier':<7} "
+            f"{'last seen':>9} "
             f"{'execs':>8} {'execs/s':>9} {'paths':>6} "
             f"{'crashes':>7} {'hangs':>6}")
         for name in sorted(workers):
             w = workers[name]
             s = w.get("stats", {})
+            meta = w.get("meta") or {}
+            tier = meta.get("tier") or "tpu"
             lines.append(
                 f"  {name:<18} {w.get('status', '?'):<8} "
+                f"{tier:<7} "
                 f"{_fmt_age(w.get('age', 0.0)):>9} "
                 f"{_fmt_n(s.get('execs', 0)):>8} "
                 f"{_fmt_n(s.get('execs_per_sec_ema', 0.0)):>9} "

@@ -130,6 +130,18 @@ def render(snap: Dict[str, Any]) -> str:
                      f"banned ({_fmt_n(c.get('peers_banned', 0))} "
                      "lifetime)")
         lines.append(line)
+    if c.get("hybrid_validations") or g.get("validation_queue_depth"):
+        line = (f"  hybrid   : "
+                f"{_fmt_n(c.get('hybrid_validations', 0))} validated"
+                f" | {_fmt_n(c.get('hybrid_confirmed', 0))} confirmed"
+                f" / {_fmt_n(c.get('hybrid_proxy_only', 0))} "
+                f"proxy-only"
+                f" / {_fmt_n(c.get('hybrid_flaky', 0))} flaky"
+                f" | queue {int(g.get('validation_queue_depth', 0))}")
+        if c.get("hybrid_proxy_gaps"):
+            line += (f" | {_fmt_n(c.get('hybrid_proxy_gaps', 0))} "
+                     "gap reports")
+        lines.append(line)
     if c.get("solver_attempts") or g.get("solver_frontier"):
         line = (f"  solver   : "
                 f"{_fmt_n(c.get('solver_solved', 0))} solved"

@@ -1,0 +1,801 @@
+"""Hybrid native⇄TPU campaign bridge (killerbeez_tpu/hybrid/,
+docs/HYBRID.md): lossless seed translation, proxy-binding
+certification, cross-tier triage verdicts, per-tier fleet
+reconciliation, and the real-pair e2e (planted proxy finding
+confirmed on the real binary; a deliberately divergent proxy yields
+``proxy_only`` + a gap report, never a silent drop).
+
+Pure-python pieces (translation, queue, validator taxonomy via an
+injected run_fn, scheduler credit, manager folds) run everywhere;
+tests using the ``corpus_bin`` fixture execute the real built
+binaries and auto-carry the ``native`` marker.
+"""
+
+import base64
+import glob
+import json
+import os
+import random
+import time
+
+import pytest
+
+from killerbeez_tpu import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_NONE
+from killerbeez_tpu.corpus.quarantine import EntryValidator
+from killerbeez_tpu.corpus.schedule import (
+    Arm, RareEdgeScheduler, make_scheduler,
+)
+from killerbeez_tpu.corpus.store import (
+    CorpusEntry, CorpusStore, VALIDATION_VERDICTS, coverage_hash,
+)
+from killerbeez_tpu.hybrid import (
+    CertificationError, NativeSpec, ProxyBinding, bind,
+    certify_binding, get_binding,
+)
+from killerbeez_tpu.hybrid.reconcile import (
+    NativeHeartbeat, fold_tiers, tier_of, validation_summary,
+)
+from killerbeez_tpu.hybrid.translate import (
+    DELIVERY_MODES, TRAIN_MODES, NativeDelivery, from_delivery,
+    to_delivery,
+)
+from killerbeez_tpu.hybrid.validate import (
+    VERDICT_CONFIRMED, VERDICT_FLAKY, VERDICT_PROXY_ONLY,
+    HybridBridge, NativeValidator, ValidationItem, ValidationQueue,
+)
+from killerbeez_tpu.stateful.framing import frame_messages, unframe
+from killerbeez_tpu.telemetry import MetricsRegistry
+from killerbeez_tpu.utils.fileio import md5_hex
+
+M_MAX = 4
+
+
+# -- seed translation (round-trip property) -----------------------------
+
+
+def _soups():
+    rng = random.Random(0xbeef)
+    yield b""
+    yield b"A"
+    yield b"\x00" * 7
+    yield bytes(range(256))
+    for n in (3, 17, 255, 256, 300, 1024):
+        yield bytes(rng.randrange(256) for _ in range(n))
+    # well-framed trains round-trip too (they are just bytes)
+    yield frame_messages([b"Lpw", b"QA", b"X"], M_MAX)
+
+
+@pytest.mark.parametrize("mode", DELIVERY_MODES)
+def test_translate_roundtrip_identity_all_modes(mode):
+    """from_delivery(to_delivery(buf)) == buf for ARBITRARY byte
+    soup in every delivery mode — translation is lossless even where
+    the framed parse is deliberately lossy."""
+    for buf in _soups():
+        d = to_delivery(buf, mode=mode, m_max=M_MAX)
+        assert d.mode == mode
+        assert from_delivery(d, m_max=M_MAX) == buf
+
+
+def test_translate_train_modes_parse_framed_sequences():
+    msgs = [b"HELLO", b"", b"WORLD"]
+    buf = frame_messages(msgs, M_MAX)
+    for mode in TRAIN_MODES:
+        d = to_delivery(buf, mode=mode, m_max=M_MAX)
+        assert d.messages == unframe(buf, M_MAX)
+        # frame_messages payload survives the parse exactly
+        assert [m for m in d.messages if m or True] == d.messages
+        assert from_delivery(d, m_max=M_MAX) == buf
+
+
+def test_translate_train_modes_require_m_max():
+    for mode in TRAIN_MODES:
+        with pytest.raises(ValueError):
+            to_delivery(b"whatever", mode=mode, m_max=0)
+
+
+def test_translate_unknown_mode_rejected():
+    with pytest.raises(ValueError):
+        to_delivery(b"x", mode="carrier-pigeon")
+
+
+def test_native_built_delivery_reencodes():
+    """A delivery built on the native side (raw=None) re-encodes its
+    messages through the canonical framing."""
+    msgs = [b"ab", b"c"]
+    d = NativeDelivery(mode="stdin_train", raw=None,
+                       payload=b"".join(msgs), messages=list(msgs))
+    assert unframe(from_delivery(d, m_max=M_MAX), M_MAX) == msgs
+    # and an EMPTY raw buffer is still "translated", not re-encoded
+    d2 = to_delivery(b"", mode="stdin_train", m_max=M_MAX)
+    assert from_delivery(d2, m_max=M_MAX) == b""
+
+
+# -- corpus sidecar schema (tier + validation) --------------------------
+
+
+def test_entry_sidecar_tier_validation_roundtrip(tmp_path):
+    store = CorpusStore(str(tmp_path))
+    val = {"verdict": "confirmed", "tier": "native", "repro": 3,
+           "repeats": 3, "attempts": 3, "statuses": [2, 2, 2],
+           "t": 1234.5}
+    e = CorpusEntry(b"SEED", sig=[1, 2], tier="tpu", validation=val)
+    assert store.put(e)
+    got = {x.md5: x for x in store.load()}[e.md5]
+    assert got.tier == "tpu"
+    assert got.validation == val
+
+
+def test_old_sidecar_loads_unchanged(tmp_path):
+    """Backcompat regression pin: a pre-hybrid sidecar (no tier /
+    validation keys) loads with both fields None and is accepted by
+    the EntryValidator untouched."""
+    store = CorpusStore(str(tmp_path))
+    e = CorpusEntry(b"OLD", sig=[7])
+    assert store.put(e)
+    meta = json.loads(open(store.meta_path(e.md5)).read())
+    # pin: the hybrid keys exist in NEW sidecars...
+    assert "tier" in meta and "validation" in meta
+    # ...build an OLD one by deleting them wholesale
+    for k in ("tier", "validation"):
+        del meta[k]
+    with open(store.meta_path(e.md5), "w") as f:
+        json.dump(meta, f)
+    got = {x.md5: x for x in store.load()}[e.md5]
+    assert got.tier is None and got.validation is None
+    entry, reason = EntryValidator().validate({
+        "content_b64": base64.b64encode(b"OLD").decode(),
+        "md5": e.md5, "cov_hash": coverage_hash([7], b"OLD"),
+        "meta": meta})
+    assert reason is None and entry.tier is None
+
+
+def test_update_validation_rewrites_sidecar(tmp_path):
+    store = CorpusStore(str(tmp_path))
+    e = CorpusEntry(b"PARENT", sig=[3])
+    store.put(e)
+    rec = {"verdict": "confirmed", "repro": 3, "repeats": 3}
+    assert store.update_validation(e.md5, rec) is True
+    got = {x.md5: x for x in store.load()}[e.md5]
+    assert got.validation["verdict"] == "confirmed"
+    # no sidecar -> False, never an exception
+    assert store.update_validation("f" * 32, rec) is False
+
+
+def _row(buf, sig=None, **meta_over):
+    sig = sorted(sig or [])
+    meta = {"sig": sig or None, "md5": md5_hex(buf),
+            "cov_hash": coverage_hash(sig or None, buf),
+            "seq": 0, "source": "local"}
+    meta.update(meta_over)
+    return {"worker": "w", "md5": md5_hex(buf),
+            "cov_hash": coverage_hash(sig or None, buf),
+            "content_b64": base64.b64encode(buf).decode(),
+            "meta": meta}
+
+
+def test_entry_validator_accepts_bounded_hybrid_meta():
+    row = _row(b"DATA", [1], tier="native",
+               validation={"verdict": "proxy_only", "tier": "native",
+                           "repro": 0, "repeats": 3,
+                           "statuses": [0, 0, 0], "t": 1.0,
+                           "detail": "x"})
+    entry, reason = EntryValidator().validate(row)
+    assert reason is None
+    assert entry.tier == "native"
+    assert entry.validation["verdict"] == "proxy_only"
+
+
+@pytest.mark.parametrize("mutate,expect", [
+    (dict(tier=7), "schema:tier"),
+    (dict(tier=""), "schema:tier"),
+    (dict(tier="x" * 33), "schema:tier"),
+    (dict(tier="evil tier!"), "schema:tier"),
+    (dict(validation="confirmed"), "schema:validation"),
+    (dict(validation={"verdict": "certainly"}), "schema:validation"),
+    (dict(validation={"verdict": "flaky", "repro": -1}),
+     "schema:validation"),
+    (dict(validation={"verdict": "flaky", "repeats": 5000}),
+     "schema:validation"),
+    (dict(validation={"verdict": "flaky", "statuses": [2] * 65}),
+     "schema:validation"),
+    (dict(validation={"verdict": "flaky", "statuses": ["boom"]}),
+     "schema:validation"),
+    (dict(validation={"verdict": "flaky", "detail": "d" * 257}),
+     "schema:validation"),
+    (dict(validation={"verdict": "flaky", "tier": "t" * 33}),
+     "schema:validation"),
+])
+def test_entry_validator_rejects_malformed_hybrid_meta(mutate, expect):
+    entry, reason = EntryValidator().validate(_row(b"DATA", [1],
+                                                   **mutate))
+    assert entry is None and reason == expect
+
+
+# -- validation queue ---------------------------------------------------
+
+
+def _item(buf=b"X", kind="crash", t=None):
+    return ValidationItem(kind, buf, md5_hex(buf), t=t)
+
+
+def test_validation_queue_bounds_and_age():
+    q = ValidationQueue(cap=2)
+    now = time.time()
+    assert q.put(_item(b"a", t=now - 50.0))
+    assert q.put(_item(b"b", t=now))
+    # full: REJECTED and counted, never silently grown
+    assert not q.put(_item(b"c"))
+    assert q.dropped == 1 and q.depth() == 2
+    assert q.oldest_age(now=now) == pytest.approx(50.0)
+    got = q.get(0.0)
+    assert got.buf == b"a"
+    q.get(0.0)
+    assert q.get(0.0) is None and q.oldest_age() == 0.0
+
+
+# -- verdict taxonomy (injected native side) ----------------------------
+
+
+def _binding():
+    return ProxyBinding(name="fake", proxy_target="test",
+                        native=NativeSpec(argv=["/bin/true"]))
+
+
+def _validate(run_fn, kind="crash", repeats=3, **kw):
+    sleeps = []
+    v = NativeValidator(_binding(), repeats=repeats, run_fn=run_fn,
+                        sleep_fn=sleeps.append, **kw)
+    rec = v.validate(_item(kind=kind))
+    return rec, sleeps
+
+
+def test_verdict_confirmed():
+    rec, _ = _validate(lambda buf: FUZZ_CRASH)
+    assert rec["verdict"] == VERDICT_CONFIRMED
+    assert rec["repro"] == 3 and rec["statuses"] == [2, 2, 2]
+
+
+def test_verdict_proxy_only():
+    rec, _ = _validate(lambda buf: FUZZ_NONE)
+    assert rec["verdict"] == VERDICT_PROXY_ONLY and rec["repro"] == 0
+
+
+def test_verdict_flaky_partial_repro():
+    it = iter([FUZZ_CRASH, FUZZ_NONE, FUZZ_CRASH])
+    rec, _ = _validate(lambda buf: next(it))
+    assert rec["verdict"] == VERDICT_FLAKY and rec["repro"] == 2
+
+
+def test_verdict_hang_kind_matches_hangs_not_crashes():
+    rec, _ = _validate(lambda buf: FUZZ_HANG, kind="hang")
+    assert rec["verdict"] == VERDICT_CONFIRMED
+    rec, _ = _validate(lambda buf: FUZZ_CRASH, kind="hang")
+    assert rec["verdict"] == VERDICT_PROXY_ONLY
+
+
+def test_transient_native_errors_retry_with_backoff():
+    """-2 statuses retry with exponential backoff inside the repeat
+    before counting; a recovered substrate still confirms."""
+    seq = iter([FUZZ_ERROR, FUZZ_ERROR, FUZZ_CRASH,   # repeat 1
+                FUZZ_CRASH,                            # repeat 2
+                FUZZ_CRASH])                           # repeat 3
+    rec, sleeps = _validate(lambda buf: next(seq))
+    assert rec["verdict"] == VERDICT_CONFIRMED
+    assert rec["attempts"] == 5
+    assert sleeps == [pytest.approx(0.1), pytest.approx(0.2)]
+
+
+def test_all_errors_is_flaky_not_proxy_gap():
+    """A substrate that never executes must NOT produce a proxy-gap
+    claim — undecided, flagged as native-exec-error."""
+    rec, sleeps = _validate(lambda buf: FUZZ_ERROR, repeats=2)
+    assert rec["verdict"] == VERDICT_FLAKY
+    assert rec["detail"] == "native-exec-error"
+    assert rec["attempts"] == 8 and len(sleeps) == 8
+
+
+# -- scheduler credit ---------------------------------------------------
+
+
+def test_note_validation_credits_finding_and_parent():
+    s = make_scheduler("bandit")
+    parent = Arm(b"PARENT")
+    child = Arm(b"CHILD", parent=parent.md5)
+    other = Arm(b"OTHER")
+    for a in (parent, child, other):
+        s.admit(a)
+    s.note_validation(child.md5, "confirmed", parent=parent.md5)
+    assert child[2] == pytest.approx(s.CONFIRM_CREDIT)
+    assert parent[2] == pytest.approx(s.CONFIRM_CREDIT)
+    assert other[2] == 0.0
+    assert {child.md5, parent.md5} <= s.confirmed_md5s
+    # idempotent per finding md5
+    s.note_validation(child.md5, "confirmed", parent=parent.md5)
+    assert child[2] == pytest.approx(s.CONFIRM_CREDIT)
+    # other verdicts never credit
+    s.note_validation(other.md5, "proxy_only")
+    s.note_validation(other.md5, "flaky")
+    assert other[2] == 0.0 and other.md5 not in s.confirmed_md5s
+
+
+def test_confirmed_set_rides_checkpoint_state():
+    s = make_scheduler("bandit")
+    # pre-hybrid checkpoints stay shape-identical: no key when empty
+    assert "confirmed" not in s.state_dict()
+    s.note_validation("a" * 32, "confirmed", parent="b" * 32)
+    d = s.state_dict()
+    assert sorted(d["confirmed"]) == sorted(["a" * 32, "b" * 32])
+    s2 = make_scheduler("bandit")
+    s2.load_state(d)
+    assert s2.confirmed_md5s == s.confirmed_md5s
+
+
+def test_rare_edge_confirmed_outranks_equal_rarity():
+    s = RareEdgeScheduler()
+    a = Arm(b"AAAA", sig=[1])
+    b = Arm(b"BBBB", sig=[2])
+    s.admit(a)
+    s.admit(b)
+    # equal rarity, equal selections: the NEWER arm (b) wins the
+    # historical seq tiebreak...
+    i, _ = s.select()
+    assert s.arms[i] is b
+    # ...until a earns native confirmation: halved rarity outranks
+    s.note_validation(a.md5, "confirmed")
+    i, _ = s.select()
+    assert s.arms[i] is a
+
+
+def test_rare_edge_parity_with_empty_confirmed_set():
+    """Non-confirmed verdicts never enter the confirmed set, so a
+    campaign whose validations all came back proxy_only/flaky selects
+    bit-identically to one with no hybrid bridge (parity pin)."""
+    def drive(s, poke):
+        for arm in (Arm(b"AAAA", sig=[1]), Arm(b"BBBB", sig=[2]),
+                    Arm(b"CCCC", sig=[1, 2])):
+            s.admit(arm)
+        if poke:
+            s.note_validation(md5_hex(b"BBBB"), "proxy_only")
+            s.note_validation(md5_hex(b"CCCC"), "flaky")
+        picks = []
+        for _ in range(6):
+            i, _ = s.select()
+            s.credit_period(s.arms[i] if i is not None else None)
+            picks.append(i)
+        return picks
+    assert drive(RareEdgeScheduler(), True) \
+        == drive(RareEdgeScheduler(), False)
+
+
+# -- bridge fold (stub campaign) ----------------------------------------
+
+
+class _StubTelemetry:
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        self.events = []
+
+    def event(self, etype, **fields):
+        self.events.append({"type": etype, **fields})
+
+
+class _StubFuzzer:
+    def __init__(self, out, store=None):
+        self.telemetry = _StubTelemetry()
+        self.output_dir = str(out)
+        self.write_findings = True
+        self.store = store
+        self.scheduler = make_scheduler("bandit")
+
+
+def _mk_bridge(run_fn, **kw):
+    b = HybridBridge(_binding(), workers=0, **kw)
+    b.validator = NativeValidator(_binding(), repeats=3,
+                                  run_fn=run_fn)
+    return b
+
+
+def test_bridge_fold_confirmed_and_proxy_gap(tmp_path):
+    """The full loop-side contract in one pass: a confirming and a
+    diverging finding enqueue -> pump -> fold, and every artifact
+    lands (counters, events, finding sidecar, corpus write-back,
+    scheduler credit, proxy-gap report)."""
+    store = CorpusStore(str(tmp_path / "corpus"))
+    fz = _StubFuzzer(tmp_path, store=store)
+    crash_buf, gap_buf = b"CRASH", b"NOPE"
+    crash_md5, gap_md5 = md5_hex(crash_buf), md5_hex(gap_buf)
+    parent = Arm(b"GENERATOR")
+    fz.scheduler.admit(parent)
+    # the confirming finding is also a corpus entry (write-back path)
+    store.put(CorpusEntry(crash_buf, sig=[9]))
+    bridge = _mk_bridge(
+        lambda buf: FUZZ_CRASH if buf == crash_buf else FUZZ_NONE)
+    assert bridge.enqueue("crash", crash_buf, crash_md5,
+                          parent=parent.md5)
+    assert bridge.enqueue("crash", gap_buf, gap_md5)
+    assert not bridge.enqueue("crash", crash_buf, crash_md5), \
+        "enqueue must be idempotent per md5"
+    assert bridge.pump() == 2
+    assert bridge.fold(fz) == 2
+
+    snap = fz.telemetry.registry.snapshot()["counters"]
+    assert snap["hybrid_validations"] == 2
+    assert snap["hybrid_confirmed"] == 1
+    assert snap["hybrid_proxy_only"] == 1
+    assert snap["hybrid_proxy_gaps"] == 1
+
+    by_type = {}
+    for e in fz.telemetry.events:
+        by_type.setdefault(e["type"], []).append(e)
+    verdicts = {e["md5"]: e["verdict"]
+                for e in by_type["cross_tier_validate"]}
+    assert verdicts == {crash_md5: "confirmed",
+                        gap_md5: "proxy_only"}
+    assert by_type["proxy_gap"][0]["md5"] == gap_md5
+
+    # finding sidecar + corpus write-back + scheduler credit
+    sc = json.load(open(tmp_path / "crashes" / f"{crash_md5}.json"))
+    assert sc["validation"]["verdict"] == "confirmed"
+    got = {x.md5: x for x in store.load()}[crash_md5]
+    assert got.validation["verdict"] == "confirmed"
+    assert parent[2] == pytest.approx(fz.scheduler.CONFIRM_CREDIT)
+
+    # the machine-readable gap contract
+    report = json.load(open(
+        tmp_path / "proxy_gaps" / f"{gap_md5}.json"))
+    assert report["schema"] == "kbz-proxy-gap-v1"
+    assert report["binding"] == "fake"
+    assert report["native"]["repro"] == 0
+    assert report["native"]["statuses"] == [0, 0, 0]
+
+    # queue gauges always posted
+    g = fz.telemetry.registry.snapshot()["gauges"]
+    assert g["validation_queue_depth"] == 0
+
+    # the native heartbeat payload carries the verdict breakdown —
+    # CLI --sync-manager campaigns have no TPU-side stats reporter,
+    # so kb-fleet's verdict split comes from THIS snapshot
+    hc = bridge.snapshot()["counters"]
+    assert hc["hybrid_validations"] == 2
+    assert hc["hybrid_confirmed"] == 1
+    assert hc["hybrid_proxy_only"] == 1
+    assert hc["hybrid_proxy_gaps"] == 1
+
+
+def test_bridge_finish_drains_without_workers(tmp_path):
+    fz = _StubFuzzer(tmp_path)
+    bridge = _mk_bridge(lambda buf: FUZZ_CRASH)
+    bridge.enqueue("crash", b"A", md5_hex(b"A"))
+    bridge.finish(fz)
+    c = fz.telemetry.registry.snapshot()["counters"]
+    assert c["hybrid_confirmed"] == 1
+    assert bridge.queue.depth() == 0
+
+
+def test_bridge_worker_thread_e2e(tmp_path):
+    """workers=1: validation happens off-thread, fold on the caller —
+    the single-writer discipline end to end."""
+    fz = _StubFuzzer(tmp_path)
+    bridge = HybridBridge(_binding(), workers=1)
+    bridge.validator = NativeValidator(_binding(), repeats=2,
+                                       run_fn=lambda buf: FUZZ_CRASH)
+    for i in range(4):
+        bridge.enqueue("crash", bytes([i]), md5_hex(bytes([i])))
+    bridge.finish(fz, drain_timeout=10.0)
+    c = fz.telemetry.registry.snapshot()["counters"]
+    assert c["hybrid_validations"] == 4
+    assert c["hybrid_confirmed"] == 4
+    assert bridge.snapshot()["counters"]["hybrid_validations"] == 4
+
+
+def test_bridge_validator_exception_becomes_flaky(tmp_path):
+    def boom(buf):
+        raise RuntimeError("native side exploded")
+    fz = _StubFuzzer(tmp_path)
+    bridge = HybridBridge(_binding(), workers=1)
+    bridge.validator = NativeValidator(_binding(), run_fn=boom,
+                                       sleep_fn=lambda s: None)
+    bridge.enqueue("crash", b"A", md5_hex(b"A"))
+    bridge.finish(fz, drain_timeout=10.0)
+    c = fz.telemetry.registry.snapshot()["counters"]
+    assert c["hybrid_flaky"] == 1, \
+        "a dying validator must yield a visible verdict, not a drop"
+
+
+# -- per-tier reconciliation --------------------------------------------
+
+
+def test_tier_of_defaults_untagged_to_tpu():
+    assert tier_of(None) == "tpu"
+    assert tier_of({}) == "tpu"
+    assert tier_of({"tier": 7}) == "tpu"
+    assert tier_of({"tier": "native"}) == "native"
+
+
+def _hb_snap(execs, **extra_counters):
+    return {"t": time.time(), "elapsed": 10.0,
+            "counters": {"execs": execs, "new_paths": 0,
+                         "crashes": 0, **extra_counters},
+            "gauges": {}, "rates": {}, "derived": {}}
+
+
+def test_fold_tiers_groups_and_merges():
+    rows = [{"worker": "w1", "meta": {"tier": "tpu"}},
+            {"worker": "w2", "meta": None},
+            {"worker": "w3-native", "meta": {"tier": "native"}}]
+    stats = {"w1": {"snapshot": _hb_snap(100)},
+             "w2": {"snapshot": _hb_snap(50)},
+             "w3-native": {"snapshot": _hb_snap(
+                 7, hybrid_validations=3)}}
+    statuses = {"w1": "healthy", "w2": "stale",
+                "w3-native": "healthy"}
+    tiers = fold_tiers(rows, stats, statuses)
+    assert set(tiers) == {"tpu", "native"}
+    assert tiers["tpu"]["n_workers"] == 2
+    assert tiers["tpu"]["counters"]["execs"] == 150
+    assert tiers["tpu"]["counts"] == {"healthy": 1, "stale": 1}
+    assert tiers["native"]["counters"]["hybrid_validations"] == 3
+
+
+def test_validation_summary_shapes():
+    assert validation_summary(None)["validations"] == 0
+    s = validation_summary({
+        "counters": {"hybrid_validations": 5, "hybrid_confirmed": 3,
+                     "hybrid_proxy_only": 1, "hybrid_flaky": 1,
+                     "hybrid_proxy_gaps": 1},
+        "gauges": {"validation_queue_depth": 2,
+                   "validation_queue_age": 8.5}})
+    assert s["validations"] == 5
+    assert s["verdicts"] == {"confirmed": 3, "proxy_only": 1,
+                             "flaky": 1}
+    assert s["proxy_gaps"] == 1
+    assert s["queue_depth"] == 2 and s["queue_age_s"] == 8.5
+
+
+def test_validation_backlog_alert_rule():
+    from killerbeez_tpu.manager.db import ManagerDB
+    from killerbeez_tpu.manager.fleet import FleetConfig, FleetMonitor
+    db = ManagerDB()
+    mon = FleetMonitor(db, FleetConfig(
+        monitor_interval=0.0, series_interval=1e9,
+        validation_backlog_after=120.0))
+    now = 1000.0
+
+    def beat(age, t):
+        db.note_fleet_worker("c", "w1", now=t)
+        snap = _hb_snap(100)
+        snap["gauges"] = {"validation_queue_depth": 3,
+                          "validation_queue_age": age}
+        snap["t"] = t
+        db.upsert_campaign_stats("c", "w1", snap)
+
+    beat(10.0, now)
+    mon.tick(now=now)
+    assert not [a for a in mon.alerts("c")
+                if a["alert"] == "validation_backlog" and a["active"]]
+    beat(180.0, now + 5.0)
+    mon.tick(now=now + 5.0)
+    active = [a for a in mon.alerts("c")
+              if a["alert"] == "validation_backlog" and a["active"]]
+    assert active and active[0]["details"]["queue_depth"] == 3
+    # queue drains -> falling edge
+    snap = _hb_snap(200)
+    snap["gauges"] = {"validation_queue_depth": 0,
+                      "validation_queue_age": 0.0}
+    db.upsert_campaign_stats("c", "w1", snap)
+    mon.tick(now=now + 10.0)
+    assert not [a for a in mon.alerts("c")
+                if a["alert"] == "validation_backlog" and a["active"]]
+
+
+def test_fleet_view_exposes_tiers_and_validation():
+    from killerbeez_tpu.manager.db import ManagerDB
+    from killerbeez_tpu.manager.fleet import (
+        FleetConfig, fleet_view, render_fleet_metrics,
+    )
+    db = ManagerDB()
+    cfg = FleetConfig()
+    now = 1000.0
+    db.note_fleet_worker("c", "w1", meta={"tier": "tpu"}, now=now)
+    db.note_fleet_worker("c", "w1-native", meta={"tier": "native"},
+                         now=now)
+    snap = _hb_snap(1000, hybrid_validations=2, hybrid_confirmed=1,
+                    hybrid_proxy_only=1)
+    snap["gauges"] = {"validation_queue_depth": 1,
+                      "validation_queue_age": 3.0}
+    db.upsert_campaign_stats("c", "w1", snap)
+    db.upsert_campaign_stats("c", "w1-native", _hb_snap(12))
+    body = fleet_view(db, cfg, "c", now=now + 1.0)
+    assert set(body["tiers"]) == {"tpu", "native"}
+    assert body["tiers"]["native"]["n_workers"] == 1
+    assert body["validation"]["validations"] == 2
+    assert body["validation"]["verdicts"]["confirmed"] == 1
+    assert body["validation"]["queue_depth"] == 1
+    # per-worker summary carries the hybrid numbers kb-fleet prints
+    ws = body["workers"]["w1"]["stats"]
+    assert ws["hybrid_validations"] == 2
+    assert ws["validation_queue_depth"] == 1
+    # /metrics: per-tier + verdict series appear for hybrid fleets
+    text = render_fleet_metrics(db, cfg, now=now + 1.0)
+    assert 'kbz_fleet_tier_workers{campaign="c",tier="native"}' \
+        in text
+    assert 'kbz_hybrid_validations_total{campaign="c",' \
+           'verdict="confirmed"} 1' in text
+    assert "kbz_validation_queue_depth" in text
+
+
+def test_pure_tpu_fleet_metrics_unchanged():
+    """Gating parity: a fleet with no tier tags and no hybrid
+    counters exports EXACTLY the historical series set."""
+    from killerbeez_tpu.manager.db import ManagerDB
+    from killerbeez_tpu.manager.fleet import (
+        FleetConfig, fleet_view, render_fleet_metrics,
+    )
+    db = ManagerDB()
+    db.note_fleet_worker("c", "w1", now=1000.0)
+    db.upsert_campaign_stats("c", "w1", _hb_snap(100))
+    text = render_fleet_metrics(db, FleetConfig(), now=1001.0)
+    assert "kbz_fleet_tier_workers" not in text
+    assert "kbz_hybrid_validations" not in text
+    body = fleet_view(db, FleetConfig(), "c", now=1001.0)
+    assert set(body["tiers"]) == {"tpu"}
+    assert body["validation"]["validations"] == 0
+
+
+def test_kb_fleet_json_shows_tiers_and_queue(capsys):
+    """Satellite: kb-fleet --json exposes per-tier worker counts and
+    the validation-queue depth through a LIVE manager, fed by the
+    bridge's own NativeHeartbeat."""
+    from killerbeez_tpu.manager.api import ManagerServer
+    from killerbeez_tpu.tools.fleet_tool import main as fleet_main
+    s = ManagerServer(port=0)
+    s.start()
+    try:
+        url = f"http://127.0.0.1:{s.port}"
+        import urllib.request
+        req = urllib.request.Request(
+            f"{url}/api/stats/c",
+            data=json.dumps({"worker": "w1",
+                             "snapshot": _hb_snap(100),
+                             "meta": {"tier": "tpu"}}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10).read()
+        bridge = HybridBridge(_binding(), workers=0)
+        bridge.enqueue("crash", b"Q", md5_hex(b"Q"))  # queued, unvalidated
+        hb = NativeHeartbeat(bridge, url, "c", "w1")
+        assert hb.post_once()
+        assert fleet_main([url, "--campaign", "c", "--json"]) == 0
+        body = json.loads(capsys.readouterr().out)
+        assert set(body["tiers"]) == {"tpu", "native"}
+        assert body["tiers"]["native"]["n_workers"] == 1
+        assert body["validation"]["queue_depth"] == 1
+        # the human rendering shows the tier column / hybrid lines
+        assert fleet_main([url, "--campaign", "c"]) == 0
+        text = capsys.readouterr().out
+        assert "tiers   :" in text and "native" in text
+    finally:
+        s.stop()
+
+
+# -- real-pair certification + e2e (native marker via corpus_bin) -------
+
+
+def test_builtin_bindings_certify_on_real_binaries(corpus_bin):
+    for name in ("test", "test_safe"):
+        cert = certify_binding(get_binding(name))
+        assert cert["certified"] is True, cert
+        assert cert["proxy"]["verdict"] == cert["native"]["verdict"]
+
+
+def test_divergent_benign_seed_refuses_bind(corpus_bin):
+    """A binding whose BENIGN seed already disagrees across tiers is
+    miswired and must refuse to bind (stand-down rule)."""
+    safe = get_binding("test_safe")
+    broken = ProxyBinding(name="broken", proxy_target="test",
+                          native=safe.native, benign_seed=b"ABCD")
+    cert = certify_binding(broken)
+    assert cert["certified"] is False
+    with pytest.raises(CertificationError):
+        bind(broken, certify=True, strict=True)
+
+
+def _run_campaign(tmp_path, binding_name, seed=b"ABCD", execs=512):
+    from killerbeez_tpu.drivers.factory import driver_factory
+    from killerbeez_tpu.fuzzer.loop import Fuzzer
+    from killerbeez_tpu.hybrid import make_bridge
+    from killerbeez_tpu.instrumentation.factory import (
+        instrumentation_factory,
+    )
+    from killerbeez_tpu.mutators.factory import mutator_factory
+    instr = instrumentation_factory("jit_harness",
+                                    json.dumps({"target": "test"}))
+    mut = mutator_factory("havoc", '{"seed": 7}', seed)
+    drv = driver_factory("file", None, instr, mut)
+    bridge = make_bridge(binding_name, repeats=3, queue_cap=32,
+                         workers=0)
+    out = tmp_path / "out"
+    fz = Fuzzer(drv, output_dir=str(out), batch_size=64,
+                write_findings=True, feedback=8, hybrid=bridge)
+    fz.run(execs)
+    events = [json.loads(line)
+              for line in open(out / "events.jsonl")]
+    counters = fz.telemetry.registry.snapshot()["counters"]
+    return out, bridge, events, counters
+
+
+def test_hybrid_e2e_planted_finding_confirmed(tmp_path, corpus_bin):
+    """The acceptance e2e: a planted proxy crash ("ABCD" on the test
+    KBVM target) translates, replays on the REAL binary and comes
+    back ``confirmed`` in the finding sidecar and event stream."""
+    out, bridge, events, c = _run_campaign(tmp_path, "test")
+    md5 = md5_hex(b"ABCD")
+    assert c.get("hybrid_confirmed", 0) >= 1
+    ctv = {e["md5"]: e for e in events
+           if e["type"] == "cross_tier_validate"}
+    assert ctv[md5]["verdict"] == "confirmed"
+    assert ctv[md5]["repro"] == 3
+    sc = json.load(open(out / "crashes" / f"{md5}.json"))
+    assert sc["validation"]["verdict"] == "confirmed"
+    assert sc["validation"]["tier"] == "native"
+    assert not (out / "proxy_gaps").exists()
+    assert bridge.queue.dropped == 0
+
+
+def test_hybrid_e2e_divergent_proxy_emits_gap(tmp_path, corpus_bin):
+    """Same planted finding against the deliberately divergent
+    hybrid-safe binary: ``proxy_only`` + a gap report, never a
+    silent drop."""
+    out, bridge, events, c = _run_campaign(tmp_path, "test_safe")
+    md5 = md5_hex(b"ABCD")
+    assert c.get("hybrid_proxy_only", 0) >= 1
+    ctv = {e["md5"]: e for e in events
+           if e["type"] == "cross_tier_validate"}
+    assert ctv[md5]["verdict"] == "proxy_only"
+    gaps = [e for e in events if e["type"] == "proxy_gap"]
+    assert gaps and gaps[0]["md5"] == md5
+    report = json.load(open(out / "proxy_gaps" / f"{md5}.json"))
+    assert report["schema"] == "kbz-proxy-gap-v1"
+    assert report["binding"] == "test_safe"
+    assert report["proxy"]["status"] == FUZZ_CRASH
+    assert report["native"]["repro"] == 0
+    # every enqueued finding got a verdict: nothing dropped
+    assert bridge.validated == bridge.enqueued
+    assert bridge.queue.dropped == 0
+
+
+def test_message_train_replay_on_real_stdin(corpus_bin):
+    """Framed sequences replay as stdin trains on a real binary: the
+    concatenated train reaches the target (test-plain crashes when
+    the messages concatenate to the magic)."""
+    from killerbeez_tpu.hybrid.registry import (
+        native_verdict, open_native,
+    )
+    spec = NativeSpec(argv=[corpus_bin("test-plain")],
+                      delivery="stdin_train", m_max=4)
+    binding = ProxyBinding(name="train", proxy_target="test",
+                           native=spec)
+    buf = frame_messages([b"AB", b"CD"], 4)
+    target = open_native(spec)
+    try:
+        kind, _ = native_verdict(target, spec, binding.translate(buf))
+        assert kind == FUZZ_CRASH
+        benign = frame_messages([b"AB", b"CX"], 4)
+        kind, _ = native_verdict(target, spec,
+                                 binding.translate(benign))
+        assert kind == FUZZ_NONE
+    finally:
+        target.close()
+
+
+def test_cli_refuses_unknown_binding(tmp_path, capsys):
+    """Stand-down at the CLI: an unknown binding exits 2 before any
+    fuzzing happens."""
+    from killerbeez_tpu.fuzzer.cli import main as cli_main
+    seed = tmp_path / "seed"
+    seed.write_bytes(b"hello")
+    rc = cli_main(["file", "jit_harness", "havoc",
+                   "-i", '{"target": "test"}', "-sf", str(seed),
+                   "-n", "16", "-o", str(tmp_path / "out"),
+                   "--hybrid", "no-such-binding"])
+    assert rc == 2
+    assert "no-such-binding" in capsys.readouterr().err

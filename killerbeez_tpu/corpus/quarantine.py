@@ -47,7 +47,10 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from ..utils.fileio import ensure_dir, md5_hex
 from ..utils.logging import WARNING_MSG
-from .store import CorpusEntry, VALIDATION_VERDICTS, coverage_hash
+from .store import (
+    CorpusEntry, MAX_VALIDATION_REPEATS, VALIDATION_VERDICTS,
+    coverage_hash,
+)
 
 #: quarantine subdirectory under a corpus store root
 QUARANTINE_DIR = "quarantine"
@@ -200,7 +203,8 @@ class EntryValidator:
                 return None, "schema:validation"
             sts = val.get("statuses")
             if sts is not None:
-                if not isinstance(sts, list) or len(sts) > 64 or \
+                if not isinstance(sts, list) or \
+                        len(sts) > MAX_VALIDATION_REPEATS or \
                         not all(isinstance(s, int) for s in sts):
                     return None, "schema:validation"
             detail = val.get("detail")

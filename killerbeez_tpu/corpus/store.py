@@ -83,6 +83,12 @@ _RESERVED = (STATE_FILE, MUTATOR_STATE_FILE, INSTR_STATE_FILE,
 # validator itself so the taxonomy cannot drift between layers.
 VALIDATION_VERDICTS = ("confirmed", "proxy_only", "flaky")
 
+# Sidecar schema bound on ``validation.statuses`` (one status per
+# native repeat).  NativeValidator clamps its repeats to this and
+# EntryValidator rejects longer lists, so a sidecar minted anywhere
+# in the fleet always syncs past every peer's validator.
+MAX_VALIDATION_REPEATS = 64
+
 
 def coverage_hash(sig: Optional[List[int]],
                   buf: Optional[bytes] = None,

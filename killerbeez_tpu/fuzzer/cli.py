@@ -140,7 +140,8 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="N",
                    help="native replays per finding before a verdict "
                         "(default 3: all crash = confirmed, none = "
-                        "proxy_only, else flaky)")
+                        "proxy_only, else flaky; clamped to 64, the "
+                        "sidecar schema's statuses bound)")
     p.add_argument("--hybrid-queue", type=int, default=256,
                    metavar="N",
                    help="validation queue bound (default 256); a full "

@@ -66,6 +66,18 @@ class NativeSpec:
         if self.delivery in ("stdin_train", "tcp") and self.m_max <= 0:
             raise ValueError(
                 f"delivery {self.delivery!r} needs m_max > 0")
+        # a spec that ExecTarget cannot actually deliver must be
+        # refused HERE: running the binary without its payload makes
+        # every genuinely-crashing finding classify as proxy_only
+        if self.delivery == "argv":
+            raise ValueError(
+                "delivery 'argv' has no native runner yet (translate "
+                "supports it; ExecTarget does not substitute argv "
+                "payloads) — use stdin or file")
+        if self.delivery == "file" and not self.input_file:
+            raise ValueError(
+                "delivery 'file' needs input_file (the path "
+                "exec_backend rewrites before each run)")
 
 
 @dataclass

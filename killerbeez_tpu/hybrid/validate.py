@@ -41,7 +41,11 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG
-from ..corpus.store import VALIDATION_VERDICTS, _atomic_write
+from ..corpus.store import (
+    MAX_VALIDATION_REPEATS,
+    VALIDATION_VERDICTS,
+    _atomic_write,
+)
 from ..utils.fileio import ensure_dir
 from ..utils.logging import INFO_MSG, WARNING_MSG
 from .registry import (
@@ -135,7 +139,13 @@ class NativeValidator:
                  run_fn: Optional[Callable[[bytes], int]] = None,
                  sleep_fn: Callable[[float], None] = time.sleep):
         self.binding = binding
-        self.repeats = max(1, int(repeats))
+        if int(repeats) > MAX_VALIDATION_REPEATS:
+            # one status lands per repeat; beyond the sidecar schema
+            # bound peers would quarantine the record on sync
+            WARNING_MSG(
+                "hybrid repeats %d exceeds the sidecar schema bound; "
+                "clamped to %d", int(repeats), MAX_VALIDATION_REPEATS)
+        self.repeats = max(1, min(int(repeats), MAX_VALIDATION_REPEATS))
         self.attempts = max(1, int(attempts))
         self.base_delay = float(base_delay)
         self._run_fn = run_fn
@@ -239,17 +249,26 @@ class HybridBridge:
 
     def __init__(self, binding: ProxyBinding, repeats: int = 3,
                  queue_cap: int = 256, workers: int = 1,
-                 validator: Optional[NativeValidator] = None):
+                 validator: Optional[NativeValidator] = None,
+                 validator_factory:
+                     Optional[Callable[[], NativeValidator]] = None):
         self.binding = binding
         self.queue = ValidationQueue(queue_cap)
-        self.validator = validator or NativeValidator(
-            binding, repeats=repeats)
+        # EVERY thread that replays natively owns its own validator —
+        # the underlying ExecTarget handle is not thread-safe and the
+        # retry path closes/reopens it mid-validate, so sharing one
+        # across workers races (corrupted verdicts, native crashes).
+        self._make_validator = validator_factory or (
+            lambda: NativeValidator(binding, repeats=repeats))
+        # loop-side validator: pump() / workers=0 synchronous mode
+        self.validator = validator or self._make_validator()
         # completed (item, verdict-record) pairs awaiting fold()
         self._results: List = []
         self._rlock = threading.Lock()
         self._parents: Dict[str, Optional[str]] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._worker_validators: List[NativeValidator] = []
         self.enqueued = 0
         self.validated = 0
         self.native_execs = 0
@@ -261,7 +280,9 @@ class HybridBridge:
         self.proxy_gaps = 0
         if workers > 0:
             for i in range(int(workers)):
-                th = threading.Thread(target=self._worker,
+                v = self._make_validator()
+                self._worker_validators.append(v)
+                th = threading.Thread(target=self._worker, args=(v,),
                                       name=f"hybrid-native-{i}",
                                       daemon=True)
                 th.start()
@@ -269,20 +290,20 @@ class HybridBridge:
 
     # -- worker side (native thread) ----------------------------------
 
-    def _worker(self) -> None:
+    def _worker(self, validator: NativeValidator) -> None:
         while not self._stop.is_set():
             item = self.queue.get(0.2)
             if item is None:
                 continue
             try:
-                result = self.validator.validate(item)
+                result = validator.validate(item)
             except Exception as e:     # never kill the campaign
                 WARNING_MSG("hybrid validator died on %s: %s",
                             item.md5, e)
                 result = {"md5": item.md5, "kind": item.kind,
                           "verdict": VERDICT_FLAKY,
                           "tier": "native", "repro": 0,
-                          "repeats": self.validator.repeats,
+                          "repeats": validator.repeats,
                           "attempts": 0, "statuses": [],
                           "t": round(time.time(), 3),
                           "detail": f"validator-error:"
@@ -299,10 +320,13 @@ class HybridBridge:
         thread).  Idempotent per md5."""
         if md5 in self._parents:
             return False
-        self._parents[md5] = parent
         ok = self.queue.put(ValidationItem(
             kind, buf, md5, parent=parent, proxy_status=proxy_status))
         if ok:
+            # record the dedup key only on admission: a finding the
+            # full queue rejected must stay eligible when it recurs
+            # after the queue drains
+            self._parents[md5] = parent
             self.enqueued += 1
         return ok
 
@@ -398,13 +422,34 @@ class HybridBridge:
         else:
             self.pump()
         self.fold(fuzzer)
+        if any(th.is_alive() for th in self._threads):
+            # a validation still in flight at the drain deadline
+            # appends its result after the fold above: grant one
+            # grace join and fold again so late verdicts land
+            # instead of silently vanishing
+            for th in self._threads:
+                th.join(timeout=0.5)
+            self.fold(fuzzer)
         self.validator.close()
-        if self.queue.depth() or self.queue.dropped:
+        stuck = 0
+        for th, v in zip(self._threads, self._worker_validators):
+            if th.is_alive():
+                # still mid-validate: closing its target under it is
+                # the exact race per-worker validators exist to avoid
+                stuck += 1
+            else:
+                v.close()
+        with self._rlock:
+            unfolded = len(self._results)
+        if self.queue.depth() or self.queue.dropped or unfolded \
+                or stuck:
             WARNING_MSG(
-                "hybrid bridge exiting with %d unvalidated and %d "
-                "dropped findings (native tier too slow — raise "
+                "hybrid bridge exiting with %d unvalidated, %d "
+                "dropped and %d unfolded findings; %d native "
+                "worker(s) still busy (native tier too slow — raise "
                 "--hybrid-queue or add native workers)",
-                self.queue.depth(), self.queue.dropped)
+                self.queue.depth(), self.queue.dropped, unfolded,
+                stuck)
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         """Native-tier stats block (heartbeat payload shape)."""

@@ -211,6 +211,22 @@ def test_entry_validator_rejects_malformed_hybrid_meta(mutate, expect):
     assert entry is None and reason == expect
 
 
+# -- native spec deliverability -----------------------------------------
+
+
+def test_native_spec_refuses_undeliverable_modes():
+    """A spec ExecTarget cannot actually deliver is refused at
+    construction: running the binary without its payload would make
+    every genuinely-crashing finding classify as proxy_only."""
+    with pytest.raises(ValueError, match="argv"):
+        NativeSpec(argv=["/bin/true"], delivery="argv")
+    with pytest.raises(ValueError, match="input_file"):
+        NativeSpec(argv=["/bin/true"], delivery="file")
+    spec = NativeSpec(argv=["/bin/true"], delivery="file",
+                      input_file="/tmp/kbz-in.bin")
+    assert spec.input_file == "/tmp/kbz-in.bin"
+
+
 # -- validation queue ---------------------------------------------------
 
 
@@ -292,6 +308,24 @@ def test_all_errors_is_flaky_not_proxy_gap():
     assert rec["verdict"] == VERDICT_FLAKY
     assert rec["detail"] == "native-exec-error"
     assert rec["attempts"] == 8 and len(sleeps) == 8
+
+
+def test_repeats_clamped_to_sidecar_schema_bound():
+    """--hybrid-repeats beyond the 64-status sidecar bound is clamped
+    so the minted record always syncs past peer EntryValidators."""
+    from killerbeez_tpu.corpus.store import MAX_VALIDATION_REPEATS
+    v = NativeValidator(_binding(), repeats=1000,
+                        run_fn=lambda buf: FUZZ_CRASH)
+    assert v.repeats == MAX_VALIDATION_REPEATS
+    rec = v.validate(_item())
+    assert len(rec["statuses"]) == MAX_VALIDATION_REPEATS
+    row = _row(b"DATA", [1],
+               validation={"verdict": rec["verdict"],
+                           "repro": rec["repro"],
+                           "repeats": rec["repeats"],
+                           "statuses": rec["statuses"]})
+    entry, reason = EntryValidator().validate(row)
+    assert reason is None, reason
 
 
 # -- scheduler credit ---------------------------------------------------
@@ -389,10 +423,10 @@ class _StubFuzzer:
 
 
 def _mk_bridge(run_fn, **kw):
-    b = HybridBridge(_binding(), workers=0, **kw)
-    b.validator = NativeValidator(_binding(), repeats=3,
-                                  run_fn=run_fn)
-    return b
+    return HybridBridge(
+        _binding(), workers=0,
+        validator=NativeValidator(_binding(), repeats=3,
+                                  run_fn=run_fn), **kw)
 
 
 def test_bridge_fold_confirmed_and_proxy_gap(tmp_path):
@@ -462,6 +496,22 @@ def test_bridge_fold_confirmed_and_proxy_gap(tmp_path):
     assert hc["hybrid_proxy_gaps"] == 1
 
 
+def test_enqueue_readmits_after_queue_full_drop(tmp_path):
+    """A finding the FULL queue rejected must stay eligible: the
+    dedup key is recorded only on admission, so the same md5 can be
+    enqueued again once the queue drains."""
+    bridge = _mk_bridge(lambda buf: FUZZ_CRASH, queue_cap=1)
+    assert bridge.enqueue("crash", b"A", md5_hex(b"A"))
+    assert not bridge.enqueue("crash", b"B", md5_hex(b"B"))  # full
+    assert bridge.queue.dropped == 1
+    assert bridge.pump() == 1
+    assert bridge.enqueue("crash", b"B", md5_hex(b"B")), \
+        "a dropped finding must not be dedup-blocked forever"
+    # admitted findings stay idempotent
+    assert not bridge.enqueue("crash", b"A", md5_hex(b"A"))
+    assert bridge.enqueued == 2
+
+
 def test_bridge_finish_drains_without_workers(tmp_path):
     fz = _StubFuzzer(tmp_path)
     bridge = _mk_bridge(lambda buf: FUZZ_CRASH)
@@ -476,9 +526,10 @@ def test_bridge_worker_thread_e2e(tmp_path):
     """workers=1: validation happens off-thread, fold on the caller —
     the single-writer discipline end to end."""
     fz = _StubFuzzer(tmp_path)
-    bridge = HybridBridge(_binding(), workers=1)
-    bridge.validator = NativeValidator(_binding(), repeats=2,
-                                       run_fn=lambda buf: FUZZ_CRASH)
+    bridge = HybridBridge(
+        _binding(), workers=1,
+        validator_factory=lambda: NativeValidator(
+            _binding(), repeats=2, run_fn=lambda buf: FUZZ_CRASH))
     for i in range(4):
         bridge.enqueue("crash", bytes([i]), md5_hex(bytes([i])))
     bridge.finish(fz, drain_timeout=10.0)
@@ -488,13 +539,52 @@ def test_bridge_worker_thread_e2e(tmp_path):
     assert bridge.snapshot()["counters"]["hybrid_validations"] == 4
 
 
+def test_bridge_multi_worker_validators_are_private(tmp_path):
+    """workers=2: each native worker thread owns its own validator
+    (and thus its own ExecTarget) — a shared handle would race under
+    the retry path's close()/reopen."""
+    import threading as _threading
+
+    made = []
+    used_by = {}
+    lock = _threading.Lock()
+
+    def factory():
+        def run(buf, _v=len(made)):
+            with lock:
+                used_by.setdefault(_v, set()).add(
+                    _threading.current_thread().name)
+            return FUZZ_CRASH
+        v = NativeValidator(_binding(), repeats=2, run_fn=run)
+        made.append(v)
+        return v
+
+    fz = _StubFuzzer(tmp_path)
+    bridge = HybridBridge(_binding(), workers=2,
+                          validator_factory=factory)
+    # loop-side validator + one per worker, all distinct instances
+    assert len(made) == 3
+    assert len({id(v) for v in made}) == 3
+    assert len(bridge._worker_validators) == 2
+    assert bridge.validator not in bridge._worker_validators
+    for i in range(8):
+        bridge.enqueue("crash", bytes([i]), md5_hex(bytes([i])))
+    bridge.finish(fz, drain_timeout=10.0)
+    c = fz.telemetry.registry.snapshot()["counters"]
+    assert c["hybrid_validations"] == 8
+    assert c["hybrid_confirmed"] == 8
+    # no validator instance was ever driven from two threads
+    assert all(len(threads) == 1 for threads in used_by.values())
+
+
 def test_bridge_validator_exception_becomes_flaky(tmp_path):
     def boom(buf):
         raise RuntimeError("native side exploded")
     fz = _StubFuzzer(tmp_path)
-    bridge = HybridBridge(_binding(), workers=1)
-    bridge.validator = NativeValidator(_binding(), run_fn=boom,
-                                       sleep_fn=lambda s: None)
+    bridge = HybridBridge(
+        _binding(), workers=1,
+        validator_factory=lambda: NativeValidator(
+            _binding(), run_fn=boom, sleep_fn=lambda s: None))
     bridge.enqueue("crash", b"A", md5_hex(b"A"))
     bridge.finish(fz, drain_timeout=10.0)
     c = fz.telemetry.registry.snapshot()["counters"]

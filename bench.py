@@ -1347,6 +1347,130 @@ def bench_hybrid(batch=256, execs=65536, gate=False):
     return 0
 
 
+def bench_repair(gate=False):
+    """--repair lane: the counterexample-guided conformance pipeline
+    (docs/ANALYSIS.md 'Conformance & repair') end-to-end against the
+    built-in test⇄hybrid-safe semantic gap, plus the honesty-contract
+    negative: an out-of-model gap must come back ``unrepairable``.
+
+    Positive: probe the ``test_safe`` binding (native tier never
+    crashes, proxy keeps the full ABCD magic) to mint real
+    kbz-proxy-gap-v1 counterexamples, then ``run_repair`` must (a)
+    localize the divergence to the actual differing guard — the
+    branch whose guarding constant is the 'D' byte, found from
+    dataflow, not hardcoded — and (b) emit a patch verified
+    verdict-identical to native on every gap input and both
+    certification seeds.  Negative: a gap claiming the loop-free
+    ``test`` proxy should HANG has no patch in the typed space, so
+    the verdict must be ``unrepairable`` with a machine-readable
+    reason, never a silent best-effort patch.
+
+    ``--gate`` exits nonzero on any miss.  Degrades to a
+    {"skipped": ...} row (exit 0) when the host toolchain is
+    unavailable.  Artifact: bench_out/BENCH_repair.json."""
+    import hashlib
+    import shutil
+    from killerbeez_tpu import FUZZ_HANG
+
+    os.makedirs(os.path.join(REPO, "bench_out"), exist_ok=True)
+    art = os.path.join(REPO, "bench_out", "BENCH_repair.json")
+    if not build_corpus():
+        row = emit("repair-skip",
+                   "conformance repair lane skipped: native "
+                   "toolchain / corpus build unavailable", 0.0,
+                   unit="skipped",
+                   skipped="native build unavailable")
+        with open(art, "w") as f:
+            json.dump({"rows": [row], "ok": None,
+                       "skipped": "native build unavailable"}, f,
+                      indent=1)
+        return 0
+
+    from killerbeez_tpu.analysis.dataflow import analyze_dataflow
+    from killerbeez_tpu.analysis.repair import run_repair
+    from killerbeez_tpu.hybrid.gaps import GapIndex, make_gap_report
+    from killerbeez_tpu.hybrid.registry import get_binding
+    from killerbeez_tpu.tools.repair_tool import _probe
+
+    rows = []
+    ok = True
+
+    # positive lane: the controlled test⇄hybrid-safe gap
+    binding = get_binding("test_safe")
+    gaps_dir = os.path.join(REPO, "bench_out", "repair_gaps")
+    shutil.rmtree(gaps_dir, ignore_errors=True)
+    t0 = time.time()
+    n_gaps = _probe(binding, gaps_dir, repeats=3)
+    result, patched = run_repair(binding, gaps_dir)
+    wall = max(time.time() - t0, 1e-9)
+
+    # the actual differing guard: hybrid-safe drops the final 'D'
+    # check, so blame must land on the branch whose guarding
+    # constant is ord('D') — looked up from dataflow, not pinned
+    program = binding.program()
+    want_pcs = {f.pc for f in analyze_dataflow(program).branches
+                if f.const == ord("D")}
+    blamed = [c.get("blame", {}).get("pc")
+              for c in result.get("clusters") or []]
+    localized = any(pc in want_pcs for pc in blamed)
+    repaired = result.get("status") == "repaired" and \
+        patched is not None
+    if n_gaps < 1:
+        ok = False
+        print("FAIL: probe minted no proxy-gap reports",
+              file=sys.stderr)
+    if not repaired:
+        ok = False
+        print(f"FAIL: repair verdict {result.get('status')!r} "
+              f"({result.get('reason')!r}) — expected repaired",
+              file=sys.stderr)
+    if not localized:
+        ok = False
+        print(f"FAIL: blame {blamed} missed the differing guard "
+              f"{sorted(want_pcs)}", file=sys.stderr)
+    rows.append(emit(
+        "repair-gap-corpus",
+        "probe test_safe gap corpus + counterexample-guided repair",
+        n_gaps / wall, unit="gaps/sec", gaps=n_gaps,
+        status=result.get("status"), blamed=blamed,
+        want_pcs=sorted(want_pcs),
+        patches=result.get("patches"), wall_s=round(wall, 2)))
+
+    # negative lane: an out-of-model gap (native claims the
+    # loop-free proxy hangs) must be honestly unrepairable
+    oom_dir = os.path.join(REPO, "bench_out", "repair_gaps_oom")
+    shutil.rmtree(oom_dir, ignore_errors=True)
+    faithful = get_binding("test")
+    buf = b"zzzz"
+    idx = GapIndex(oom_dir)
+    idx.admit(make_gap_report(
+        md5=hashlib.md5(buf).hexdigest(), kind="crash",
+        binding=faithful.name, proxy_target=faithful.proxy_target,
+        proxy_status=2, native_argv=["bench"],
+        native_delivery="stdin",
+        statuses=[FUZZ_HANG] * 3, repro=3, repeats=3,
+        t=1.0, input_bytes=buf))
+    oom, oom_patched = run_repair(faithful, oom_dir)
+    honest = oom.get("status") == "unrepairable" and \
+        oom_patched is None and bool(oom.get("reason"))
+    if not honest:
+        ok = False
+        print(f"FAIL: out-of-model gap got {oom.get('status')!r} "
+              f"({oom.get('reason')!r}) — expected an honest "
+              f"unrepairable", file=sys.stderr)
+    rows.append(emit(
+        "repair-out-of-model",
+        "out-of-model gap (native hang on loop-free proxy) stays "
+        "unrepairable", 1.0 if honest else 0.0, unit="honest",
+        status=oom.get("status"), reason=oom.get("reason")))
+
+    with open(art, "w") as f:
+        json.dump({"rows": rows, "ok": ok}, f, indent=1)
+    if gate and not ok:
+        return 1
+    return 0
+
+
 BENCH_R05_GATE = 1807549.5   # BENCH_r05 headline: execs/s/chip,
 #                              fused-pallas superbatch on tlvstack_vm
 
@@ -1866,6 +1990,18 @@ def main():
                       file=sys.stderr)
                 return 2
         return bench_hybrid(batch=batch, execs=execs, gate=gate)
+
+    if "--repair" in sys.argv[1:]:
+        # conformance repair lane:
+        #   python bench.py --repair [--gate]
+        rest = [a for a in sys.argv[1:] if a != "--repair"]
+        gate = "--gate" in rest
+        rest = [a for a in rest if a != "--gate"]
+        if rest:
+            print(f"error: unknown --repair arg {rest[0]!r}",
+                  file=sys.stderr)
+            return 2
+        return bench_repair(gate=gate)
 
     if "--crack" in sys.argv[1:]:
         # plateau-crack A/B mode:

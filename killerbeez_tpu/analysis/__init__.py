@@ -24,14 +24,34 @@ of facts AFL can only estimate dynamically is simply computable here:
                compositions, budget-capped enumeration beyond, every
                emitted input concretely verified) — the ``kb-solve``
                tool and the fuzzing loop's plateau crack stage
+  conformance.py  counterexample-guided proxy conformance — ingest
+               the hybrid tier's proxy-gap reports, replay-cluster
+               them through the reference interpreter, localize the
+               diverging guard (``kbz-proxy-blame-v1``), and lint
+               the gap backlog / drift (``kb-lint --gaps-dir``)
+  repair.py    verified proxy repair — bounded typed patch space
+               over the blamed guard, accepted ONLY when verdict-
+               identical to the native tier on every accumulated
+               counterexample + certification seed; anything else is
+               an honest ``unrepairable`` — the ``kb-repair`` tool
+               and the fuzzing loop's ``--auto-repair`` stage
 """
 
 from .cfg import ControlFlowGraph, build_cfg, static_edge_prior
+from .conformance import (
+    BLAME_SCHEMA, GAP_SCHEMA, BlameRecord, GapParseError, GapReport,
+    conformance_lint, load_gap_reports, localize, parse_gap_report,
+    replay_gaps, verdict_class,
+)
 from .dataflow import (
     BranchFact, DataflowResult, analyze_dataflow,
     dictionary_candidates, extract_dictionary,
 )
 from .lint import Finding, lint_program
+from .repair import (
+    REPAIR_SCHEMA, Patch, apply_patch, enumerate_patches, run_repair,
+    save_patched_program, write_repair_ledger,
+)
 from .solver import (
     SolveResult, concrete_run, edge_dep_mask, solve_edge, solve_edges,
 )
@@ -43,4 +63,10 @@ __all__ = [
     "Finding", "lint_program",
     "SolveResult", "concrete_run", "edge_dep_mask", "solve_edge",
     "solve_edges",
+    "GAP_SCHEMA", "BLAME_SCHEMA", "REPAIR_SCHEMA",
+    "GapReport", "GapParseError", "BlameRecord", "Patch",
+    "parse_gap_report", "load_gap_reports", "replay_gaps",
+    "localize", "verdict_class", "conformance_lint",
+    "apply_patch", "enumerate_patches", "run_repair",
+    "save_patched_program", "write_repair_ledger",
 ]

@@ -86,6 +86,11 @@ class ConcreteTrace:
     steps: int
     edges: List[Tuple[int, int]]    # (from block, to block), -1 = entry
     blocks: List[int]
+    #: every OP_BR executed, in order: (pc, x, y, taken) with the
+    #: concrete operand values at that step — the conformance pass's
+    #: blame-localization evidence (analysis/conformance.py)
+    branches: List[Tuple[int, int, int, bool]] = field(
+        default_factory=list)
 
 
 def concrete_run(program, data: bytes) -> ConcreteTrace:
@@ -103,6 +108,7 @@ def concrete_run(program, data: bytes) -> ConcreteTrace:
     status, exit_code, steps = FUZZ_RUNNING, 0, 0
     edges: List[Tuple[int, int]] = []
     blocks: List[int] = []
+    branches: List[Tuple[int, int, int, bool]] = []
     while status == FUZZ_RUNNING and steps < int(program.max_steps):
         steps += 1
         if pc < 0 or pc >= ni:
@@ -134,7 +140,9 @@ def concrete_run(program, data: bytes) -> ConcreteTrace:
             pc = a
         elif op == OP_BR:
             x, y = regs[_reg(a)], regs[(b >> 2) & (N_REGS - 1)]
-            pc = c if _fold_cmp(b & 3, x, y) else pc + 1
+            taken = _fold_cmp(b & 3, x, y)
+            branches.append((pc, x, y, bool(taken)))
+            pc = c if taken else pc + 1
         elif op == OP_CRASH:
             status = FUZZ_CRASH
         elif op == OP_LEN:
@@ -159,7 +167,7 @@ def concrete_run(program, data: bytes) -> ConcreteTrace:
     if status == FUZZ_RUNNING:
         status = FUZZ_HANG
     return ConcreteTrace(status=status, exit_code=exit_code, steps=steps,
-                         edges=edges, blocks=blocks)
+                         edges=edges, blocks=blocks, branches=branches)
 
 
 # --------------------------------------------------------------------

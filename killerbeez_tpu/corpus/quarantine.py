@@ -48,7 +48,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from ..utils.fileio import ensure_dir, md5_hex
 from ..utils.logging import WARNING_MSG
 from .store import (
-    CorpusEntry, MAX_VALIDATION_REPEATS, VALIDATION_VERDICTS,
+    CorpusEntry, MAX_VALIDATION_REPEATS, REPAIR_VERDICTS,
+    VALIDATION_VERDICTS,
     coverage_hash,
 )
 
@@ -211,6 +212,24 @@ class EntryValidator:
             if detail is not None and not (isinstance(detail, str)
                                            and len(detail) <= 256):
                 return None, "schema:validation"
+            repair = val.get("repair")
+            if repair is not None:
+                # kb-repair / --auto-repair write-back: verdict from
+                # the fixed (honest) taxonomy, bounded strings — a
+                # "repaired" claim changes which proxy peers trust,
+                # so its shape syncs as strictly as the verdict's
+                if not isinstance(repair, dict) or \
+                        repair.get("verdict") not in REPAIR_VERDICTS:
+                    return None, "schema:repair"
+                rt = repair.get("t")
+                if rt is not None and not isinstance(rt,
+                                                     (int, float)):
+                    return None, "schema:repair"
+                for key in ("patch", "reason"):
+                    v = repair.get(key)
+                    if v is not None and not (isinstance(v, str)
+                                              and len(v) <= 256):
+                        return None, "schema:repair"
         for key in ("selections", "finds", "discovered", "seq"):
             v = meta.get(key)
             if v is not None and not isinstance(v, (int, float)):

@@ -45,10 +45,18 @@ Sidecar schema (docs/CORPUS.md):
                                       # pre-hybrid sidecars omit it
      "validation": {"verdict": "confirmed" | "proxy_only" | "flaky",
                     "tier": ..., "repro": N, "repeats": N,
-                    "attempts": N, "statuses": [...], "t": unix_time}
+                    "attempts": N, "statuses": [...], "t": unix_time,
+                    "repair": {"verdict": "repaired" |
+                                          "unrepairable",
+                               "patch": str | null,
+                               "reason": str | null,
+                               "t": unix_time} | absent}
                                       # | null — cross-tier verdict
                                       # written back by the hybrid
-                                      # bridge (docs/HYBRID.md)
+                                      # bridge (docs/HYBRID.md); the
+                                      # repair subsection by
+                                      # kb-repair / --auto-repair
+                                      # (docs/ANALYSIS.md)
 
 Every write is atomic (tmp file + ``os.replace``, the telemetry
 sink's discipline) so a tailer or a crash mid-write never leaves a
@@ -88,6 +96,12 @@ VALIDATION_VERDICTS = ("confirmed", "proxy_only", "flaky")
 # EntryValidator rejects longer lists, so a sidecar minted anywhere
 # in the fleet always syncs past every peer's validator.
 MAX_VALIDATION_REPEATS = 64
+
+# Repair verdicts a proxy-gap entry's sidecar may carry under
+# validation.repair (kb-repair / --auto-repair write-back;
+# docs/ANALYSIS.md "Conformance & repair").  Honest by construction:
+# there is no "best-effort" value.
+REPAIR_VERDICTS = ("repaired", "unrepairable")
 
 
 def coverage_hash(sig: Optional[List[int]],
@@ -297,6 +311,30 @@ class CorpusStore:
             _atomic_write(path, json.dumps(meta).encode())
         except OSError as e:
             WARNING_MSG("corpus validation update failed for %s: %s",
+                        md5, e)
+            return False
+        return True
+
+    def update_repair(self, md5: str,
+                      repair: Dict[str, Any]) -> bool:
+        """Fold a repair verdict into one entry's ``validation``
+        block (``validation.repair``: verdict, patch/reason, t).
+        Entries without a validation block are skipped — a repair
+        claim only makes sense on a cross-tier-validated finding."""
+        path = self.meta_path(md5)
+        try:
+            with open(path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            return False
+        val = meta.get("validation")
+        if not isinstance(val, dict):
+            return False
+        val["repair"] = dict(repair)
+        try:
+            _atomic_write(path, json.dumps(meta).encode())
+        except OSError as e:
+            WARNING_MSG("corpus repair update failed for %s: %s",
                         md5, e)
             return False
         return True

@@ -152,6 +152,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="native validator threads (default 1; 0 = "
                         "validate synchronously at fold points — "
                         "deterministic, for tests)")
+    p.add_argument("--auto-repair", type=int, nargs="?", const=16,
+                   default=0, metavar="N",
+                   help="with --hybrid: plateau auto-repair stage "
+                        "(docs/ANALYSIS.md 'Conformance & repair') — "
+                        "after N batches with no new paths (default "
+                        "16 when bare), run the counterexample-guided "
+                        "repair pass over the accumulated proxy-gap "
+                        "reports: localize the diverging guard, "
+                        "search the bounded patch space, and install "
+                        "a <binding>+repaired binding ONLY when the "
+                        "patch is verdict-identical to the native "
+                        "tier on every gap input + certification "
+                        "seed (else an honest unrepairable verdict)")
     p.add_argument("--crack", type=int, nargs="?", const=16, default=0,
                    metavar="N",
                    help="plateau crack stage (KBVM device targets): "
@@ -657,6 +670,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 descend_lanes=args.descend_lanes,
                 descend_engine=args.descend_engine,
                 descend_scan_iters=args.descend_scan_iters)
+        if args.auto_repair:
+            if hybrid_bridge is None:
+                print("error: --auto-repair consumes the hybrid "
+                      "tier's proxy-gap reports — it needs --hybrid",
+                      file=sys.stderr)
+                return 2
+            from .repairer import ProxyRepairer
+            fuzzer.repairer = ProxyRepairer(
+                hybrid_bridge, plateau_batches=args.auto_repair)
         try:
             stats = fuzzer.run(args.iterations)
         except Exception as e:

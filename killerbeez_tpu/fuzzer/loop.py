@@ -246,6 +246,10 @@ class Fuzzer:
         #: inputs when coverage stalls, and feeds the focused-
         #: mutation masks; installed by the CLI's --crack wiring
         self.cracker = None
+        #: plateau auto-repair stage (fuzzer/repairer.py): consumes
+        #: accumulated proxy-gap counterexamples into a verified
+        #: patched proxy; installed by the CLI's --auto-repair wiring
+        self.repairer = None
         #: opt-in jax.profiler device capture: trace this many batches
         #: into <output>/device_trace next to the host trace.json
         self.profile_device = int(profile_device)
@@ -840,6 +844,11 @@ class Fuzzer:
             # and the event stream before the final push
             if self.hybrid is not None:
                 self.hybrid.finish(self)
+                # after the drain: every verdict (and gap report) has
+                # folded, so the run-end repair sees the full
+                # counterexample set
+                if self.repairer is not None:
+                    self.repairer.finish(self)
             # one forced sync round AFTER the drain: entries triaged
             # there (a short campaign triages everything in it) must
             # still reach the fleet
@@ -1367,6 +1376,11 @@ class Fuzzer:
                     with self.telemetry.timer("corpus_feedback"):
                         self._drain_ready(pending)
                         self.cracker.maybe_crack(self)
+                # conformance repair rides the same plateau signal:
+                # coverage stalls are when spending host time on the
+                # accumulated proxy-gap counterexamples is free
+                if self.repairer is not None:
+                    self.repairer.maybe_repair(self)
                 # opt-in device capture: starts at the next dispatch,
                 # stops after profile_device batches
                 if self.profile_device and not self._prof_active:

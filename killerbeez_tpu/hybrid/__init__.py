@@ -17,9 +17,19 @@ stream.  Four pieces:
     / ``proxy_only`` / ``flaky`` verdicts, proxy-gap reports;
   * :mod:`.reconcile` — per-tier coverage reconciliation: tier tags
     on entries / heartbeats / gossip rows, per-tier fleet folds, the
-    native-tier heartbeat.
+    native-tier heartbeat;
+  * :mod:`.gaps`      — bounded, deduped, indexed storage for
+    proxy-gap reports: the conformance/repair pass's counterexample
+    queue (analysis/conformance.py, analysis/repair.py).
 """
 
+from .gaps import (  # noqa: F401
+    GAP_SCHEMA,
+    GapIndex,
+    append_ledger,
+    load_ledger,
+    make_gap_report,
+)
 from .registry import (  # noqa: F401
     CertificationError,
     NativeSpec,
@@ -29,6 +39,7 @@ from .registry import (  # noqa: F401
     builtin_bindings,
     certify_binding,
     get_binding,
+    install_repaired,
     register_binding,
 )
 from .translate import (  # noqa: F401

@@ -90,8 +90,22 @@ class ProxyBinding:
     native: NativeSpec
     #: certification input: must be verdict-identical on both sides
     benign_seed: bytes = b"hello"
+    #: crash reproducers that must ALSO be verdict-identical at bind
+    #: time — () for deliberately-divergent fixtures like test_safe,
+    #: where crash divergence is the point, not a wiring bug.  These
+    #: double as the repair pass's certification obligations: a patch
+    #: that "fixes" a gap by breaking a known-good reproducer is
+    #: rejected (analysis/repair.py honesty contract).
+    crash_seeds: Tuple[bytes, ...] = ()
+    #: when set, the proxy program loads from this .npz instead of the
+    #: target registry — how a kb-repair patched proxy installs
+    #: without code changes
+    program_file: Optional[str] = None
 
     def program(self):
+        if self.program_file:
+            from ..models.targets import load_program_file
+            return load_program_file(self.program_file)
         from ..models.targets import get_target
         return get_target(self.proxy_target)
 
@@ -155,7 +169,8 @@ def builtin_bindings() -> List[ProxyBinding]:
             name="test", proxy_target="test",
             native=NativeSpec(argv=(os.path.join(d, "test-plain"),),
                               delivery="stdin"),
-            benign_seed=b"hello"),
+            benign_seed=b"hello",
+            crash_seeds=(b"ABCD",)),
         ProxyBinding(
             name="test_safe", proxy_target="test",
             native=NativeSpec(argv=(os.path.join(d, "hybrid-safe"),),
@@ -250,26 +265,64 @@ def certify_binding(binding: ProxyBinding) -> Dict[str, Any]:
         return {"certified": None, "binding": binding.name,
                 "reason": f"native binary missing: {exe} "
                           f"(make -C corpus)"}
-    p_kind = proxy_verdict(binding, binding.benign_seed)
     target = open_native(binding.native)
+    seeds = [("benign", binding.benign_seed)]
+    seeds += [(f"crash[{i}]", s)
+              for i, s in enumerate(binding.crash_seeds)]
     try:
-        delivery = binding.translate(binding.benign_seed)
-        n_kind, n_status = native_verdict(
-            target, binding.native, delivery)
+        for label, seed in seeds:
+            p_kind = proxy_verdict(binding, seed)
+            delivery = binding.translate(seed)
+            n_kind, n_status = native_verdict(
+                target, binding.native, delivery)
+            p_cls, n_cls = _verdict_class(p_kind), \
+                _verdict_class(n_kind)
+            if p_cls != n_cls:
+                return {
+                    "certified": False, "binding": binding.name,
+                    "reason": f"{label} seed diverges: proxy={p_cls} "
+                              f"native={n_cls}",
+                    "proxy": {"target": binding.proxy_target,
+                              "verdict": p_cls},
+                    "native": {"argv": list(binding.native.argv),
+                               "delivery": binding.native.delivery,
+                               "verdict": n_cls,
+                               "status": n_status},
+                }
     finally:
         target.close()
-    p_cls, n_cls = _verdict_class(p_kind), _verdict_class(n_kind)
-    ok = p_cls == n_cls
     return {
-        "certified": ok, "binding": binding.name,
-        "reason": (None if ok else
-                   f"benign seed diverges: proxy={p_cls} "
-                   f"native={n_cls}"),
+        "certified": True, "binding": binding.name, "reason": None,
+        "seeds": len(seeds),
         "proxy": {"target": binding.proxy_target, "verdict": p_cls},
         "native": {"argv": list(binding.native.argv),
                    "delivery": binding.native.delivery,
                    "verdict": n_cls, "status": n_status},
     }
+
+
+def install_repaired(base: ProxyBinding, program_path: str,
+                     certify: bool = True) -> ProxyBinding:
+    """Register ``<base.name>+repaired``: the same native side bound
+    to a kb-repair patched proxy program (.npz).
+
+    RE-certification is mandatory by default — a patched proxy gets
+    no grandfather rights from the binding it repairs.  When the
+    native substrate is unavailable the install is refused (None
+    certification is a skip, and a skipped check cannot admit a
+    program whose whole provenance is "I changed the semantics")."""
+    import dataclasses
+
+    repaired = dataclasses.replace(
+        base, name=f"{base.name}+repaired",
+        program_file=os.path.abspath(program_path))
+    if certify:
+        cert = certify_binding(repaired)
+        if cert["certified"] is not True:
+            raise CertificationError(
+                f"repaired binding {repaired.name!r} refused: "
+                f"{cert['reason'] or 'native tier unavailable'}")
+    return register_binding(repaired)
 
 
 def bind(binding: ProxyBinding, certify: bool = True,

@@ -48,6 +48,7 @@ from ..corpus.store import (
 )
 from ..utils.fileio import ensure_dir
 from ..utils.logging import INFO_MSG, WARNING_MSG
+from .gaps import GapIndex, make_gap_report, proxy_trace_edge
 from .registry import (
     ProxyBinding,
     get_binding,
@@ -214,28 +215,31 @@ class NativeValidator:
 
 def write_proxy_gap(output_dir: str, item: ValidationItem,
                     result: Dict[str, Any],
-                    binding: ProxyBinding) -> str:
+                    binding: ProxyBinding,
+                    index: Optional["GapIndex"] = None) -> str:
     """Write the machine-readable proxy-gap report (the contract in
     docs/HYBRID.md) for one ``proxy_only`` divergence; returns its
-    path."""
+    path (the existing report's path when the index dedups it).
+
+    Reports carry the concrete input and the proxy-trace edge so the
+    conformance pass can replay them as counterexamples; storage is
+    bounded+deduped through :class:`hybrid.gaps.GapIndex`."""
     gap_dir = os.path.join(output_dir, "proxy_gaps")
-    ensure_dir(gap_dir)
-    path = os.path.join(gap_dir, f"{item.md5}.json")
-    report = {
-        "schema": "kbz-proxy-gap-v1",
-        "md5": item.md5, "kind": item.kind,
-        "binding": binding.name,
-        "proxy": {"target": binding.proxy_target,
-                  "status": item.proxy_status},
-        "native": {"argv": list(binding.native.argv),
-                   "delivery": binding.native.delivery,
-                   "statuses": result.get("statuses", []),
-                   "repro": result.get("repro", 0),
-                   "repeats": result.get("repeats", 0)},
-        "t": result.get("t"),
-    }
-    _atomic_write(path, json.dumps(report, indent=1).encode())
-    return path
+    report = make_gap_report(
+        md5=item.md5, kind=item.kind, binding=binding.name,
+        proxy_target=binding.proxy_target,
+        proxy_status=item.proxy_status,
+        native_argv=binding.native.argv,
+        native_delivery=binding.native.delivery,
+        statuses=result.get("statuses", []),
+        repro=result.get("repro", 0),
+        repeats=result.get("repeats", 0),
+        t=result.get("t"),
+        input_bytes=item.buf,
+        edge=proxy_trace_edge(binding.program(), item.buf))
+    idx = index if index is not None else GapIndex(gap_dir)
+    path = idx.admit(report)
+    return path or os.path.join(gap_dir, f"{item.md5}.json")
 
 
 class HybridBridge:
@@ -278,6 +282,9 @@ class HybridBridge:
         # (CLI --sync-manager campaigns only sync corpus)
         self.verdict_counts: Dict[str, int] = {}
         self.proxy_gaps = 0
+        # lazy: the bounded gap-report index for this campaign's
+        # proxy_gaps/ dir (created on the first proxy_only verdict)
+        self._gap_index: Optional[GapIndex] = None
         if workers > 0:
             for i in range(int(workers)):
                 v = self._make_validator()
@@ -370,8 +377,12 @@ class HybridBridge:
             if verdict == VERDICT_PROXY_ONLY:
                 self.proxy_gaps += 1
                 reg.count("hybrid_proxy_gaps")
+                if self._gap_index is None:
+                    self._gap_index = GapIndex(os.path.join(
+                        fuzzer.output_dir, "proxy_gaps"))
                 gap_path = write_proxy_gap(
-                    fuzzer.output_dir, item, result, self.binding)
+                    fuzzer.output_dir, item, result, self.binding,
+                    index=self._gap_index)
                 fuzzer.telemetry.event(
                     "proxy_gap", md5=item.md5, kind=item.kind,
                     binding=self.binding.name, report=gap_path)

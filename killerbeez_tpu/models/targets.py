@@ -88,6 +88,13 @@ def load_program_from_options(options: Dict, missing_hint: str
     return prog
 
 
+def load_program_file(path: str) -> Program:
+    """Load one compiled ``.npz`` program (kb-compile output, or a
+    kb-repair ``--apply`` patched proxy)."""
+    return load_program_from_options(
+        {"program_file": path}, missing_hint="program_file")
+
+
 @register_target("test")
 def test_target() -> Program:
     """'ABCD' crasher: nested per-byte checks, crash = store through a

@@ -142,6 +142,15 @@ def render(snap: Dict[str, Any]) -> str:
             line += (f" | {_fmt_n(c.get('hybrid_proxy_gaps', 0))} "
                      "gap reports")
         lines.append(line)
+    if c.get("repair_attempts"):
+        line = (f"  repair   : "
+                f"{_fmt_n(c.get('repair_attempts', 0))} attempts"
+                f" | {_fmt_n(c.get('repair_repaired', 0))} repaired"
+                f" / {_fmt_n(c.get('repair_unrepairable', 0))} "
+                "unrepairable")
+        if c.get("repair_errors"):
+            line += f" | {_fmt_n(c.get('repair_errors', 0))} errors"
+        lines.append(line)
     if c.get("solver_attempts") or g.get("solver_frontier"):
         line = (f"  solver   : "
                 f"{_fmt_n(c.get('solver_solved', 0))} solved"

@@ -1,0 +1,791 @@
+"""Counterexample-guided proxy conformance (ISSUE 18).
+
+Covers the whole pipeline: the ``kbz-proxy-gap-v1`` emit→parse
+round-trip property (byte soup + framed message trains), PR 17
+backcompat, the bounded GapIndex (dedup / retention / manifest
+rebuild), replay clustering, divergence localization (the blame must
+land on the ACTUAL differing guard — looked up from dataflow, never
+hardcoded), verified repair under the honesty contract (out-of-model
+gaps stay ``unrepairable`` with a machine-readable reason), the
+conformance lint tier (backlog warning / drift error + SARIF source
+anchoring), the corpus repair-verdict sidecar bounds, and the
+``--auto-repair`` plateau stage.  Native-substrate e2e rides the
+``corpus_bin`` fixture and skips cleanly without the toolchain.
+"""
+
+import hashlib
+import json
+import random
+
+import pytest
+
+from killerbeez_tpu import FUZZ_CRASH, FUZZ_ERROR, FUZZ_HANG, FUZZ_NONE
+from killerbeez_tpu.analysis.conformance import (
+    BLAME_SCHEMA, GapParseError, conformance_lint, load_gap_reports,
+    localize, parse_gap_report, replay_gaps, verdict_class,
+)
+from killerbeez_tpu.analysis.dataflow import analyze_dataflow
+from killerbeez_tpu.analysis.repair import (
+    Obligation, apply_patch, certification_obligations,
+    enumerate_patches, run_repair, save_patched_program,
+    verify_program, write_repair_ledger,
+)
+from killerbeez_tpu.analysis.solver import concrete_run
+from killerbeez_tpu.corpus.quarantine import EntryValidator
+from killerbeez_tpu.corpus.store import CorpusEntry, CorpusStore
+from killerbeez_tpu.hybrid.gaps import (
+    GapIndex, append_ledger, load_ledger, make_gap_report,
+    proxy_trace_edge,
+)
+from killerbeez_tpu.hybrid.registry import (
+    CertificationError, NativeSpec, ProxyBinding, get_binding,
+    install_repaired,
+)
+from killerbeez_tpu.models.targets import get_target, load_program_file
+from killerbeez_tpu.stateful.framing import frame_messages
+from killerbeez_tpu.utils.fileio import md5_hex
+
+
+def _mk_report(buf, *, binding="test_safe", kind="crash",
+               proxy_status=FUZZ_CRASH, statuses=(0, 0, 0), t=1.0,
+               program=None, **over):
+    kw = dict(
+        md5=md5_hex(buf), kind=kind, binding=binding,
+        proxy_target="test", proxy_status=proxy_status,
+        native_argv=["corpus/build/hybrid-safe"],
+        native_delivery="stdin", statuses=list(statuses),
+        repro=statuses.count(FUZZ_CRASH), repeats=len(statuses),
+        t=t, input_bytes=buf,
+        edge=(proxy_trace_edge(program, buf)
+              if program is not None else None))
+    kw.update(over)
+    return make_gap_report(**kw)
+
+
+# -- emit→parse round-trip property (byte soup + framed trains) --------
+
+
+def _soups():
+    rng = random.Random(0x18c0de)
+    yield b""
+    yield b"ABCD"
+    yield b"\x00" * 9
+    yield bytes(range(256))
+    for n in (1, 7, 63, 255, 300, 1024):
+        yield bytes(rng.randrange(256) for _ in range(n))
+    # framed message trains are just bytes to the gap contract
+    yield frame_messages([b"Lpw", b"", b"QA\xff"], 4)
+    yield frame_messages([bytes(rng.randrange(256)
+                                for _ in range(rng.randrange(5)))
+                          for _ in range(6)], 8)
+
+
+def test_gap_report_roundtrip_property():
+    """make_gap_report -> parse_gap_report is the identity on every
+    field the repair pass consumes, for arbitrary byte soup."""
+    prog = get_target("test")
+    for i, buf in enumerate(_soups()):
+        statuses = [FUZZ_NONE, FUZZ_CRASH, FUZZ_ERROR][: 1 + i % 3]
+        rep = _mk_report(buf, statuses=statuses, t=float(i),
+                         program=prog)
+        gap = parse_gap_report(rep)
+        assert gap.md5 == md5_hex(buf)
+        assert gap.input == buf
+        assert gap.binding == "test_safe"
+        assert gap.proxy_status == FUZZ_CRASH
+        assert gap.native_statuses == statuses
+        assert gap.t == float(i)
+        assert gap.edge == proxy_trace_edge(prog, buf)
+        assert gap.proxy_cls == "crash"
+
+
+def test_gap_report_input_size_bound():
+    """Oversized inputs are never inlined — the report still parses,
+    counted unreplayable."""
+    rep = _mk_report(b"x" * ((1 << 16) + 1))
+    assert "input_hex" not in rep and rep["input_omitted"] > 1 << 16
+    gap = parse_gap_report(rep)
+    assert gap.input is None
+
+
+def test_pr17_shaped_report_backcompat():
+    """A PR 17-era report (no input_hex, no proxy.edge) parses; the
+    replay pass counts it skipped — never silently dropped."""
+    old = {
+        "schema": "kbz-proxy-gap-v1", "md5": "a" * 32,
+        "kind": "crash", "binding": "test_safe",
+        "proxy": {"target": "test", "status": FUZZ_CRASH},
+        "native": {"argv": ["x"], "delivery": "stdin",
+                   "statuses": [0, 0, 0], "repro": 0, "repeats": 3},
+        "t": 123.0,
+    }
+    gap = parse_gap_report(old)
+    assert gap.input is None and gap.edge is None
+    assert gap.native_cls == "ok" and gap.proxy_cls == "crash"
+    replay = replay_gaps(get_target("test"), [gap])
+    assert not replay.clusters
+    assert replay.skipped == [(gap, "no-input")]
+
+
+@pytest.mark.parametrize("mutate,reason", [
+    (dict(schema="kbz-proxy-gap-v0"), "gap:schema"),
+    (dict(md5=""), "gap:md5"),
+    (dict(kind="banana"), "gap:kind"),
+    (dict(binding=7), "gap:binding"),
+    (dict(proxy={"target": "test"}), "gap:proxy"),
+    (dict(native="nope"), "gap:native"),
+    (dict(native={"statuses": "all-fine"}), "gap:native.statuses"),
+    (dict(t="yesterday"), "gap:t"),
+    (dict(input_hex="zz"), "gap:input_hex"),
+])
+def test_parse_rejects_are_machine_greppable(mutate, reason):
+    rep = _mk_report(b"ABCD")
+    rep.update(mutate)
+    with pytest.raises(GapParseError, match=reason):
+        parse_gap_report(rep)
+
+
+def test_parse_rejects_bad_edge():
+    rep = _mk_report(b"ABCD")
+    rep["proxy"]["edge"] = [1, "two"]
+    with pytest.raises(GapParseError, match="gap:proxy.edge"):
+        parse_gap_report(rep)
+
+
+def test_native_cls_majority_excludes_errors():
+    gap = parse_gap_report(_mk_report(
+        b"Q", statuses=[FUZZ_ERROR, FUZZ_NONE, FUZZ_NONE, FUZZ_CRASH]))
+    assert gap.native_cls == "ok"
+    all_err = parse_gap_report(_mk_report(
+        b"Q", statuses=[FUZZ_ERROR, FUZZ_ERROR]))
+    assert all_err.native_cls is None
+    assert replay_gaps(get_target("test"), [all_err]).skipped[0][1] \
+        == "native-never-measured"
+
+
+def test_verdict_class_vocabulary():
+    assert [verdict_class(s) for s in
+            (FUZZ_NONE, FUZZ_HANG, FUZZ_CRASH, FUZZ_ERROR)] == \
+        ["ok", "hang", "crash", "error"]
+
+
+# -- bounded gap directory (GapIndex) ----------------------------------
+
+
+def test_gap_index_dedup_by_edge_kind_md5(tmp_path):
+    d = str(tmp_path / "gaps")
+    idx = GapIndex(d)
+    rep = _mk_report(b"ABCD", program=get_target("test"))
+    assert idx.admit(rep) is not None
+    assert idx.admit(rep) is None           # exact duplicate
+    assert idx.duplicates == 1
+    assert len(idx.entries) == 1
+    # same input, different kind -> a distinct counterexample
+    rep2 = dict(rep, kind="hang")
+    assert idx.admit(rep2) is not None
+    assert len(idx.entries) == 2
+
+
+def test_gap_index_cap_evicts_oldest(tmp_path):
+    d = str(tmp_path / "gaps")
+    idx = GapIndex(d, cap=3)
+    bufs = [bytes([i]) * 4 for i in range(5)]
+    for i, buf in enumerate(bufs):
+        idx.admit(_mk_report(buf, t=float(i)))
+    assert len(idx.entries) == 3 and idx.evicted == 2
+    kept = {e["md5"] for e in idx.entries}
+    assert kept == {md5_hex(b) for b in bufs[2:]}
+    # evicted report FILES are gone too
+    files = {p.name for p in (tmp_path / "gaps").glob("*.json")}
+    assert f"{md5_hex(bufs[0])}.json" not in files
+    # the manifest is an honest ledger of the bound
+    doc = json.loads((tmp_path / "gaps" / "index.json").read_text())
+    assert doc["schema"] == "kbz-proxy-gap-index-v1"
+    assert doc["evicted"] == 2 and len(doc["entries"]) == 3
+
+
+def test_gap_index_rebuilds_from_torn_manifest(tmp_path):
+    d = str(tmp_path / "gaps")
+    idx = GapIndex(d)
+    for buf in (b"one1", b"two2"):
+        idx.admit(_mk_report(buf))
+    (tmp_path / "gaps" / "index.json").write_text("{torn")
+    again = GapIndex(d)
+    assert {e["md5"] for e in again.entries} == \
+        {md5_hex(b"one1"), md5_hex(b"two2")}
+    # a PR 17-era dir (no manifest at all) also indexes on first touch
+    (tmp_path / "gaps" / "index.json").unlink()
+    assert len(GapIndex(d).entries) == 2
+
+
+def test_ledger_roundtrip_bounded_and_torn(tmp_path):
+    d = str(tmp_path / "gaps")
+    assert load_ledger(d) == []
+    for i in range(5):
+        append_ledger(d, {"status": "repaired", "i": i}, cap=3)
+    got = load_ledger(d)
+    assert [r["i"] for r in got] == [2, 3, 4]
+    (tmp_path / "gaps" / "repairs.json").write_text("not json")
+    assert load_ledger(d) == []
+
+
+def test_load_gap_reports_surfaces_rejects(tmp_path):
+    d = tmp_path / "gaps"
+    GapIndex(str(d)).admit(_mk_report(b"ABCD"))
+    (d / "bogus.json").write_text("{")
+    (d / "wrong.json").write_text(json.dumps({"schema": "nope"}))
+    reports, rejects = load_gap_reports(str(d))
+    assert len(reports) == 1
+    assert sorted(r[0] for r in rejects) == ["bogus.json",
+                                             "wrong.json"]
+
+
+# -- replay clustering + localization ----------------------------------
+
+
+def _d_check(program):
+    """The ACTUAL differing guard of the test⇄hybrid-safe pair: the
+    branch whose guarding constant is the 'D' byte — found from
+    dataflow, never hardcoded."""
+    facts = [f for f in analyze_dataflow(program).branches
+             if f.const == ord("D")]
+    assert len(facts) == 1
+    return facts[0]
+
+
+def _gap_corpus(tmp_path, program, bufs=(b"ABCD", b"ABCDxx",
+                                         b"ABCD\x00\x01"),
+                name="gaps"):
+    d = str(tmp_path / name)
+    idx = GapIndex(d)
+    for buf in bufs:
+        idx.admit(_mk_report(buf, program=program))
+    return d
+
+
+def test_replay_clusters_by_diverging_edge(tmp_path):
+    program = get_target("test")
+    d = _gap_corpus(tmp_path, program)
+    reports, rejects = load_gap_reports(d)
+    assert not rejects and len(reports) == 3
+    replay = replay_gaps(program, reports)
+    assert len(replay.clusters) == 1        # one diverging guard
+    cl = replay.clusters[0]
+    assert cl.proxy_cls == "crash" and cl.native_cls == "ok"
+    assert len(cl.reports) == 3 == len(cl.traces)
+    assert cl.edge == tuple(cl.traces[0].edges[-1])
+
+
+def test_replay_stale_when_proxy_already_agrees():
+    program = get_target("test")
+    gap = parse_gap_report(_mk_report(
+        b"NOPE", statuses=[FUZZ_NONE] * 3))   # proxy agrees: benign
+    replay = replay_gaps(program, [gap])
+    assert replay.stale == [gap] and not replay.clusters
+
+
+def test_localize_blames_the_differing_guard(tmp_path):
+    program = get_target("test")
+    d = _gap_corpus(tmp_path, program)
+    reports, _ = load_gap_reports(d)
+    replay = replay_gaps(program, reports)
+    blame = localize(program, replay.clusters[0])
+    want = _d_check(program)
+    assert blame.pc == want.pc
+    assert blame.cmp == want.cmp
+    assert blame.const == ord("D")
+    assert blame.deps == sorted(want.deps)
+    assert set(blame.inputs) == {md5_hex(b) for b in
+                                 (b"ABCD", b"ABCDxx",
+                                  b"ABCD\x00\x01")}
+    # observed operands carry the concrete evidence: x == y == 'D'
+    assert all(x == ord("D") for x, _y, _tk in blame.observed)
+    rec = blame.as_dict()
+    assert rec["schema"] == BLAME_SCHEMA
+    assert rec["pc"] == want.pc and rec["candidates"][0] == want.pc
+
+
+def test_localize_skips_constant_only_branches():
+    """A trace whose only branches are input-independent yields no
+    blame (None) — repair must report it, not guess."""
+    from killerbeez_tpu.analysis.conformance import GapCluster
+    program = get_target("test")
+    trace = concrete_run(program, b"ABCD")
+    facts = {f.pc: f for f in analyze_dataflow(program).branches}
+    cluster = GapCluster(edge=(0, 1), proxy_cls="crash",
+                        native_cls="ok", reports=[], traces=[trace])
+    # with real facts the D-check wins; with every branch forced
+    # constant there is nothing input-dependent to indict
+    import killerbeez_tpu.analysis.conformance as conf
+    blame = localize(program, cluster)
+    assert blame is not None
+    constant = {pc: type(f)(pc=f.pc, block=f.block, cmp=f.cmp,
+                            const=f.const, deps=frozenset(),
+                            always=f.always, len_dep=False)
+                for pc, f in facts.items()}
+
+    class _DF:
+        branches = list(constant.values())
+    assert localize(program, cluster, _DF()) is None
+    assert conf._input_dependent(None) is True
+
+
+# -- verified repair (the honesty contract) ----------------------------
+
+
+def test_repair_e2e_in_process(tmp_path):
+    """run_repair on the controlled gap corpus: localized to the
+    D-check, patched, and the patch is verdict-identical to native
+    on every gap input AND both certification seeds."""
+    binding = get_binding("test_safe")
+    program = binding.program()
+    d = _gap_corpus(tmp_path, program)
+    result, patched = run_repair(binding, d)
+    assert result["status"] == "repaired", result
+    assert patched is not None
+    want = _d_check(program)
+    [cl] = result["clusters"]
+    assert cl["blame"]["pc"] == want.pc
+    assert cl["status"] == "repaired" and cl["patch_desc"]
+    # every gap input now classifies like the native tier (ok)...
+    for buf in (b"ABCD", b"ABCDxx", b"ABCD\x00\x01"):
+        assert verdict_class(concrete_run(patched, buf).status) == "ok"
+    # ...and the benign certification seed kept its class
+    assert verify_program(
+        patched, certification_obligations(binding, program)) == []
+    # the ORIGINAL program still crashes — repair copied, not mutated
+    assert verdict_class(concrete_run(program, b"ABCD").status) \
+        == "crash"
+
+
+def test_repair_out_of_model_is_honestly_unrepairable(tmp_path):
+    """A gap claiming the loop-free proxy should HANG has no patch in
+    the typed space: verdict ``unrepairable``, machine-readable
+    reason, NO best-effort program."""
+    binding = get_binding("test")
+    d = str(tmp_path / "gaps")
+    GapIndex(d).admit(_mk_report(
+        b"zzzz", binding="test", proxy_status=FUZZ_CRASH,
+        statuses=[FUZZ_HANG] * 3))
+    # the proxy is benign on zzzz: claim crash via a crashing input
+    # replayed as hang-expected instead
+    GapIndex(d).admit(_mk_report(
+        b"ABCD", binding="test", statuses=[FUZZ_HANG] * 3))
+    result, patched = run_repair(binding, d)
+    assert result["status"] == "unrepairable"
+    assert patched is None
+    assert result["reason"]                  # machine-readable, always
+    assert any(result["reason"].startswith(p)
+               for p in ("patch:", "blame:", "verify:", "gap:"))
+
+
+def test_repair_no_gaps_and_foreign_reports(tmp_path):
+    binding = get_binding("test_safe")
+    d = str(tmp_path / "gaps")
+    result, patched = run_repair(binding, d)
+    assert result["status"] == "no-gaps"
+    assert result["reason"] == "gap:none-for-binding"
+    # a foreign binding's reports are counted, never consumed
+    GapIndex(d).admit(_mk_report(b"ABCD", binding="someone-else"))
+    result, _ = run_repair(binding, d)
+    assert result["status"] == "no-gaps" and result["foreign"] == 1
+
+
+def test_repair_unreplayable_only_is_unrepairable(tmp_path):
+    """Gap reports with no input bytes cannot anchor a repair: the
+    verdict is unrepairable (gap:no-replayable-inputs), not no-gaps —
+    there IS evidence, it just cannot be consumed."""
+    binding = get_binding("test_safe")
+    d = str(tmp_path / "gaps")
+    rep = _mk_report(b"ABCD")
+    del rep["input_hex"]
+    GapIndex(d).admit(rep)
+    result, patched = run_repair(binding, d)
+    assert result["status"] == "unrepairable" and patched is None
+    assert result["reason"] == "gap:no-replayable-inputs"
+
+
+def test_patch_space_is_bounded_and_row_local():
+    program = get_target("test")
+    from killerbeez_tpu.analysis.repair import MAX_PATCHES_PER_CLUSTER
+    import numpy as np
+    gap = parse_gap_report(_mk_report(b"ABCD", program=program))
+    replay = replay_gaps(program, [gap])
+    blame = localize(program, replay.clusters[0])
+    patches = enumerate_patches(program, blame)
+    assert 0 < len(patches) <= MAX_PATCHES_PER_CLUSTER
+    for p in patches:
+        patched = apply_patch(program, p)
+        before = np.asarray(program.instrs)
+        after = np.asarray(patched.instrs)
+        diff = np.argwhere((before != after).any(axis=1)).ravel()
+        assert list(diff) == [p.pc]          # exactly one row rewritten
+        assert patched.n_blocks == program.n_blocks
+        assert list(patched.block_ids) == list(program.block_ids)
+
+
+def test_save_patched_program_roundtrip(tmp_path):
+    binding = get_binding("test_safe")
+    d = _gap_corpus(tmp_path, binding.program())
+    result, patched = run_repair(binding, d)
+    out = str(tmp_path / "repaired.npz")
+    save_patched_program(patched, out)
+    loaded = load_program_file(out)
+    assert loaded.name.endswith("+repaired")
+    assert loaded.n_blocks == patched.n_blocks
+    assert list(loaded.block_ids) == list(patched.block_ids)
+    assert verdict_class(concrete_run(loaded, b"ABCD").status) == "ok"
+
+
+def test_write_repair_ledger_consumes_inputs(tmp_path):
+    binding = get_binding("test_safe")
+    d = _gap_corpus(tmp_path, binding.program())
+    result, _ = run_repair(binding, d)
+    assert write_repair_ledger(d, result) == 1
+    [rec] = load_ledger(d)
+    assert rec["binding"] == "test_safe"
+    assert rec["status"] == "repaired" and rec["patch"]
+    assert set(rec["consumed"]) == \
+        {md5_hex(b) for b in (b"ABCD", b"ABCDxx", b"ABCD\x00\x01")}
+
+
+def test_install_repaired_refuses_uncertifiable(tmp_path):
+    """A 'repaired' program the native tier cannot re-certify is
+    refused — install_repaired never grandfathers a patched proxy.
+    (Native absent counts as refusal: a skipped check cannot admit a
+    program whose whole provenance is changed semantics.)"""
+    binding = ProxyBinding(
+        name="cert-refuse", proxy_target="test",
+        native=NativeSpec(argv=["/nonexistent/definitely-not-built"]),
+        benign_seed=b"hello")
+    out = str(tmp_path / "p.npz")
+    save_patched_program(get_target("test"), out)
+    with pytest.raises(CertificationError):
+        install_repaired(binding, out)
+
+
+# -- conformance lint (kb-lint --gaps-dir) -----------------------------
+
+
+def test_lint_backlog_warning_thresholded(tmp_path):
+    program = get_target("test")
+    d = _gap_corpus(tmp_path, program)
+    assert conformance_lint(d, backlog_threshold=8) == []
+    findings = conformance_lint(d, backlog_threshold=0)
+    [f] = findings
+    assert f.severity == "warning" and f.code == "proxy-gap-backlog"
+    assert f.data["unconsumed"] == 3
+    assert f.data["binding"] == "test_safe"
+
+
+def test_lint_backlog_clears_when_ledger_consumes(tmp_path):
+    binding = get_binding("test_safe")
+    d = _gap_corpus(tmp_path, binding.program())
+    result, _ = run_repair(binding, d)
+    write_repair_ledger(d, result)
+    assert conformance_lint(d, backlog_threshold=0) == []
+
+
+def test_lint_drift_error_on_regressed_repair(tmp_path):
+    binding = get_binding("test_safe")
+    program = binding.program()
+    d = _gap_corpus(tmp_path, program)
+    result, _ = run_repair(binding, d)
+    write_repair_ledger(d, result)
+    # a NEWER gap on the repaired (binding, edge) site = drift
+    GapIndex(d).admit(_mk_report(b"ABCDQQ", t=result["t"] + 1000,
+                                 program=program))
+    findings = conformance_lint(d, backlog_threshold=99)
+    [f] = [x for x in findings if x.code == "conformance-drift"]
+    assert f.severity == "error"
+    assert f.data["binding"] == "test_safe"
+    assert f.data["newer"] == [md5_hex(b"ABCDQQ")]
+    # errors sort first for the SARIF/report stream
+    assert findings[0].code == "conformance-drift"
+
+
+def test_lint_tool_sarif_anchors_binding_source_line(tmp_path):
+    """Satellite: the SARIF physicalLocation for conformance findings
+    must anchor on the BINDING's proxy program source line (the
+    registered target builder), not a synthetic URI."""
+    from killerbeez_tpu.tools.lint_tool import (
+        conformance_reports, sarif_report,
+    )
+    d = _gap_corpus(tmp_path, get_target("test"))
+    reports = conformance_reports(d, threshold=0)
+    assert set(reports) == {"conformance:test_safe"}
+    rec = reports["conformance:test_safe"]
+    assert rec["location"]["uri"].endswith("models/targets.py")
+    assert rec["location"]["line"] > 1
+    sarif = sarif_report({k: v["report"] for k, v in reports.items()},
+                         {k: v["location"] for k, v in reports.items()})
+    res = sarif["runs"][0]["results"]
+    assert res, "backlog finding must surface in SARIF"
+    loc = res[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("models/targets.py")
+    assert loc["region"]["startLine"] == rec["location"]["line"]
+
+
+def test_lint_tool_cli_gaps_dir_lane(tmp_path, capsys):
+    from killerbeez_tpu.tools.lint_tool import main as lint_main
+    d = _gap_corpus(tmp_path, get_target("test"))
+    # warnings alone exit 0; the lane lints ONLY conformance
+    rc = lint_main(["--gaps-dir", d, "--gap-backlog", "0", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    # --gaps-dir alone lints ONLY the conformance tier
+    assert set(out["targets"]) == {"conformance:test_safe"}
+    assert out["warnings"] == 1 and out["errors"] == 0
+    codes = [f["code"] for f in
+             out["targets"]["conformance:test_safe"]["findings"]]
+    assert codes == ["proxy-gap-backlog"]
+    # an empty gap dir is a clean bill
+    assert lint_main(["--gaps-dir", str(tmp_path / "none"),
+                      "--gap-backlog", "0"]) == 0
+
+
+# -- corpus sidecar: validation.repair bounds --------------------------
+
+
+def test_store_update_repair_requires_validation_block(tmp_path):
+    store = CorpusStore(str(tmp_path))
+    e = CorpusEntry(b"GAPPY", sig=[4])
+    store.put(e)
+    rep = {"verdict": "repaired", "patch": "const-nudge@pc22",
+           "reason": None, "t": 5.0}
+    # no validation block yet: repair has nothing to attach to
+    assert store.update_repair(e.md5, rep) is False
+    store.update_validation(e.md5, {"verdict": "proxy_only",
+                                    "repro": 0, "repeats": 3})
+    assert store.update_repair(e.md5, rep) is True
+    got = {x.md5: x for x in store.load()}[e.md5]
+    assert got.validation["repair"]["verdict"] == "repaired"
+    assert store.update_repair("f" * 32, rep) is False
+
+
+def _val_row(buf, repair):
+    import base64
+    from killerbeez_tpu.corpus.store import coverage_hash
+    meta = {"sig": [1], "md5": md5_hex(buf),
+            "cov_hash": coverage_hash([1], buf), "seq": 0,
+            "source": "local", "tier": "native",
+            "validation": {"verdict": "proxy_only", "repro": 0,
+                           "repeats": 3, "repair": repair}}
+    return {"worker": "w", "md5": md5_hex(buf),
+            "cov_hash": coverage_hash([1], buf),
+            "content_b64": base64.b64encode(buf).decode(),
+            "meta": meta}
+
+
+def test_entry_validator_accepts_bounded_repair():
+    entry, reason = EntryValidator().validate(_val_row(
+        b"DATA", {"verdict": "unrepairable", "patch": None,
+                  "reason": "patch:space-exhausted", "t": 9.0}))
+    assert reason is None
+    assert entry.validation["repair"]["verdict"] == "unrepairable"
+
+
+@pytest.mark.parametrize("repair", [
+    "repaired",                              # not a dict
+    {"verdict": "probably"},                 # unknown verdict
+    {"verdict": "repaired", "t": "noon"},    # non-numeric t
+    {"verdict": "repaired", "patch": "p" * 257},
+    {"verdict": "repaired", "reason": ["x"]},
+])
+def test_entry_validator_rejects_malformed_repair(repair):
+    entry, reason = EntryValidator().validate(_val_row(b"DATA",
+                                                       repair))
+    assert entry is None and reason == "schema:repair"
+
+
+# -- the --auto-repair plateau stage -----------------------------------
+
+
+class _Stats:
+    def __init__(self):
+        self.new_paths = 0
+        self.iterations = 0
+
+
+class _Telemetry:
+    def __init__(self):
+        from killerbeez_tpu.telemetry import MetricsRegistry
+        self.registry = MetricsRegistry()
+        self.events = []
+
+    def event(self, etype, **fields):
+        self.events.append({"type": etype, **fields})
+
+
+class _RepairStubFuzzer:
+    PIPELINE_DEPTH = 0
+
+    def __init__(self, out, store=None):
+        self.stats = _Stats()
+        self.batch_size = 1
+        self.output_dir = str(out)
+        self.telemetry = _Telemetry()
+        self.store = store
+
+
+class _StubBridge:
+    def __init__(self, binding, gaps=0):
+        self.binding = binding
+        self.proxy_gaps = gaps
+
+
+def test_proxy_repairer_fires_only_at_plateau_with_new_gaps(tmp_path):
+    from killerbeez_tpu.fuzzer.repairer import ProxyRepairer
+    binding = get_binding("test_safe")
+    _gap_corpus(tmp_path, binding.program(), name="proxy_gaps")
+    fz = _RepairStubFuzzer(tmp_path)
+    bridge = _StubBridge(binding, gaps=3)
+    rep = ProxyRepairer(bridge, plateau_batches=4, apply=False)
+    # progress: never fires
+    for i in range(10):
+        fz.stats.iterations = i
+        fz.stats.new_paths = i
+        rep.maybe_repair(fz)
+    assert rep.attempts == 0
+    # plateau, but not past the window yet
+    fz.stats.iterations += 3
+    rep.maybe_repair(fz)
+    assert rep.attempts == 0
+    # past the window with accumulated gaps: one attempt
+    fz.stats.iterations += 10
+    rep.maybe_repair(fz)
+    assert rep.attempts == 1 and rep.last_status == "repaired"
+    c = fz.telemetry.registry.snapshot()["counters"]
+    assert c["repair_attempts"] == 1 and c["repair_repaired"] == 1
+    [ev] = [e for e in fz.telemetry.events
+            if e["type"] == "proxy_repair"]
+    assert ev["status"] == "repaired" and ev["clusters"] == 1
+    # same evidence, next plateau: re-arms only when gaps GROW
+    fz.stats.iterations += 10
+    rep.maybe_repair(fz)
+    assert rep.attempts == 1
+    bridge.proxy_gaps += 1
+    rep.finish(fz)
+    assert rep.attempts == 2
+
+
+def test_proxy_repairer_writes_back_corpus_and_ledger(tmp_path,
+                                                      monkeypatch):
+    import killerbeez_tpu.hybrid.registry as registry
+    from killerbeez_tpu.fuzzer.repairer import ProxyRepairer
+    # install is the real-substrate e2e's job; stub it so this unit
+    # test neither needs the native toolchain nor touches the registry
+    monkeypatch.setattr(registry, "install_repaired",
+                        lambda base, path, certify=True: base)
+    binding = get_binding("test_safe")
+    store = CorpusStore(str(tmp_path / "corpus"))
+    e = CorpusEntry(b"ABCD", sig=[2])
+    store.put(e)
+    store.update_validation(e.md5, {"verdict": "proxy_only",
+                                    "repro": 0, "repeats": 3})
+    _gap_corpus(tmp_path, binding.program(), name="proxy_gaps")
+    fz = _RepairStubFuzzer(tmp_path, store=store)
+    rep = ProxyRepairer(_StubBridge(binding, gaps=3), apply=True)
+    result = rep.repair(fz)
+    assert result["status"] == "repaired"
+    # ledger landed (the lint's consumed-set)...
+    gaps_dir = str(tmp_path / "proxy_gaps")
+    assert load_ledger(gaps_dir)
+    # ...and the corpus entry's sidecar carries the repair verdict
+    got = {x.md5: x for x in store.load()}[e.md5]
+    assert got.validation["repair"]["verdict"] == "repaired"
+    assert got.validation["repair"]["patch"]
+
+
+def test_fuzzer_loop_wires_repairer_hooks():
+    """The loop drives repairer.maybe_repair at batch end and
+    repairer.finish after the bridge drains — presence pins."""
+    import inspect
+    from killerbeez_tpu.fuzzer.loop import Fuzzer
+    src = inspect.getsource(Fuzzer)
+    assert "self.repairer.maybe_repair(self)" in src
+    assert "self.repairer.finish(self)" in src
+
+
+def test_cli_auto_repair_requires_hybrid(tmp_path, capsys):
+    from killerbeez_tpu.fuzzer.cli import main as cli_main
+    seed = tmp_path / "seed"
+    seed.write_bytes(b"AAAA")
+    rc = cli_main(["file", "jit_harness", "havoc",
+                   "-i", '{"target": "test"}', "-sf", str(seed),
+                   "-o", str(tmp_path / "out"), "-n", "16",
+                   "-b", "16", "--auto-repair"])
+    err = capsys.readouterr().err
+    assert rc == 2 and "--hybrid" in err
+
+
+# -- kb-repair CLI + native e2e (corpus_bin) ---------------------------
+
+
+def test_repair_tool_unknown_binding_exits_2(tmp_path, capsys):
+    from killerbeez_tpu.tools.repair_tool import main as repair_main
+    rc = repair_main(["--binding", "no-such", "--gaps-dir",
+                      str(tmp_path)])
+    assert rc == 2
+
+
+def test_repair_tool_require_repaired_gate(tmp_path, capsys):
+    from killerbeez_tpu.tools.repair_tool import main as repair_main
+    binding = get_binding("test_safe")
+    d = _gap_corpus(tmp_path, binding.program())
+    assert repair_main(["--binding", "test_safe", "--gaps-dir", d,
+                        "--require-repaired", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["status"] == "repaired"
+    assert out["clusters"][0]["blame"]["pc"] == \
+        _d_check(binding.program()).pc
+    # empty dir: no-gaps fails the gate
+    assert repair_main(["--binding", "test_safe", "--gaps-dir",
+                        str(tmp_path / "empty"),
+                        "--require-repaired"]) == 1
+
+
+def test_repair_tool_probe_and_apply_e2e(tmp_path, capsys,
+                                         corpus_bin):
+    """The acceptance e2e on the REAL pair: --probe mints the gap
+    corpus from both tiers, repair localizes the differing guard,
+    --apply installs the re-certified <binding>+repaired binding."""
+    from killerbeez_tpu.hybrid.registry import _BINDINGS
+    from killerbeez_tpu.tools.repair_tool import main as repair_main
+    d = str(tmp_path / "gaps")
+    rc = repair_main(["--binding", "test_safe", "--gaps-dir", d,
+                      "--probe", "--apply", "--require-repaired",
+                      "--json"])
+    out = json.loads(capsys.readouterr().out)
+    try:
+        assert rc == 0, out
+        assert out["status"] == "repaired"
+        binding = get_binding("test_safe")
+        want = _d_check(get_target("test"))
+        assert any(c["blame"]["pc"] == want.pc
+                   for c in out["clusters"])
+        assert out["installed"] == "test_safe+repaired"
+        installed = get_binding("test_safe+repaired")
+        prog = installed.program()
+        assert prog.name.endswith("+repaired")
+        # the installed proxy agrees with hybrid-safe on the old gap
+        assert verdict_class(concrete_run(prog, b"ABCD").status) \
+            == "ok"
+        # drift lint is clean right after the repair
+        assert conformance_lint(d, backlog_threshold=0) == []
+    finally:
+        _BINDINGS.pop("test_safe+repaired", None)
+
+
+def test_repair_tool_probe_faithful_binding_finds_nothing(
+        tmp_path, capsys, corpus_bin):
+    """The faithful test⇄test-plain pair probes clean: no gap
+    reports, verdict no-gaps, exit 0 (without --require-repaired)."""
+    from killerbeez_tpu.tools.repair_tool import main as repair_main
+    d = str(tmp_path / "gaps")
+    rc = repair_main(["--binding", "test", "--gaps-dir", d,
+                      "--probe", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["status"] == "no-gaps"
+    assert out["reason"] == "gap:none-for-binding"

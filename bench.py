@@ -1471,6 +1471,118 @@ def bench_repair(gate=False):
     return 0
 
 
+#: the --vsa gate universe and the uplift requirement: strictly more
+#: solved static edges than the plain solver on at least this many
+#: of the targets, zero per-edge regressions anywhere
+VSA_GATE_TARGETS = ("imgparse_vm", "rledec_vm", "tlvstack_vm")
+VSA_GATE_MIN_UPLIFTED = 2
+
+
+def bench_vsa(gate=False):
+    """--vsa lane: the value-set solver-uplift gate (ISSUE 19).
+
+    For every static edge of each gate target, solve with the plain
+    solver and with VSA seeding + the visit-cap escalation ladder,
+    both at DEFAULT budgets.  The gate requires:
+
+      * zero regressions — no edge's verdict rank drops
+        (solved > unsat > unknown) under --vsa;
+      * strictly more solved edges on >= VSA_GATE_MIN_UPLIFTED
+        targets;
+      * every newly-solved edge's witness INDEPENDENTLY re-verified
+        here by concrete replay (the synthesized input must walk the
+        edge — not just trusted from the solver's own check);
+      * every newly-unsat edge carrying an exhaustive-refutation
+        certificate (caps unhit at the refuting rung).
+
+    Artifact: bench_out/BENCH_vsa.json."""
+    import numpy as np
+
+    from killerbeez_tpu.analysis.dataflow import analyze_dataflow
+    from killerbeez_tpu.analysis.solver import (
+        concrete_run, solve_edge, solve_edge_vsa,
+    )
+    from killerbeez_tpu.analysis.vsa import analyze_vsa
+    from killerbeez_tpu.models.targets import get_target
+    from killerbeez_tpu.models import targets_cgc  # noqa: F401
+
+    os.makedirs(os.path.join(REPO, "bench_out"), exist_ok=True)
+    art = os.path.join(REPO, "bench_out", "BENCH_vsa.json")
+    rank = {"solved": 2, "unsat": 1, "unknown": 0}
+    rows = []
+    ok = True
+    uplifted = 0
+    for name in VSA_GATE_TARGETS:
+        program = get_target(name)
+        edges = sorted(
+            (int(f), int(t)) for f, t in
+            zip(np.asarray(program.edge_from),
+                np.asarray(program.edge_to)))
+        df = analyze_dataflow(program)
+        t0 = time.time()
+        vsa = analyze_vsa(program)
+        fixpoint_s = time.time() - t0
+        base_n = {"solved": 0, "unsat": 0, "unknown": 0}
+        vsa_n = {"solved": 0, "unsat": 0, "unknown": 0}
+        regressions, unverified, uncertified = [], [], []
+        t0 = time.time()
+        for e in edges:
+            b = solve_edge(program, e)
+            v = solve_edge_vsa(program, e, vsa=vsa, dataflow=df)
+            base_n[b.status] += 1
+            vsa_n[v.status] += 1
+            key = f"{e[0]}:{e[1]}"
+            if rank[v.status] < rank[b.status]:
+                regressions.append(key)
+            if v.status == "solved" and b.status != "solved":
+                if e not in concrete_run(program, v.input).edges:
+                    unverified.append(key)
+            if v.status == "unsat" and b.status != "unsat":
+                cert = (v.vsa or {}).get("certificate")
+                if not (cert and cert.get("exhaustive")):
+                    uncertified.append(key)
+        wall = max(time.time() - t0, 1e-9)
+        up = vsa_n["solved"] > base_n["solved"]
+        uplifted += up
+        if regressions:
+            ok = False
+            print(f"FAIL: {name} verdicts regressed under --vsa: "
+                  f"{regressions}", file=sys.stderr)
+        if unverified:
+            ok = False
+            print(f"FAIL: {name} newly-solved witnesses failed "
+                  f"replay: {unverified}", file=sys.stderr)
+        if uncertified:
+            ok = False
+            print(f"FAIL: {name} newly-unsat edges lack exhaustive "
+                  f"certificates: {uncertified}", file=sys.stderr)
+        rows.append(emit(
+            f"vsa-{name}",
+            f"plain vs VSA-seeded solver over {len(edges)} static "
+            f"edges at default budgets",
+            len(edges) / wall, unit="edges/sec",
+            base=base_n, vsa=vsa_n, uplift=up,
+            regressions=regressions,
+            fixpoint_s=round(fixpoint_s, 3),
+            n_branch_facts=len(vsa.branches),
+            wall_s=round(wall, 2)))
+    if uplifted < VSA_GATE_MIN_UPLIFTED:
+        ok = False
+        print(f"FAIL: VSA uplift on {uplifted} target(s) < required "
+              f"{VSA_GATE_MIN_UPLIFTED} of {len(VSA_GATE_TARGETS)}",
+              file=sys.stderr)
+    rows.append(emit(
+        "vsa-summary",
+        f"targets with strictly more solved edges under --vsa "
+        f"(need >= {VSA_GATE_MIN_UPLIFTED})",
+        float(uplifted), unit="targets", ok=ok))
+    with open(art, "w") as f:
+        json.dump({"rows": rows, "ok": ok}, f, indent=1)
+    if gate and not ok:
+        return 1
+    return 0
+
+
 BENCH_R05_GATE = 1807549.5   # BENCH_r05 headline: execs/s/chip,
 #                              fused-pallas superbatch on tlvstack_vm
 
@@ -2002,6 +2114,18 @@ def main():
                   file=sys.stderr)
             return 2
         return bench_repair(gate=gate)
+
+    if "--vsa" in sys.argv[1:]:
+        # value-set solver-uplift lane:
+        #   python bench.py --vsa [--gate]
+        rest = [a for a in sys.argv[1:] if a != "--vsa"]
+        gate = "--gate" in rest
+        rest = [a for a in rest if a != "--gate"]
+        if rest:
+            print(f"error: unknown --vsa arg {rest[0]!r}",
+                  file=sys.stderr)
+            return 2
+        return bench_vsa(gate=gate)
 
     if "--crack" in sys.argv[1:]:
         # plateau-crack A/B mode:

@@ -35,6 +35,19 @@ of facts AFL can only estimate dynamically is simply computable here:
                counterexample + certification seed; anything else is
                an honest ``unrepairable`` — the ``kb-repair`` tool
                and the fuzzing loop's ``--auto-repair`` stage
+  vsa.py       value-set analysis — a second abstract-interpretation
+               fixpoint over a reduced product of strided intervals
+               and small value sets per register and input byte,
+               int32-exact transfer functions, affine byte
+               provenance; every published domain checkable by
+               concrete replay (``check_replay``).  Consumers:
+               solver seeding (``kb-solve --vsa``), grammar
+               alphabets (``derive_grammar(vsa=)``), value priors
+               (priors.py), and the infeasible-edge lint class
+               (``kb-lint --vsa``)
+  priors.py    static per-position value histograms from VSA — the
+               ``kbz-value-prior-v1`` sidecar initializing ROADMAP
+               item 4's value-conditioned model
 """
 
 from .cfg import ControlFlowGraph, build_cfg, static_edge_prior
@@ -52,8 +65,14 @@ from .repair import (
     REPAIR_SCHEMA, Patch, apply_patch, enumerate_patches, run_repair,
     save_patched_program, write_repair_ledger,
 )
+from .priors import PRIOR_SCHEMA, load_priors, save_priors, value_priors
 from .solver import (
-    SolveResult, concrete_run, edge_dep_mask, solve_edge, solve_edges,
+    SolveResult, concrete_run, edge_dep_mask, solve_edge,
+    solve_edge_vsa, solve_edges, vsa_seed_domains,
+)
+from .vsa import (
+    VSA_SCHEMA, VDom, VsaFact, VsaResult, analyze_vsa, check_replay,
+    program_sig, vsa_stats,
 )
 
 __all__ = [
@@ -62,7 +81,10 @@ __all__ = [
     "dictionary_candidates", "extract_dictionary",
     "Finding", "lint_program",
     "SolveResult", "concrete_run", "edge_dep_mask", "solve_edge",
-    "solve_edges",
+    "solve_edge_vsa", "solve_edges", "vsa_seed_domains",
+    "VSA_SCHEMA", "VDom", "VsaFact", "VsaResult", "analyze_vsa",
+    "check_replay", "program_sig", "vsa_stats",
+    "PRIOR_SCHEMA", "value_priors", "save_priors", "load_priors",
     "GAP_SCHEMA", "BLAME_SCHEMA", "REPAIR_SCHEMA",
     "GapReport", "GapParseError", "BlameRecord", "Patch",
     "parse_gap_report", "load_gap_reports", "replay_gaps",

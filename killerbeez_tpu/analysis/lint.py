@@ -34,6 +34,31 @@ Each check turns a silent correctness hazard into a reported finding:
                           a look)
     no-blocks             the program has no coverage blocks at all
 
+  VSA checks (``lint_program(..., vsa=VsaResult)``; kb-lint enables
+  them with ``--vsa``):
+    infeasible-edge       (warning; error when constant propagation
+                          independently folds the branch the same
+                          way) a branch side whose VSA domains make
+                          the outcome empty — the edge pads the
+                          static universe and the solver's frontier.
+                          For stateful targets, a side only message
+                          sequences can take downgrades to info
+                          (``session-infeasible-edge``), mirroring
+                          the dead-block downgrade
+    value-range-contradiction
+                          (warning) a pc constant propagation says
+                          is reached but the value-set fixpoint
+                          proves no value combination enters —
+                          refinement emptied every path in; info
+                          under the same session-live downgrade
+    guaranteed-oob-store  (warning) a LDM/STM whose VSA index
+                          interval lies entirely outside
+                          [0, mem_size) on a non-constant index —
+                          the access always faults, but constant
+                          propagation cannot see it (constant
+                          indices already surface via crash-pc
+                          analysis)
+
   stateful targets (``lint_program(..., stateful=StatefulSpec)``;
   kb-lint resolves the spec from the target registry automatically):
     state-unreachable     (warning) a protocol state the program
@@ -86,10 +111,13 @@ class Finding:
 def lint_program(program,
                  cfg: Optional[ControlFlowGraph] = None,
                  dataflow: Optional[DataflowResult] = None,
-                 stateful=None) -> List[Finding]:
+                 stateful=None, vsa=None) -> List[Finding]:
     """All checks over one Program, errors first.  ``stateful`` (a
     StatefulSpec) enables the session-tier checks and downgrades
-    single-shot dead-block warnings for session-reachable blocks."""
+    single-shot dead-block warnings for session-reachable blocks.
+    ``vsa`` (a VsaResult) enables the value-set checks; ``None``
+    (the default) leaves the finding list bit-identical to the
+    pre-VSA linter — the parity anchor."""
     cfg = cfg or build_cfg(program)
     dataflow = dataflow or analyze_dataflow(program)
     out: List[Finding] = []
@@ -242,6 +270,11 @@ def lint_program(program,
             f"always goes the other way)",
             {"block": k, "pc": cfg.block_pcs[k]}))
 
+    # -- value-set checks (--vsa) -------------------------------------
+    if vsa is not None:
+        out.extend(_vsa_findings(program, cfg, dataflow, vsa,
+                                 session_live))
+
     # -- must-crash blocks --------------------------------------------
     for k in sorted(dataflow.must_crash_blocks):
         out.append(Finding(
@@ -258,6 +291,110 @@ def lint_program(program,
 
     sev_rank = {SEV_ERROR: 0, SEV_WARNING: 1, SEV_INFO: 2}
     out.sort(key=lambda f: sev_rank[f.severity])
+    return out
+
+
+def _block_of_pc(cfg: ControlFlowGraph, pc: int) -> Optional[int]:
+    """Block index containing ``pc`` (the nearest OP_BLOCK at/before
+    it), None for pre-block prologue pcs."""
+    k = None
+    for i, bpc in enumerate(cfg.block_pcs):
+        if bpc <= pc:
+            k = i
+        else:
+            break
+    return k
+
+
+def _vsa_findings(program, cfg: ControlFlowGraph,
+                  dataflow: DataflowResult, vsa,
+                  session_live) -> List[Finding]:
+    """The three value-set checks.  All anchored on pcs so the SARIF
+    emitter places them like every other finding."""
+    out: List[Finding] = []
+    instrs = np.asarray(program.instrs)
+    ni = instrs.shape[0]
+    always_by_pc = {f.pc: f.always for f in dataflow.branches}
+
+    def live_downgrade(block: Optional[int]) -> bool:
+        return (session_live is not None and block is not None
+                and block in session_live)
+
+    # -- infeasible-edge: a branch side VSA proves empty --------------
+    for f in vsa.branches:
+        for want, feas in ((True, f.feasible_true),
+                           (False, f.feasible_false)):
+            if feas:
+                continue
+            succ = int(instrs[f.pc, 3]) if want else f.pc + 1
+            sblk = _block_of_pc(cfg, succ) if 0 <= succ < ni else None
+            side = "taken" if want else "fallthrough"
+            agrees = always_by_pc.get(f.pc) == (not want)
+            if live_downgrade(sblk):
+                out.append(Finding(
+                    SEV_INFO, "session-infeasible-edge",
+                    f"branch at pc {f.pc} ({f.cmp} with "
+                    f"x={f.x_dom} y={f.y_dom}) cannot go "
+                    f"{side} in a single shot, but message "
+                    f"sequences can — the session tier's target "
+                    f"surface, not dead weight",
+                    {"pc": f.pc, "side": side, "block": f.block,
+                     "succ_block": sblk}))
+                continue
+            sev = SEV_ERROR if agrees else SEV_WARNING
+            out.append(Finding(
+                sev, "infeasible-edge",
+                f"branch at pc {f.pc} ({f.cmp} with x={f.x_dom} "
+                f"y={f.y_dom}) can never go {side}: the value-set "
+                f"domains make that outcome empty"
+                + (" — constant propagation independently agrees"
+                   if agrees else "")
+                + "; the edge pads the static universe and the "
+                  "solver frontier",
+                {"pc": f.pc, "side": side, "block": f.block,
+                 "succ_block": sblk,
+                 "constprop_agrees": bool(agrees)}))
+
+    # -- value-range-contradiction: constprop reaches, VSA refutes ----
+    contradicted = sorted(dataflow.reached_pcs - vsa.reached_pcs)
+    by_block: Dict[Optional[int], List[int]] = {}
+    for pc in contradicted:
+        by_block.setdefault(_block_of_pc(cfg, pc), []).append(pc)
+    for blk, pcs in sorted(by_block.items(),
+                           key=lambda kv: (kv[0] is None, kv[0])):
+        if live_downgrade(blk):
+            out.append(Finding(
+                SEV_INFO, "session-value-range-contradiction",
+                f"pcs {pcs} (block {blk}) are reached under "
+                f"constant propagation but the value-set fixpoint "
+                f"proves no single-shot value combination enters — "
+                f"session-only surface",
+                {"block": blk, "pcs": pcs}))
+            continue
+        out.append(Finding(
+            SEV_WARNING, "value-range-contradiction",
+            f"pcs {pcs}" + (f" (block {blk})" if blk is not None
+                            else "")
+            + " are reached under constant propagation but the "
+              "value-set fixpoint proves no value combination "
+              "enters: byte-domain refinement emptied every path "
+              "in", {"block": blk, "pcs": pcs}))
+
+    # -- guaranteed-oob-store: non-constant index, interval all OOB ---
+    mem = int(program.mem_size)
+    for m in vsa.mem_ops:
+        d = m.idx_dom
+        if d.const_val is not None:
+            continue                    # crash-pc analysis owns these
+        if d.hi < 0 or d.lo >= mem:
+            out.append(Finding(
+                SEV_WARNING, "guaranteed-oob-store",
+                f"{m.op} at pc {m.pc} indexes mem[{d}] — entirely "
+                f"outside [0, {mem}): every execution reaching it "
+                f"faults, invisible to constant propagation "
+                f"(non-constant index)",
+                {"pc": m.pc, "op": m.op, "block": m.block,
+                 "index_domain": str(d), "mem_size": mem}))
     return out
 
 

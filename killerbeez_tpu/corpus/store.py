@@ -81,9 +81,10 @@ STATE_FILE = "campaign.json"
 MUTATOR_STATE_FILE = "mutator.state"
 INSTR_STATE_FILE = "instrumentation.state"
 SOLVER_STATE_FILE = "solver.json"
+VSA_STATE_FILE = "vsa.json"
 CHECKPOINT_FILE = _ckpt.CHECKPOINT_FILE
 _RESERVED = (STATE_FILE, MUTATOR_STATE_FILE, INSTR_STATE_FILE,
-             SOLVER_STATE_FILE, CHECKPOINT_FILE,
+             SOLVER_STATE_FILE, VSA_STATE_FILE, CHECKPOINT_FILE,
              CHECKPOINT_FILE + _ckpt.PREV_SUFFIX)
 
 # Cross-tier validation verdicts (hybrid bridge; docs/HYBRID.md).
@@ -403,7 +404,8 @@ class CorpusStore:
         erase its last good state from the epoch chain."""
         prev = self.load_checkpoint()
         if prev:
-            for section in ("campaign", "solver", "event_seq"):
+            for section in ("campaign", "solver", "vsa",
+                            "event_seq"):
                 if section not in doc and section in prev:
                     doc[section] = prev[section]
             pc = prev.get("components")
@@ -511,3 +513,32 @@ class CorpusStore:
             return d if isinstance(d, dict) else {}
         except (OSError, ValueError):
             return {}
+
+    # -- VSA document (value-set fixpoint; analysis/vsa.py) -------------
+
+    def save_vsa_doc(self, doc: Dict[str, Any]) -> None:
+        """The serialized value-set fixpoint (``VsaResult.to_doc``) —
+        a pure function of the program, keyed by ``program_sig``, so
+        ``--resume`` and repeated cracks never re-run the analysis.
+        Same dual-write discipline as the solver cache: standalone
+        file for offline tools, write-through epoch when a checkpoint
+        exists (checkpoint-first loaders must not shadow a newer doc
+        with a stale ``vsa`` section)."""
+        try:
+            _atomic_write(os.path.join(self.root, VSA_STATE_FILE),
+                          json.dumps(doc).encode())
+        except OSError as e:
+            WARNING_MSG("vsa doc write failed: %s", e)
+        if self.load_checkpoint() is not None:
+            self.save_checkpoint({"vsa": dict(doc)})
+
+    def load_vsa_doc(self) -> Optional[Dict[str, Any]]:
+        ck = self.load_checkpoint()
+        if ck and isinstance(ck.get("vsa"), dict):
+            return ck["vsa"]
+        try:
+            with open(os.path.join(self.root, VSA_STATE_FILE)) as f:
+                d = json.load(f)
+            return d if isinstance(d, dict) else None
+        except (OSError, ValueError):
+            return None

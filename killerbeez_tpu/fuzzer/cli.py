@@ -174,6 +174,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "inputs (analysis/solver.py) and inject them; "
                         "solve results persist to the corpus store's "
                         "solver.json so resumes don't re-solve")
+    p.add_argument("--vsa", action="store_true",
+                   help="with --crack: seed the solver's byte "
+                        "domains from the value-set fixpoint "
+                        "(analysis/vsa.py) and escalate visit caps "
+                        "on honest visit-cap unknowns; the fixpoint "
+                        "document caches in the corpus checkpoint "
+                        "epoch so --resume and repeated cracks "
+                        "never re-run it")
     p.add_argument("--descend", type=int, nargs="?", const=48,
                    default=0, metavar="N",
                    help="with --crack: escalate solver-UNKNOWN edges "
@@ -645,6 +653,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "solver-unknown frontier — it needs --crack",
                   file=sys.stderr)
             return 2
+        if args.vsa and not args.crack:
+            print("error: --vsa seeds the crack stage's solver "
+                  "from the value-set fixpoint — it needs --crack",
+                  file=sys.stderr)
+            return 2
         if args.crack:
             prog = getattr(instrumentation, "program", None)
             if prog is None or not instrumentation.device_backed \
@@ -669,7 +682,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 descend=args.descend,
                 descend_lanes=args.descend_lanes,
                 descend_engine=args.descend_engine,
-                descend_scan_iters=args.descend_scan_iters)
+                descend_scan_iters=args.descend_scan_iters,
+                vsa=args.vsa)
         if args.auto_repair:
             if hybrid_bridge is None:
                 print("error: --auto-repair consumes the hybrid "

@@ -74,7 +74,8 @@ class BranchCracker:
                  descend_engine: str = "device",
                  descend_scan_iters: int = 0,
                  max_solves: Optional[int] = None,
-                 max_descends: Optional[int] = None):
+                 max_descends: Optional[int] = None,
+                 vsa: bool = False):
         self.program = program
         self.plateau_batches = max(int(plateau_batches), 1)
         self.budget = int(budget)
@@ -112,6 +113,14 @@ class BranchCracker:
         self.slot_of_edge: Dict[Tuple[int, int], int] = {
             e: int(s) for e, s in zip(self.edges, slots)}
         self._dataflow = None           # lazy (mask computation only)
+        #: --vsa: solve through solve_edge_vsa (byte-domain seeding
+        #: + the visit-cap escalation ladder); the fixpoint document
+        #: is computed once and cached in the corpus store's
+        #: checkpoint epoch, so --resume and repeated cracks never
+        #: re-run it.  Off (default): solve_edge, bit-identical to
+        #: the pre-VSA cracker.
+        self.vsa = bool(vsa)
+        self._vsa_result = None         # lazy (first crack)
         #: "f:t" -> {"status", "reason", "input_hex"?}
         self.cache: Dict[str, Dict] = {}
         if store is not None:
@@ -132,6 +141,27 @@ class BranchCracker:
     @staticmethod
     def _key(edge: Tuple[int, int]) -> str:
         return f"{edge[0]}:{edge[1]}"
+
+    # -- the value-set document (--vsa) ---------------------------------
+
+    def _get_vsa(self):
+        """The VsaResult for this program: corpus-cached doc if its
+        ``program_sig`` still matches, else one fresh fixpoint run
+        persisted for every later crack / resume."""
+        if self._vsa_result is not None:
+            return self._vsa_result
+        from ..analysis.vsa import VsaResult, analyze_vsa
+        if self.store is not None:
+            doc = self.store.load_vsa_doc()
+            if doc is not None:
+                cached = VsaResult.from_doc(doc, self.program)
+                if cached is not None:
+                    self._vsa_result = cached
+                    return cached
+        self._vsa_result = analyze_vsa(self.program)
+        if self.store is not None:
+            self.store.save_vsa_doc(self._vsa_result.to_doc())
+        return self._vsa_result
 
     # -- the plateau trigger --------------------------------------------
 
@@ -187,9 +217,16 @@ class BranchCracker:
         t0 = time.time()
         for e in fresh[:self.max_solves]:
             reg.count("solver_attempts")
-            res = solve_edge(self.program, e, budget=self.budget,
-                             max_visits=self.max_visits,
-                             max_len=self.max_len)
+            if self.vsa:
+                from ..analysis.solver import solve_edge_vsa
+                res = solve_edge_vsa(
+                    self.program, e, vsa=self._get_vsa(),
+                    budget=self.budget, max_visits=self.max_visits,
+                    max_len=self.max_len)
+            else:
+                res = solve_edge(self.program, e, budget=self.budget,
+                                 max_visits=self.max_visits,
+                                 max_len=self.max_len)
             entry = {"status": res.status, "reason": res.reason}
             if res.status == "solved":
                 reg.count("solver_solved")

@@ -36,14 +36,57 @@ from .spec import Field, Grammar, Rule, blob, length, lit, token
 MAX_ALPHA = 16
 
 
+def _vsa_facts(vsa) -> Tuple[Dict[int, Set[int]], Set[int]]:
+    """Per-position value sets and bound positions from VSA affine
+    guard inversion — the facts the guarding-constant pass provably
+    cannot see: a byte compared through arithmetic (``b0+200==300``
+    folds to an affine operand vs a constant outside 0..255) inverts
+    to the byte values that flip the guard.  eq/ne guards contribute
+    alphabets; lt/ge guards over an affine byte mark the position as
+    a length-style bound (the KBVM range-check idiom), exactly like
+    the literal ``bounds`` path.  Length-dependent guards exclude
+    themselves: LEN yields a non-constant domain, so no constant
+    other side exists to invert against."""
+    from ..analysis.vsa import affine_sat_set
+    from ..models.vm import CMP_EQ
+    pins: Dict[int, Set[int]] = {}
+    bounds: Set[int] = set()
+    for f in sorted(vsa.branches, key=lambda f: f.pc):
+        for aff, other in ((f.x_affine, f.y_dom),
+                           (f.y_affine, f.x_dom)):
+            if aff is None or other.const_val is None:
+                continue
+            i = aff[0]
+            if f.cmp in ("eq", "ne"):
+                # the values solving the equality are the magic
+                # values regardless of which side carries the byte
+                sat = affine_sat_set(aff, CMP_EQ,
+                                     other.const_val, True)
+                if 0 < len(sat) <= MAX_ALPHA:
+                    pins.setdefault(i, set()).update(sat)
+            else:                       # lt / ge: a range check
+                bounds.add(i)
+    return pins, bounds
+
+
 def derive_grammar(program,
-                   result: Optional[DataflowResult] = None
-                   ) -> Grammar:
+                   result: Optional[DataflowResult] = None,
+                   vsa=None) -> Grammar:
+    """Fold branch facts into a field layout.  With ``vsa`` (a
+    ``VsaResult``), affine guard inversion adds per-field alphabets
+    and bound positions the literal pass cannot derive; with
+    ``vsa=None`` (the default) the output is bit-identical to the
+    pre-VSA derivation — the parity anchor."""
     result = result or analyze_dataflow(program)
 
     pins: Dict[int, Set[int]] = {}
     wide: Dict[Tuple[int, int], Set[int]] = {}
     bounds: Set[int] = set()
+    if vsa is not None:
+        vpins, vbounds = _vsa_facts(vsa)
+        for i, vals in vpins.items():
+            pins.setdefault(i, set()).update(vals)
+        bounds |= vbounds
     for f in sorted(result.branches, key=lambda f: f.pc):
         if f.const is None or f.deps is ANY or not f.deps:
             continue

@@ -13,6 +13,9 @@ Usage:
     kb-lint --program-file p.npz  # a compiled program
     kb-lint --json                # machine-readable report
     kb-lint --dict tlvstack_vm    # print the auto-dictionary too
+    kb-lint --vsa                 # + value-set checks (infeasible-
+                                  # edge, value-range-contradiction,
+                                  # guaranteed-oob-store)
 """
 
 from __future__ import annotations
@@ -67,23 +70,36 @@ def _load_programs(args) -> List:
     return progs
 
 
-def lint_report(program, want_dict: bool = False) -> Dict:
+def lint_report(program, want_dict: bool = False,
+                want_vsa: bool = False) -> Dict:
     """One target's full report (the --json per-target payload).
     Stateful targets (registered in models.targets_stateful) get the
     session-tier checks automatically: state-unreachable /
     state-clip warnings and the dead-block -> session-only-block
-    downgrade."""
+    downgrade.  ``want_vsa`` runs the value-set fixpoint, enables
+    the infeasible-edge / value-range-contradiction /
+    guaranteed-oob-store checks, and adds a ``vsa`` stats section
+    mirroring ``stats``; off (the default) the report is
+    bit-identical to the pre-VSA tool."""
     from ..models.targets_stateful import get_stateful_spec
     cfg = build_cfg(program)
     df = analyze_dataflow(program)
+    vsa = None
+    if want_vsa:
+        from ..analysis.vsa import analyze_vsa
+        vsa = analyze_vsa(program)
     findings = lint_program(program, cfg, df,
-                            stateful=get_stateful_spec(program.name))
+                            stateful=get_stateful_spec(program.name),
+                            vsa=vsa)
     rep = {
         "stats": universe_stats(program, cfg),
         "findings": [f.as_dict() for f in findings],
         "errors": sum(f.severity == SEV_ERROR for f in findings),
         "warnings": sum(f.severity == SEV_WARNING for f in findings),
     }
+    if vsa is not None:
+        from ..analysis.vsa import vsa_stats
+        rep["vsa"] = vsa_stats(vsa)
     if want_dict:
         rep["dictionary"] = [t.decode("latin-1")
                              for t in extract_dictionary(program, df)]
@@ -207,6 +223,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "to annotate findings on PRs")
     p.add_argument("--dict", action="store_true", dest="want_dict",
                    help="include the extracted auto-dictionary")
+    p.add_argument("--vsa", action="store_true", dest="want_vsa",
+                   help="run the value-set fixpoint: enables the "
+                        "infeasible-edge / value-range-contradiction"
+                        " / guaranteed-oob-store checks and a 'vsa' "
+                        "stats section in --json")
     p.add_argument("--gaps-dir",
                    help="a campaign's proxy_gaps/ directory: run the "
                         "conformance checks (proxy-gap-backlog, "
@@ -226,7 +247,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     locs = {}
     errors = warnings = 0
     for prog, loc in progs:
-        rep = lint_report(prog, want_dict=args.want_dict)
+        rep = lint_report(prog, want_dict=args.want_dict,
+                          want_vsa=args.want_vsa)
         key, n = prog.name, 2
         while key in reports:           # same-named programs must not
             key = f"{prog.name}#{n}"    # overwrite each other

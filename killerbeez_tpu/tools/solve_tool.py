@@ -12,6 +12,8 @@ Usage:
     kb-solve cgc_like --block 7           # any edge into block 7
     kb-solve test --json --explain
     kb-solve test --require-solved 11     # CI gate
+    kb-solve imgparse_vm --vsa --explain  # value-set seeding +
+                                          # per-byte domain verdicts
 """
 
 from __future__ import annotations
@@ -49,13 +51,30 @@ def _load_program(args):
 
 
 def solve_report(program, edges, *, budget: int, max_visits: int,
-                 max_len: int, explain: bool) -> dict:
-    """The --json payload (and the CI smoke lane's data source)."""
+                 max_len: int, explain: bool,
+                 vsa: bool = False) -> dict:
+    """The --json payload (and the CI smoke lane's data source).
+    ``vsa=True`` routes every edge through ``solve_edge_vsa``
+    (byte-domain seeding + the visit-cap escalation ladder) and
+    attaches each verdict's ``vsa`` metadata; False (the default)
+    keeps the report bit-identical to the pre-VSA tool."""
     out = {"target": program.name, "edges": {}, "solved": 0,
            "unsat": 0, "unknown": 0}
+    vsa_doc = df = None
+    if vsa:
+        from ..analysis.dataflow import analyze_dataflow
+        from ..analysis.solver import solve_edge_vsa
+        from ..analysis.vsa import analyze_vsa
+        vsa_doc = analyze_vsa(program)
+        df = analyze_dataflow(program)
     for e in edges:
-        r = solve_edge(program, e, budget=budget,
-                       max_visits=max_visits, max_len=max_len)
+        if vsa:
+            r = solve_edge_vsa(program, e, vsa=vsa_doc,
+                               budget=budget, max_visits=max_visits,
+                               max_len=max_len, dataflow=df)
+        else:
+            r = solve_edge(program, e, budget=budget,
+                           max_visits=max_visits, max_len=max_len)
         d = r.as_dict()
         if not explain:
             d.pop("conditions", None)
@@ -92,9 +111,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"(default {DEFAULT_MAX_LEN})")
     p.add_argument("--json", action="store_true",
                    help="machine-readable report on stdout")
+    p.add_argument("--vsa", action="store_true",
+                   help="seed byte domains from the value-set "
+                        "fixpoint and escalate visit caps on honest "
+                        "visit-cap unknowns (analysis/vsa.py)")
     p.add_argument("--explain", action="store_true",
                    help="print the collected path condition of each "
-                        "solved edge")
+                        "solved edge; with --vsa, also the VSA "
+                        "domain that pruned (or failed to prune) "
+                        "each free byte of unknown edges")
     p.add_argument("--require-solved", type=int, metavar="N",
                    help="exit 1 unless at least N edges solved (the "
                         "CI smoke gate: a previously-solvable edge "
@@ -119,7 +144,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     rep = solve_report(program, edges, budget=args.budget,
                        max_visits=args.max_visits,
-                       max_len=args.max_len, explain=args.explain)
+                       max_len=args.max_len, explain=args.explain,
+                       vsa=args.vsa)
     ok = (args.require_solved is None
           or rep["solved"] >= args.require_solved)
 
@@ -141,6 +167,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                         print(f"      {c}")
             else:
                 print(f"  {key}: {d['status']} ({d['reason']})")
+            if args.explain and d.get("vsa"):
+                v = d["vsa"]
+                if v.get("visit_ladder"):
+                    print(f"      vsa: visit ladder "
+                          f"{v['visit_ladder']}, seeded bytes "
+                          f"{v.get('seeded_bytes', [])}")
+                for var, desc in sorted(
+                        v.get("domains", {}).items()):
+                    print(f"      vsa: {var}: {desc}")
+                if v.get("certificate"):
+                    c = v["certificate"]
+                    print(f"      vsa: unsat certificate — "
+                          f"exhaustive at max_visits="
+                          f"{c['max_visits']}, "
+                          f"{c['expansions']} expansions, "
+                          f"{len(c['forced_guards'])} forced "
+                          f"guard(s)")
         if args.require_solved is not None and not ok:
             print(f"FAIL: {rep['solved']} solved < required "
                   f"{args.require_solved}", file=sys.stderr)

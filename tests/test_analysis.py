@@ -610,3 +610,67 @@ def test_dataflow_len_dep_flags_length_comparisons():
     assert any(not f.deps for f in len_facts)   # pure length bound
     content = [f for f in df.branches if f.deps and not f.len_dep]
     assert content                      # the 32-bit magic gate
+
+
+# -- _fold_cmp vs the concrete engine at int32 boundaries ------------
+#
+# _fold_cmp is the shared fold both constant propagation and the
+# value-set tier compare through: a signedness or wrap slip here
+# poisons every derived fact.  Pin it against the CONCRETE engine:
+# build each operand in-register (LDI + SHL + OR byte chunks — the
+# <2^24 field bound forbids wide immediates), branch on it, and
+# compare the folded verdict with the block the VM actually walked.
+
+_INT32_EDGE_VALUES = (
+    -(1 << 31),                         # INT32_MIN
+    -(1 << 31) + 1,
+    -1, 0, 1,
+    (1 << 31) - 1,                      # INT32_MAX
+    (1 << 31),                          # wraps to INT32_MIN
+    (1 << 32) - 1,                      # wraps to -1
+    0x7FFFFF01,                         # MAX-ish vs small positive
+)
+
+
+def _emit_const32(a, rd, value, scratch):
+    """rd = int32(value), built from 8-bit chunks via SHL/OR so every
+    instruction field stays below 2^24.  The final OR of the top
+    chunk wraps through _i32 exactly like any runtime ALU result."""
+    v = value & 0xFFFFFFFF
+    a.ldi(rd, (v >> 24) & 0xFF)
+    for shift in (16, 8, 0):
+        a.ldi(scratch, 8)
+        a.alu("shl", rd, rd, scratch)
+        a.ldi(scratch, (v >> shift) & 0xFF)
+        a.alu("or", rd, rd, scratch)
+
+
+@pytest.mark.parametrize("cmp_name,sel", [("eq", 0), ("ne", 1),
+                                          ("lt", 2), ("ge", 3)])
+def test_fold_cmp_matches_concrete_engine_at_int32_boundaries(
+        cmp_name, sel):
+    from killerbeez_tpu.analysis.dataflow import _fold_cmp, _i32
+    from killerbeez_tpu.analysis.solver import concrete_run
+    for xv in _INT32_EDGE_VALUES:
+        for yv in _INT32_EDGE_VALUES:
+            a = Assembler(f"fold_{cmp_name}", mem_size=16,
+                          max_steps=128)
+            a.block()
+            _emit_const32(a, 0, xv, 6)
+            _emit_const32(a, 1, yv, 6)
+            a.br(cmp_name, 0, 1, "taken")
+            a.block()                   # block 1: fallthrough
+            a.halt()
+            a.label("taken")
+            a.block()                   # block 2: taken side
+            a.halt()
+            prog = a.build()
+            trace = concrete_run(prog, b"")
+            concrete_taken = 2 in trace.blocks
+            folded = _fold_cmp(sel, _i32(xv), _i32(yv))
+            assert folded is not None, (cmp_name, xv, yv)
+            assert folded == concrete_taken, (cmp_name, xv, yv)
+            # and the dataflow pass folds the same verdict end-to-end
+            df = analyze_dataflow(prog)
+            fact = [f for f in df.branches][0]
+            assert fact.always == concrete_taken, (cmp_name, xv, yv)

@@ -342,3 +342,62 @@ def test_grammar_and_learn_exclusive():
     with pytest.raises(ValueError, match="mutually exclusive"):
         instrumentation_factory("jit_harness", json.dumps(
             {"target": "test", "grammar": "degenerate", "learn": 1}))
+
+
+# ---------------------------------------------------------------------------
+# VSA-sourced facts (derive_grammar(vsa=); analysis/vsa.py consumer)
+# ---------------------------------------------------------------------------
+
+
+def test_derive_vsa_only_facts_nondegenerate():
+    """A program whose ONLY facts are VSA-derived (an affine guard
+    against an out-of-byte-range constant — invisible to the literal
+    guarding-constant pass) must still derive a non-degenerate
+    grammar once the value-set tier feeds it."""
+    from killerbeez_tpu.analysis.vsa import analyze_vsa
+    from killerbeez_tpu.models.compiler import Assembler
+    a = Assembler("affine_only", mem_size=16, max_steps=64)
+    a.block()
+    a.ldi(1, 0)
+    a.ldb(0, 1)
+    a.addi(0, 0, 200)                   # only fact: b0+200 == 300
+    a.ldi(2, 300)
+    a.br("eq", 0, 2, "win")
+    a.block()
+    a.halt()
+    a.label("win")
+    a.block()
+    a.crash()
+    prog = a.build()
+    # the literal pass alone: degenerate (one free blob, no pins)
+    g0 = derive_grammar(prog)
+    assert not compile_grammar(g0).nondegen
+    # with VSA: byte 0 pinned to the inverted guard value
+    g1 = derive_grammar(prog, vsa=analyze_vsa(prog))
+    fields = g1.rules["msg"].fields
+    assert fields[0].kind == "lit" and fields[0].value == bytes([100])
+    assert compile_grammar(g1).nondegen
+
+
+def test_derive_degenerate_parity_survives_vsa_source():
+    """The degenerate-grammar bit-parity guarantee (derive.py
+    doctrine) must survive the new fact source: a program VSA can
+    say nothing useful about still derives the degenerate grammar,
+    and it still compiles to the blind-parity tables."""
+    from killerbeez_tpu.analysis.vsa import analyze_vsa
+    from killerbeez_tpu.models.compiler import Assembler
+    a = Assembler("no_facts", mem_size=16, max_steps=64)
+    a.block()
+    a.ldi(1, 0)
+    a.ldb(0, 1)                         # read a byte, gate nothing
+    a.halt()
+    prog = a.build()
+    g0 = derive_grammar(prog)
+    g1 = derive_grammar(prog, vsa=analyze_vsa(prog))
+    assert g0 == g1                     # the fact source added nothing
+    t = compile_grammar(g1)
+    assert not t.nondegen               # still the blind-parity tables
+    # and on a REAL target the vsa=None path is the exact pre-VSA
+    # derivation (the parity anchor for existing campaigns)
+    real = get_target("tlvstack_vm")
+    assert derive_grammar(real) == derive_grammar(real, vsa=None)
